@@ -1,0 +1,17 @@
+"""deep_vision_tpu: a TPU-native (JAX/XLA/pjit/Pallas) computer-vision training framework.
+
+A from-scratch rebuild of the capabilities of the `deep-vision` model zoo
+(reference: darveenvijayan/deep-vision) as one layered library:
+
+- ``core``      mesh-aware train state, rng, dtypes, checkpoint, metrics
+- ``parallel``  device mesh + sharding rules, ring attention, collectives
+- ``nn``        flax modules shared by all models (conv/bn/lrn/depthwise/...)
+- ``ops``       vectorized vision ops (iou, nms, anchors, heatmaps)
+- ``losses``    task losses (ce+aux, yolo, heatmap mse, focal+l1, gan)
+- ``models``    the model zoo (lenet ... cyclegan)
+- ``data``      record IO, dataset schemas, augmentations, device feed
+- ``train``     the single Trainer (+ GAN variant), optimizers, schedules
+- ``configs``   named experiment registry + CLI entry
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,3 @@
+from deep_vision_tpu.core.train_state import TrainState, create_train_state
+from deep_vision_tpu.core.checkpoint import CheckpointManager
+from deep_vision_tpu.core.metrics import MetricLogger, topk_accuracy

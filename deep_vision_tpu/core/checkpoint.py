@@ -1,0 +1,106 @@
+"""Checkpoint/resume for the whole zoo.
+
+Semantics preserved from the reference (SURVEY.md §2.6):
+  (a) full training-state capture incl. optimizer + scheduler + metric history
+      (torch dict at ResNet/pytorch/train.py:417-428);
+  (b) resume-by-flag (`-c <ckpt>`, ResNet/pytorch/train.py:293-307);
+  (c) best-val-only saving (YOLO/tensorflow/train.py:243-247);
+  (d) keep-every vs max_to_keep policies (CycleGAN/tensorflow/train.py:142-143,
+      DCGAN/tensorflow/main.py:40).
+
+TPU-native mechanism: orbax async checkpointing of the TrainState pytree,
+step-indexed directories, plus a small JSON sidecar for host-side state
+(metric history, plateau-scheduler state) that must never enter jit.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Optional
+
+import jax
+import orbax.checkpoint as ocp
+
+
+class CheckpointManager:
+    def __init__(
+        self,
+        directory: str,
+        max_to_keep: Optional[int] = 3,
+        save_interval_steps: int = 1,
+        best_mode: Optional[str] = None,  # None | 'min' | 'max'
+        best_metric: Optional[str] = None,
+    ):
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self._best_mode = best_mode
+        self._best_metric = best_metric
+        self._best_value = None
+        options = ocp.CheckpointManagerOptions(
+            max_to_keep=max_to_keep,
+            save_interval_steps=save_interval_steps,
+            enable_async_checkpointing=True,
+        )
+        self._mgr = ocp.CheckpointManager(self.directory, options=options)
+
+    # -- host-side sidecar -------------------------------------------------
+    def _sidecar_path(self, step: int) -> str:
+        return os.path.join(self.directory, f"host_state_{step}.json")
+
+    def save(self, step: int, state, host_state: Optional[dict] = None, metrics=None):
+        """Save TrainState (async) + JSON host state. Returns True if saved."""
+        if self._best_mode and metrics is not None and self._best_metric in metrics:
+            v = float(metrics[self._best_metric])
+            better = (
+                self._best_value is None
+                or (self._best_mode == "min" and v < self._best_value)
+                or (self._best_mode == "max" and v > self._best_value)
+            )
+            if not better:
+                return False
+            self._best_value = v
+        saveable = {
+            "step": state.step,
+            "params": state.params,
+            "batch_stats": state.batch_stats,
+            "opt_state": state.opt_state,
+            "rng": state.rng,
+        }
+        saved = self._mgr.save(step, args=ocp.args.StandardSave(saveable))
+        if saved and host_state is not None:
+            with open(self._sidecar_path(step), "w") as f:
+                json.dump(host_state, f)
+        return saved
+
+    def restore(self, state, step: Optional[int] = None):
+        """Restore into the structure of `state`; returns (state, host_state)."""
+        step = step if step is not None else self._mgr.latest_step()
+        if step is None:
+            return state, None
+        template = {
+            "step": state.step,
+            "params": state.params,
+            "batch_stats": state.batch_stats,
+            "opt_state": state.opt_state,
+            "rng": state.rng,
+        }
+        restored = self._mgr.restore(
+            step, args=ocp.args.StandardRestore(template)
+        )
+        state = state.replace(**restored)
+        host_state = None
+        sidecar = self._sidecar_path(step)
+        if os.path.exists(sidecar):
+            with open(sidecar) as f:
+                host_state = json.load(f)
+        return state, host_state
+
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    def wait(self):
+        self._mgr.wait_until_finished()
+
+    def close(self):
+        self._mgr.wait_until_finished()
+        self._mgr.close()

@@ -1,0 +1,116 @@
+"""Metrics: in-jit scalar computation + host-side series logging.
+
+The reference logged (a) dict-of-lists persisted inside checkpoints
+(ResNet/pytorch/train.py:260-285), (b) TensorBoard scalars at batch/epoch
+cadence (YOLO/tensorflow/train.py:159-179), (c) stdout lines with timestamps,
+and (d) examples/sec per epoch (YOLO/tensorflow/train.py:217-223) — its only
+perf instrumentation.
+
+Here: metric values are computed inside the jitted step (scalar means over the
+global batch; under pjit a batch mean is already a global mean, replacing
+`strategy.reduce(SUM)` at YOLO/tensorflow/train.py:134-151), and a MetricLogger
+accumulates host-side series + writes TensorBoard events + prints stdout lines
+with ISO timestamps, plus a built-in step timer / examples-per-sec meter.
+"""
+from __future__ import annotations
+
+import collections
+import datetime
+import time
+from typing import Dict, Optional
+
+import jax.numpy as jnp
+
+
+def topk_accuracy(logits, labels, ks=(1, 5), weights=None):
+    """Top-k accuracy fractions. Mirrors accuracy() at ResNet/pytorch/train.py:524-538.
+
+    labels: int class ids (B,). `weights` (B,) masks out padded rows (the
+    final partial batch). Returns dict {f'top{k}': scalar}.
+    """
+    maxk = max(ks)
+    # top-k prediction ids: (B, maxk)
+    topk = jnp.argsort(-logits, axis=-1)[:, :maxk]
+    correct = topk == labels[:, None]
+    if weights is None:
+        weights = jnp.ones(labels.shape, logits.dtype)
+    denom = jnp.maximum(jnp.sum(weights), 1e-9)
+    return {
+        f"top{k}": jnp.sum(jnp.any(correct[:, :k], axis=-1) * weights) / denom
+        for k in ks
+    }
+
+
+class _Meter:
+    def __init__(self):
+        self.total = 0.0
+        self.count = 0
+
+    def update(self, v, n=1):
+        self.total += float(v) * n
+        self.count += n
+
+    @property
+    def avg(self):
+        return self.total / max(self.count, 1)
+
+
+class MetricLogger:
+    """Host-side metric series, stdout logging and examples/sec meter."""
+
+    def __init__(self, tb_writer=None, print_every: int = 10, name: str = "train"):
+        self.history: Dict[str, list] = collections.defaultdict(list)
+        self.tb = tb_writer
+        self.print_every = print_every
+        self.name = name
+        self._epoch_meters: Dict[str, _Meter] = {}
+        self._epoch_start = time.time()
+        self._epoch_examples = 0
+
+    # -- epoch lifecycle ---------------------------------------------------
+    def start_epoch(self):
+        self._epoch_meters = collections.defaultdict(_Meter)
+        self._epoch_start = time.time()
+        self._epoch_examples = 0
+
+    def log_step(self, step: int, metrics: dict, batch_size: int = 0,
+                 epoch: Optional[int] = None, lr: Optional[float] = None):
+        metrics = {k: float(v) for k, v in metrics.items()}
+        for k, v in metrics.items():
+            self._epoch_meters[k].update(v, max(batch_size, 1))
+        self._epoch_examples += batch_size
+        if self.tb is not None:
+            for k, v in metrics.items():
+                self.tb.scalar(f"{self.name}/batch_{k}", v, step)
+        if self.print_every and step % self.print_every == 0:
+            ts = datetime.datetime.now().isoformat(timespec="seconds")
+            parts = " ".join(f"{k}={v:.4f}" for k, v in metrics.items())
+            lr_s = f" lr={lr:.2e}" if lr is not None else ""
+            ep_s = f"epoch {epoch} " if epoch is not None else ""
+            print(f"[{ts}] {self.name} {ep_s}step {step}: {parts}{lr_s}", flush=True)
+
+    def end_epoch(self, epoch: int, extra: Optional[dict] = None) -> dict:
+        elapsed = max(time.time() - self._epoch_start, 1e-9)
+        summary = {k: m.avg for k, m in self._epoch_meters.items()}
+        if extra:
+            summary.update({k: float(v) for k, v in extra.items()})
+        if self._epoch_examples:
+            summary["examples_per_sec"] = self._epoch_examples / elapsed
+        summary["epoch_time_s"] = elapsed
+        for k, v in summary.items():
+            self.history[k].append((epoch, v))
+            if self.tb is not None:
+                self.tb.scalar(f"{self.name}/epoch_{k}", v, epoch)
+        ts = datetime.datetime.now().isoformat(timespec="seconds")
+        parts = " ".join(f"{k}={v:.4f}" for k, v in summary.items())
+        print(f"[{ts}] {self.name} epoch {epoch} done: {parts}", flush=True)
+        return summary
+
+    # -- persistence (goes into the checkpoint sidecar) --------------------
+    def state_dict(self) -> dict:
+        return {"history": {k: v for k, v in self.history.items()}}
+
+    def load_state_dict(self, d: dict):
+        self.history = collections.defaultdict(list)
+        for k, v in d.get("history", {}).items():
+            self.history[k] = [tuple(x) for x in v]

@@ -1,0 +1,78 @@
+"""The single training-state pytree shared by every model in the zoo.
+
+Replaces the reference's four ad-hoc checkpoint payloads (torch dict at
+ResNet/pytorch/train.py:417-428, Keras hdf5 at ResNet/tensorflow/train.py:65-78,
+save_weights at YOLO/tensorflow/train.py:243-257, tf.train.Checkpoint at
+CycleGAN/tensorflow/train.py:133-148) with one functional state:
+
+    {step, params, batch_stats, opt_state, rng}
+
+Everything is a pytree, so pjit shards it, optax updates it, and orbax
+checkpoints it without model-specific code.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+import optax
+
+
+@flax.struct.dataclass
+class TrainState:
+    step: jnp.ndarray  # scalar int32
+    params: Any
+    batch_stats: Any  # BN running stats ({} for stat-less models)
+    opt_state: Any
+    rng: jax.Array  # per-step dropout/augment key
+
+    apply_fn: Callable = flax.struct.field(pytree_node=False)
+    tx: optax.GradientTransformation = flax.struct.field(pytree_node=False)
+
+    def apply_gradients(self, grads) -> "TrainState":
+        updates, new_opt_state = self.tx.update(grads, self.opt_state, self.params)
+        new_params = optax.apply_updates(self.params, updates)
+        return self.replace(
+            step=self.step + 1,
+            params=new_params,
+            opt_state=new_opt_state,
+        )
+
+    @property
+    def variables(self):
+        v = {"params": self.params}
+        if self.batch_stats:
+            v["batch_stats"] = self.batch_stats
+        return v
+
+
+def create_train_state(
+    model,
+    tx: optax.GradientTransformation,
+    sample_input,
+    rng: Optional[jax.Array] = None,
+    init_kwargs: Optional[dict] = None,
+) -> TrainState:
+    """Initialize params on host, build optimizer state, return TrainState.
+
+    `sample_input` may be an array or a tuple of arrays fed to `model.init`.
+    """
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    init_rng, state_rng = jax.random.split(rng)
+    args = sample_input if isinstance(sample_input, tuple) else (sample_input,)
+    variables = model.init(
+        {"params": init_rng, "dropout": init_rng}, *args, **(init_kwargs or {})
+    )
+    params = variables["params"]
+    batch_stats = variables.get("batch_stats", {})
+    return TrainState(
+        step=jnp.zeros((), jnp.int32),
+        params=params,
+        batch_stats=batch_stats,
+        opt_state=tx.init(params),
+        rng=state_rng,
+        apply_fn=model.apply,
+        tx=tx,
+    )

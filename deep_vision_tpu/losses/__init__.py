@@ -1,0 +1,4 @@
+from deep_vision_tpu.losses.classification import (
+    cross_entropy_loss,
+    classification_loss_fn,
+)

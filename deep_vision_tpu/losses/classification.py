@@ -1,0 +1,59 @@
+"""Classification losses: softmax CE (+ label smoothing) with aux-head support.
+
+Replaces `nn.CrossEntropyLoss` (ResNet/pytorch/train.py:358) and Keras
+`categorical_crossentropy` (ResNet/tensorflow/train.py:275-297), and fixes the
+Inception aux-head plumbing the reference broke (SURVEY.md §2.9): a model may
+return `logits` or a tuple `(logits, *aux_logits)`; aux heads are weighted
+0.3 as in the GoogLeNet paper.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+import optax
+
+from deep_vision_tpu.core.metrics import topk_accuracy
+
+
+def cross_entropy_loss(logits, labels, label_smoothing: float = 0.0, weights=None):
+    """Mean softmax cross entropy; labels are int class ids. `weights` (B,)
+    masks padded rows of the final partial batch."""
+    num_classes = logits.shape[-1]
+    onehot = jnp.asarray(
+        optax.smooth_labels(
+            jnp.eye(num_classes, dtype=jnp.float32)[labels], label_smoothing
+        )
+    )
+    ce = optax.softmax_cross_entropy(logits, onehot)
+    if weights is None:
+        return jnp.mean(ce)
+    return jnp.sum(ce * weights) / jnp.maximum(jnp.sum(weights), 1e-9)
+
+
+def classification_loss_fn(
+    outputs,
+    batch,
+    aux_weight: float = 0.3,
+    label_smoothing: float = 0.0,
+):
+    """loss + metrics from model outputs (logits or (logits, *aux)) + batch.
+
+    batch: {'image': ..., 'label': int (B,)}.
+    """
+    labels = batch["label"]
+    weights = batch.get("_mask")
+    aux_logits = ()
+    if isinstance(outputs, (tuple, list)):
+        logits, *aux_logits = outputs
+    else:
+        logits = outputs
+    loss = cross_entropy_loss(logits, labels, label_smoothing, weights)
+    for aux in aux_logits:
+        if aux is not None:
+            loss = loss + aux_weight * cross_entropy_loss(
+                aux, labels, label_smoothing, weights
+            )
+    metrics = {"loss": loss}
+    metrics.update(topk_accuracy(logits, labels, weights=weights))
+    return loss, metrics

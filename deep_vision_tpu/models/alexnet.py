@@ -1,0 +1,78 @@
+"""AlexNet V1 (Krizhevsky 2012) and V2 ("one weird trick", 2014).
+
+Parity targets: AlexNet/pytorch/models/alexnet_v1.py:33-89 (one-tower
+original with LocalResponseNorm after conv1/conv2) and alexnet_v2.py:12-40
+(single-column simplification, no LRN); Keras twin
+AlexNet/tensorflow/models/alexnet_v2.py. 227x227x3 (v1) / 224x224x3 (v2)
+inputs, 1000-way logits, dropout 0.5 in the classifier.
+"""
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from deep_vision_tpu.models import register_model
+from deep_vision_tpu.nn.layers import LocalResponseNorm
+
+
+class AlexNetV1(nn.Module):
+    num_classes: int = 1000
+    dropout: float = 0.5
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = nn.Conv(96, (11, 11), strides=(4, 4), padding="VALID")(x)
+        x = nn.relu(x)
+        x = LocalResponseNorm()(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = nn.Conv(256, (5, 5), padding=[(2, 2), (2, 2)])(x)
+        x = nn.relu(x)
+        x = LocalResponseNorm()(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = nn.Conv(384, (3, 3), padding="SAME")(x)
+        x = nn.relu(x)
+        x = nn.Conv(384, (3, 3), padding="SAME")(x)
+        x = nn.relu(x)
+        x = nn.Conv(256, (3, 3), padding="SAME")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dropout(self.dropout, deterministic=not train)(x)
+        x = nn.relu(nn.Dense(4096)(x))
+        x = nn.Dropout(self.dropout, deterministic=not train)(x)
+        x = nn.relu(nn.Dense(4096)(x))
+        return nn.Dense(self.num_classes)(x)
+
+
+class AlexNetV2(nn.Module):
+    num_classes: int = 1000
+    dropout: float = 0.5
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = nn.Conv(64, (11, 11), strides=(4, 4), padding=[(2, 2), (2, 2)])(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = nn.Conv(192, (5, 5), padding=[(2, 2), (2, 2)])(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = nn.relu(nn.Conv(384, (3, 3), padding="SAME")(x))
+        x = nn.relu(nn.Conv(256, (3, 3), padding="SAME")(x))
+        x = nn.relu(nn.Conv(256, (3, 3), padding="SAME")(x))
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dropout(self.dropout, deterministic=not train)(x)
+        x = nn.relu(nn.Dense(4096)(x))
+        x = nn.Dropout(self.dropout, deterministic=not train)(x)
+        x = nn.relu(nn.Dense(4096)(x))
+        return nn.Dense(self.num_classes)(x)
+
+
+@register_model("alexnet1")
+def alexnet_v1(num_classes: int = 1000, **_):
+    return AlexNetV1(num_classes=num_classes)
+
+
+@register_model("alexnet2")
+def alexnet_v2(num_classes: int = 1000, **_):
+    return AlexNetV2(num_classes=num_classes)

@@ -1,0 +1,101 @@
+"""ObjectsAsPoints / CenterNet (Zhou 2019): hourglass backbone + center
+heatmap / size / offset heads.
+
+Parity target: ObjectsAsPoints/tensorflow/model.py — HourglassModule with a
+per-order filter table (:17-32,94-127), DetectionHead producing
+(class-heatmap, wh, offset) (:81-91), 2-stack default (:130-179). The
+reference's trainer and losses were never finished (train.py:35,248 —
+SURVEY.md §2.9); the complete focal+L1 loss lives in losses/centernet.py.
+
+Head convention per stack: dict with
+  'heatmap': (B, H/4, W/4, num_classes)  raw logits (sigmoid in loss/decode)
+  'wh':      (B, H/4, W/4, 2)
+  'offset':  (B, H/4, W/4, 2)
+"""
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from deep_vision_tpu.models import register_model
+from deep_vision_tpu.models.hourglass import HgBottleneck
+
+# per-depth channel table, model.py:17-32 flavor
+_CURR_DIMS = (256, 256, 384, 384, 384, 512)
+
+
+class CenterHourglassModule(nn.Module):
+    order: int  # 5 at the top
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        curr = _CURR_DIMS[5 - self.order]
+        nxt = _CURR_DIMS[5 - self.order + 1]
+        up = HgBottleneck(curr)(x, train)
+        up = HgBottleneck(curr)(up, train)
+        low = nn.max_pool(x, (2, 2), strides=(2, 2))
+        low = HgBottleneck(nxt)(low, train)
+        low = HgBottleneck(nxt)(low, train)
+        if self.order > 1:
+            low = CenterHourglassModule(self.order - 1)(low, train)
+        else:
+            low = HgBottleneck(nxt)(low, train)
+        low = HgBottleneck(curr)(low, train)
+        low = HgBottleneck(curr)(low, train)
+        low = jnp.repeat(jnp.repeat(low, 2, axis=1), 2, axis=2)
+        return up + low
+
+
+class DetectionHead(nn.Module):
+    """3x3 conv + 1x1 per output branch (model.py:81-91)."""
+
+    num_classes: int
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        def branch(ch, bias_init=0.0):
+            y = nn.Conv(256, (3, 3))(x)
+            y = nn.relu(y)
+            return nn.Conv(
+                ch, (1, 1), bias_init=nn.initializers.constant(bias_init)
+            )(y)
+
+        # heatmap bias init -2.19 = -log((1-0.1)/0.1): focal-loss prior
+        return {
+            "heatmap": branch(self.num_classes, bias_init=-2.19),
+            "wh": branch(2),
+            "offset": branch(2),
+        }
+
+
+class ObjectsAsPoints(nn.Module):
+    """Returns a list of per-stack head dicts (intermediate supervision)."""
+
+    num_classes: int = 20
+    num_stack: int = 2
+    features: int = 256
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        # stem: /4 resolution (model.py:130-140)
+        x = nn.Conv(128, (7, 7), strides=(2, 2), use_bias=False)(x)
+        x = nn.relu(nn.BatchNorm(use_running_average=not train, momentum=0.9)(x))
+        x = HgBottleneck(self.features)(x, train)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = HgBottleneck(self.features)(x, train)
+
+        outputs = []
+        for stack in range(self.num_stack):
+            inter = CenterHourglassModule(5)(x, train)
+            inter = HgBottleneck(self.features)(inter, train)
+            outputs.append(DetectionHead(self.num_classes)(inter, train))
+            if stack < self.num_stack - 1:
+                x = x + nn.Conv(self.features, (1, 1), use_bias=False)(inter)
+        return outputs
+
+
+@register_model("objects_as_points")
+def objects_as_points(num_classes: int = 20, num_stack: int = 2, **_):
+    return ObjectsAsPoints(num_classes=num_classes, num_stack=num_stack)

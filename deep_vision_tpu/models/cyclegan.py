@@ -1,0 +1,109 @@
+"""CycleGAN (Zhu 2017): ResNet generator + PatchGAN discriminator.
+
+Parity targets: CycleGAN/tensorflow/models.py — generator with ReflectionPad
++ 9 ResNet blocks + two up/down sampling stages (:8-78), 70x70 PatchGAN
+discriminator (:81-104). Instance norm per the paper (the reference uses BN;
+we default to instance norm which is the published recipe, with `use_in=False`
+to reproduce the reference exactly).
+"""
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from deep_vision_tpu.models import register_model
+
+_INIT = nn.initializers.normal(0.02)
+
+
+def reflect_pad(x, pad: int):
+    return jnp.pad(x, [(0, 0), (pad, pad), (pad, pad), (0, 0)], mode="reflect")
+
+
+class _Norm(nn.Module):
+    use_in: bool = True  # instance norm (paper) vs batch norm (reference)
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        if self.use_in:
+            # instance norm: per-sample, per-channel spatial normalization
+            mean = jnp.mean(x, axis=(1, 2), keepdims=True)
+            var = jnp.var(x, axis=(1, 2), keepdims=True)
+            x = (x - mean) / jnp.sqrt(var + 1e-5)
+            scale = self.param("scale", nn.initializers.ones, (x.shape[-1],))
+            bias = self.param("bias", nn.initializers.zeros, (x.shape[-1],))
+            return x * scale + bias
+        return nn.BatchNorm(use_running_average=not train, momentum=0.9)(x)
+
+
+class ResNetBlock(nn.Module):
+    features: int
+    use_in: bool = True
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        y = reflect_pad(x, 1)
+        y = nn.Conv(self.features, (3, 3), padding="VALID", kernel_init=_INIT)(y)
+        y = _Norm(self.use_in)(y, train)
+        y = nn.relu(y)
+        y = reflect_pad(y, 1)
+        y = nn.Conv(self.features, (3, 3), padding="VALID", kernel_init=_INIT)(y)
+        y = _Norm(self.use_in)(y, train)
+        return x + y
+
+
+class CycleGanGenerator(nn.Module):
+    n_blocks: int = 9
+    base: int = 64
+    use_in: bool = True
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = reflect_pad(x, 3)
+        x = nn.Conv(self.base, (7, 7), padding="VALID", kernel_init=_INIT)(x)
+        x = nn.relu(_Norm(self.use_in)(x, train))
+        for mult in (2, 4):  # downsample
+            x = nn.Conv(self.base * mult, (3, 3), strides=(2, 2), padding="SAME",
+                        kernel_init=_INIT)(x)
+            x = nn.relu(_Norm(self.use_in)(x, train))
+        for _ in range(self.n_blocks):
+            x = ResNetBlock(self.base * 4, self.use_in)(x, train)
+        for mult in (2, 1):  # upsample
+            x = nn.ConvTranspose(self.base * mult, (3, 3), strides=(2, 2),
+                                 padding="SAME", kernel_init=_INIT)(x)
+            x = nn.relu(_Norm(self.use_in)(x, train))
+        x = reflect_pad(x, 3)
+        x = nn.Conv(3, (7, 7), padding="VALID", kernel_init=_INIT)(x)
+        return nn.tanh(x)
+
+
+class PatchGanDiscriminator(nn.Module):
+    """70x70 PatchGAN: 4 strided convs -> 1-channel patch logits."""
+
+    base: int = 64
+    use_in: bool = True
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = nn.Conv(self.base, (4, 4), strides=(2, 2), padding="SAME",
+                    kernel_init=_INIT)(x)
+        x = nn.leaky_relu(x, 0.2)
+        for mult in (2, 4):
+            x = nn.Conv(self.base * mult, (4, 4), strides=(2, 2), padding="SAME",
+                        kernel_init=_INIT)(x)
+            x = nn.leaky_relu(_Norm(self.use_in)(x, train), 0.2)
+        x = nn.Conv(self.base * 8, (4, 4), strides=(1, 1), padding="SAME",
+                    kernel_init=_INIT)(x)
+        x = nn.leaky_relu(_Norm(self.use_in)(x, train), 0.2)
+        return nn.Conv(1, (4, 4), strides=(1, 1), padding="SAME",
+                       kernel_init=_INIT)(x)
+
+
+@register_model("cyclegan_generator")
+def cyclegan_generator(n_blocks: int = 9, **kw):
+    return CycleGanGenerator(n_blocks=n_blocks, **kw)
+
+
+@register_model("cyclegan_discriminator")
+def cyclegan_discriminator(**kw):
+    return PatchGanDiscriminator(**kw)

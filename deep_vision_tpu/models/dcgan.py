@@ -1,0 +1,59 @@
+"""DCGAN (Radford 2015) for MNIST 28x28.
+
+Parity targets: DCGAN/tensorflow/models.py — generator Dense(7*7*256) ->
+ConvTranspose stack to 28x28x1 tanh (:30-65), discriminator two strided convs
++ dropout -> 1 logit (:8-27). Normal(0.02) init per the paper.
+"""
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from deep_vision_tpu.models import register_model
+
+_INIT = nn.initializers.normal(0.02)
+
+
+class Generator(nn.Module):
+    latent_dim: int = 100
+
+    @nn.compact
+    def __call__(self, z, train: bool = True):
+        x = nn.Dense(7 * 7 * 256, use_bias=False, kernel_init=_INIT)(z)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9)(x)
+        x = nn.leaky_relu(x, 0.2)
+        x = x.reshape((-1, 7, 7, 256))
+        x = nn.ConvTranspose(128, (5, 5), strides=(1, 1), padding="SAME",
+                             use_bias=False, kernel_init=_INIT)(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9)(x)
+        x = nn.leaky_relu(x, 0.2)
+        x = nn.ConvTranspose(64, (5, 5), strides=(2, 2), padding="SAME",
+                             use_bias=False, kernel_init=_INIT)(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9)(x)
+        x = nn.leaky_relu(x, 0.2)
+        x = nn.ConvTranspose(1, (5, 5), strides=(2, 2), padding="SAME",
+                             use_bias=False, kernel_init=_INIT)(x)
+        return nn.tanh(x)
+
+
+class Discriminator(nn.Module):
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = nn.Conv(64, (5, 5), strides=(2, 2), padding="SAME", kernel_init=_INIT)(x)
+        x = nn.leaky_relu(x, 0.2)
+        x = nn.Dropout(0.3, deterministic=not train)(x)
+        x = nn.Conv(128, (5, 5), strides=(2, 2), padding="SAME", kernel_init=_INIT)(x)
+        x = nn.leaky_relu(x, 0.2)
+        x = nn.Dropout(0.3, deterministic=not train)(x)
+        x = x.reshape((x.shape[0], -1))
+        return nn.Dense(1, kernel_init=_INIT)(x)
+
+
+@register_model("dcgan_generator")
+def dcgan_generator(latent_dim: int = 100, **_):
+    return Generator(latent_dim=latent_dim)
+
+
+@register_model("dcgan_discriminator")
+def dcgan_discriminator(**_):
+    return Discriminator()

@@ -1,0 +1,114 @@
+"""Stacked Hourglass network for pose estimation (Newell 2016).
+
+Parity target: Hourglass/tensorflow/hourglass104.py — BottleneckBlock (:19-67),
+recursive HourglassModule (:70-98), StackedHourglassNetwork with intermediate
+supervision: one heatmap head per stack plus re-injection of the head output
+into the next stack's input (:113-159). Default 4 stacks, 16 MPII keypoints,
+256x256 input -> 64x64x16 heatmaps per stack.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from deep_vision_tpu.models import register_model
+
+
+class HgBottleneck(nn.Module):
+    """Pre-activation bottleneck used throughout the hourglass."""
+
+    features: int  # output channels
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        def bn_relu(y):
+            y = nn.BatchNorm(use_running_average=not train, momentum=0.9)(y)
+            return nn.relu(y)
+
+        residual = x
+        y = bn_relu(x)
+        y = nn.Conv(self.features // 2, (1, 1), use_bias=False)(y)
+        y = bn_relu(y)
+        y = nn.Conv(self.features // 2, (3, 3), use_bias=False)(y)
+        y = bn_relu(y)
+        y = nn.Conv(self.features, (1, 1), use_bias=False)(y)
+        if residual.shape[-1] != self.features:
+            residual = nn.Conv(self.features, (1, 1), use_bias=False)(x)
+        return y + residual
+
+
+class HourglassModule(nn.Module):
+    """Recursive down-up module of `order` levels (hourglass104.py:70-98)."""
+
+    order: int
+    features: int = 256
+    num_residual: int = 1
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        # upper (skip) branch at current resolution
+        up = x
+        for _ in range(self.num_residual):
+            up = HgBottleneck(self.features)(up, train)
+        # lower branch: pool -> recurse -> upsample
+        low = nn.max_pool(x, (2, 2), strides=(2, 2))
+        for _ in range(self.num_residual):
+            low = HgBottleneck(self.features)(low, train)
+        if self.order > 1:
+            low = HourglassModule(self.order - 1, self.features, self.num_residual)(
+                low, train
+            )
+        else:
+            for _ in range(self.num_residual):
+                low = HgBottleneck(self.features)(low, train)
+        for _ in range(self.num_residual):
+            low = HgBottleneck(self.features)(low, train)
+        b, h, w, c = low.shape
+        low = jnp.repeat(jnp.repeat(low, 2, axis=1), 2, axis=2)  # nearest 2x
+        return up + low
+
+
+class StackedHourglass(nn.Module):
+    """Returns a list of per-stack heatmaps [(B, 64, 64, K)] * num_stack."""
+
+    num_stack: int = 4
+    num_heatmap: int = 16
+    features: int = 256
+    num_residual: int = 1
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        # stem: 256x256 -> 64x64 (hourglass104.py:120-128)
+        x = nn.Conv(64, (7, 7), strides=(2, 2), use_bias=False)(x)
+        x = nn.relu(nn.BatchNorm(use_running_average=not train, momentum=0.9)(x))
+        x = HgBottleneck(128)(x, train)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = HgBottleneck(128)(x, train)
+        x = HgBottleneck(self.features)(x, train)
+
+        heatmaps = []
+        for stack in range(self.num_stack):
+            inter = HourglassModule(4, self.features, self.num_residual)(x, train)
+            inter = HgBottleneck(self.features)(inter, train)
+            inter = nn.Conv(self.features, (1, 1), use_bias=False)(inter)
+            inter = nn.relu(
+                nn.BatchNorm(use_running_average=not train, momentum=0.9)(inter)
+            )
+            hm = nn.Conv(self.num_heatmap, (1, 1))(inter)
+            heatmaps.append(hm)
+            # re-inject head output + features into the next stack (:144-157);
+            # the last stack has no successor, so no re-injection params
+            if stack < self.num_stack - 1:
+                x = (
+                    x
+                    + nn.Conv(self.features, (1, 1), use_bias=False)(inter)
+                    + nn.Conv(self.features, (1, 1), use_bias=False)(hm)
+                )
+        return heatmaps
+
+
+@register_model("hourglass")
+def hourglass(num_stack: int = 4, num_heatmap: int = 16, **_):
+    return StackedHourglass(num_stack=num_stack, num_heatmap=num_heatmap)

@@ -1,0 +1,250 @@
+"""Inception V1 / GoogLeNet (Szegedy 2014) and Inception V3 (Szegedy 2015).
+
+Parity targets: Inception/pytorch/models/inception_v1.py (InceptionModule,
+two AuxiliaryClassifier heads active only in training, Xavier init at
+inception_v1.py:116-124). The reference's V3 is a 6-line stub
+(inception_v3.py, SURVEY.md §2.9) — ours is a real implementation from the
+paper (factorized 7x7, grid-reduction blocks, aux head, label-smoothing
+handled in the loss).
+
+Training-mode output is `(logits, aux1_logits, aux2_logits)`; the trainer's
+loss plumbing (losses/classification.py) weights aux heads by 0.3 as in the
+paper — fixing the incompatibility the reference shipped (SURVEY.md §2.9,
+inception_v1.py:112-114 vs train.py:449-452).
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from deep_vision_tpu.models import register_model
+from deep_vision_tpu.nn.layers import global_avg_pool
+
+_XAVIER = nn.initializers.xavier_normal()
+
+
+class BasicConv(nn.Module):
+    """Conv + BN + ReLU with xavier init (BasicConv2d, inception_v1.py)."""
+
+    features: int
+    kernel: Tuple[int, int]
+    strides: Tuple[int, int] = (1, 1)
+    padding: str = "SAME"
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = nn.Conv(self.features, self.kernel, strides=self.strides,
+                    padding=self.padding, use_bias=False, kernel_init=_XAVIER)(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9)(x)
+        return nn.relu(x)
+
+
+class InceptionModule(nn.Module):
+    """4-branch module (1x1 / 1x1-3x3 / 1x1-5x5 / pool-1x1)."""
+
+    c1: int
+    c3r: int
+    c3: int
+    c5r: int
+    c5: int
+    cp: int
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        b1 = BasicConv(self.c1, (1, 1))(x, train)
+        b2 = BasicConv(self.c3r, (1, 1))(x, train)
+        b2 = BasicConv(self.c3, (3, 3))(b2, train)
+        b3 = BasicConv(self.c5r, (1, 1))(x, train)
+        b3 = BasicConv(self.c5, (5, 5))(b3, train)
+        b4 = nn.max_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+        b4 = BasicConv(self.cp, (1, 1))(b4, train)
+        return jnp.concatenate([b1, b2, b3, b4], axis=-1)
+
+
+class AuxClassifier(nn.Module):
+    num_classes: int
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = nn.avg_pool(x, (5, 5), strides=(3, 3))
+        x = BasicConv(128, (1, 1))(x, train)
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(1024, kernel_init=_XAVIER)(x))
+        x = nn.Dropout(0.7, deterministic=not train)(x)
+        return nn.Dense(self.num_classes, kernel_init=_XAVIER)(x)
+
+
+class InceptionV1(nn.Module):
+    num_classes: int = 1000
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = BasicConv(64, (7, 7), strides=(2, 2))(x, train)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        x = BasicConv(64, (1, 1))(x, train)
+        x = BasicConv(192, (3, 3))(x, train)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        x = InceptionModule(64, 96, 128, 16, 32, 32)(x, train)    # 3a
+        x = InceptionModule(128, 128, 192, 32, 96, 64)(x, train)  # 3b
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        x = InceptionModule(192, 96, 208, 16, 48, 64)(x, train)   # 4a
+        aux1 = AuxClassifier(self.num_classes)(x, train) if train else None
+        x = InceptionModule(160, 112, 224, 24, 64, 64)(x, train)  # 4b
+        x = InceptionModule(128, 128, 256, 24, 64, 64)(x, train)  # 4c
+        x = InceptionModule(112, 144, 288, 32, 64, 64)(x, train)  # 4d
+        aux2 = AuxClassifier(self.num_classes)(x, train) if train else None
+        x = InceptionModule(256, 160, 320, 32, 128, 128)(x, train)  # 4e
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        x = InceptionModule(256, 160, 320, 32, 128, 128)(x, train)  # 5a
+        x = InceptionModule(384, 192, 384, 48, 128, 128)(x, train)  # 5b
+        x = global_avg_pool(x)
+        x = nn.Dropout(0.4, deterministic=not train)(x)
+        logits = nn.Dense(self.num_classes, kernel_init=_XAVIER)(x)
+        if train:
+            return logits, aux1, aux2
+        return logits
+
+
+# ---------------------------------------------------------------------------
+# Inception V3 (from the paper; reference stub only)
+# ---------------------------------------------------------------------------
+
+
+class InceptionA(nn.Module):
+    pool_features: int
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        b1 = BasicConv(64, (1, 1))(x, train)
+        b2 = BasicConv(48, (1, 1))(x, train)
+        b2 = BasicConv(64, (5, 5))(b2, train)
+        b3 = BasicConv(64, (1, 1))(x, train)
+        b3 = BasicConv(96, (3, 3))(b3, train)
+        b3 = BasicConv(96, (3, 3))(b3, train)
+        b4 = nn.avg_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+        b4 = BasicConv(self.pool_features, (1, 1))(b4, train)
+        return jnp.concatenate([b1, b2, b3, b4], axis=-1)
+
+
+class ReductionA(nn.Module):
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        b1 = BasicConv(384, (3, 3), strides=(2, 2), padding="VALID")(x, train)
+        b2 = BasicConv(64, (1, 1))(x, train)
+        b2 = BasicConv(96, (3, 3))(b2, train)
+        b2 = BasicConv(96, (3, 3), strides=(2, 2), padding="VALID")(b2, train)
+        b3 = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        return jnp.concatenate([b1, b2, b3], axis=-1)
+
+
+class InceptionB(nn.Module):
+    """Factorized 7x7 module."""
+
+    c7: int
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        b1 = BasicConv(192, (1, 1))(x, train)
+        b2 = BasicConv(self.c7, (1, 1))(x, train)
+        b2 = BasicConv(self.c7, (1, 7))(b2, train)
+        b2 = BasicConv(192, (7, 1))(b2, train)
+        b3 = BasicConv(self.c7, (1, 1))(x, train)
+        b3 = BasicConv(self.c7, (7, 1))(b3, train)
+        b3 = BasicConv(self.c7, (1, 7))(b3, train)
+        b3 = BasicConv(self.c7, (7, 1))(b3, train)
+        b3 = BasicConv(192, (1, 7))(b3, train)
+        b4 = nn.avg_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+        b4 = BasicConv(192, (1, 1))(b4, train)
+        return jnp.concatenate([b1, b2, b3, b4], axis=-1)
+
+
+class ReductionB(nn.Module):
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        b1 = BasicConv(192, (1, 1))(x, train)
+        b1 = BasicConv(320, (3, 3), strides=(2, 2), padding="VALID")(b1, train)
+        b2 = BasicConv(192, (1, 1))(x, train)
+        b2 = BasicConv(192, (1, 7))(b2, train)
+        b2 = BasicConv(192, (7, 1))(b2, train)
+        b2 = BasicConv(192, (3, 3), strides=(2, 2), padding="VALID")(b2, train)
+        b3 = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        return jnp.concatenate([b1, b2, b3], axis=-1)
+
+
+class InceptionC(nn.Module):
+    """Expanded-filter-bank output module."""
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        b1 = BasicConv(320, (1, 1))(x, train)
+        b2 = BasicConv(384, (1, 1))(x, train)
+        b2 = jnp.concatenate(
+            [BasicConv(384, (1, 3))(b2, train), BasicConv(384, (3, 1))(b2, train)],
+            axis=-1,
+        )
+        b3 = BasicConv(448, (1, 1))(x, train)
+        b3 = BasicConv(384, (3, 3))(b3, train)
+        b3 = jnp.concatenate(
+            [BasicConv(384, (1, 3))(b3, train), BasicConv(384, (3, 1))(b3, train)],
+            axis=-1,
+        )
+        b4 = nn.avg_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+        b4 = BasicConv(192, (1, 1))(b4, train)
+        return jnp.concatenate([b1, b2, b3, b4], axis=-1)
+
+
+class InceptionV3Aux(nn.Module):
+    num_classes: int
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = nn.avg_pool(x, (5, 5), strides=(3, 3))
+        x = BasicConv(128, (1, 1))(x, train)
+        x = BasicConv(768, x.shape[1:3], padding="VALID")(x, train)
+        x = x.reshape((x.shape[0], -1))
+        return nn.Dense(self.num_classes, kernel_init=_XAVIER)(x)
+
+
+class InceptionV3(nn.Module):
+    num_classes: int = 1000
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        # x: (B, 299, 299, 3)
+        x = BasicConv(32, (3, 3), strides=(2, 2), padding="VALID")(x, train)
+        x = BasicConv(32, (3, 3), padding="VALID")(x, train)
+        x = BasicConv(64, (3, 3))(x, train)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = BasicConv(80, (1, 1))(x, train)
+        x = BasicConv(192, (3, 3), padding="VALID")(x, train)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = InceptionA(32)(x, train)
+        x = InceptionA(64)(x, train)
+        x = InceptionA(64)(x, train)
+        x = ReductionA()(x, train)
+        x = InceptionB(128)(x, train)
+        x = InceptionB(160)(x, train)
+        x = InceptionB(160)(x, train)
+        x = InceptionB(192)(x, train)
+        aux = InceptionV3Aux(self.num_classes)(x, train) if train else None
+        x = ReductionB()(x, train)
+        x = InceptionC()(x, train)
+        x = InceptionC()(x, train)
+        x = global_avg_pool(x)
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        logits = nn.Dense(self.num_classes, kernel_init=_XAVIER)(x)
+        if train:
+            return logits, aux
+        return logits
+
+
+@register_model("inception1")
+def inception_v1(num_classes: int = 1000, **_):
+    return InceptionV1(num_classes=num_classes)
+
+
+@register_model("inception3")
+def inception_v3(num_classes: int = 1000, **_):
+    return InceptionV3(num_classes=num_classes)

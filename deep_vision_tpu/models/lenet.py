@@ -1,0 +1,38 @@
+"""LeNet-5 (LeCun 1998).
+
+Parity target: LeNet/pytorch/models/lenet5.py (tanh activations, average
+pooling, 32x32x1 input, C1=6/C3=16/C5=120 convs, F6=84 dense, 10-way head;
+lenet5.py:24-57) and the Keras twin LeNet/tensorflow/models/lenet5.py:7-34.
+NHWC, logits output (softmax lives in the loss).
+"""
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from deep_vision_tpu.models import register_model
+
+
+class LeNet5(nn.Module):
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        # x: (B, 32, 32, 1)
+        x = nn.Conv(6, (5, 5), padding="VALID")(x)
+        x = nn.tanh(x)
+        x = nn.avg_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Conv(16, (5, 5), padding="VALID")(x)
+        x = nn.tanh(x)
+        x = nn.avg_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Conv(120, (5, 5), padding="VALID")(x)
+        x = nn.tanh(x)
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(84)(x)
+        x = nn.tanh(x)
+        return nn.Dense(self.num_classes)(x)
+
+
+@register_model("lenet5")
+def lenet5(num_classes: int = 10, **_):
+    return LeNet5(num_classes=num_classes)

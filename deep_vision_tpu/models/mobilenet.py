@@ -1,0 +1,56 @@
+"""MobileNet V1 (Howard 2017) with width multiplier alpha.
+
+Parity targets: MobileNet/pytorch/models/mobilenet_v1.py (DepthwiseSeparableConv
+stack, alpha at mobilenet_v1.py:17, depthwise via groups=in_channels at
+:109-122) and the Keras twin MobileNet/tensorflow/models/mobilenet_v1.py:7-26.
+Depthwise lowers to lax.conv_general_dilated with feature_group_count — the
+TPU-native grouped conv.
+"""
+from __future__ import annotations
+
+import flax.linen as nn
+
+from deep_vision_tpu.models import register_model
+from deep_vision_tpu.nn.layers import ConvBN, DepthwiseSeparableConv, global_avg_pool
+
+# (features, stride) after the stem; features are pre-alpha
+_CFG = [
+    (64, 1),
+    (128, 2),
+    (128, 1),
+    (256, 2),
+    (256, 1),
+    (512, 2),
+    (512, 1),
+    (512, 1),
+    (512, 1),
+    (512, 1),
+    (512, 1),
+    (1024, 2),
+    (1024, 1),
+]
+
+
+class MobileNetV1(nn.Module):
+    num_classes: int = 1000
+    alpha: float = 1.0
+    dropout: float = 0.001  # keras MobileNet default; reference uses none (PT)
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        def scaled(ch):
+            return max(8, int(ch * self.alpha))
+
+        x = ConvBN(scaled(32), (3, 3), strides=(2, 2))(x, train)
+        for features, stride in _CFG:
+            x = DepthwiseSeparableConv(scaled(features), strides=(stride, stride))(
+                x, train
+            )
+        x = global_avg_pool(x)
+        x = nn.Dropout(self.dropout, deterministic=not train)(x)
+        return nn.Dense(self.num_classes)(x)
+
+
+@register_model("mobilenet1")
+def mobilenet_v1(num_classes: int = 1000, alpha: float = 1.0, **_):
+    return MobileNetV1(num_classes=num_classes, alpha=alpha)

@@ -1,0 +1,142 @@
+"""ResNet-34/50/152 (He 2015) and ResNet-50 V2 (pre-activation, He 2016).
+
+Parity targets: ResNet/pytorch/models/resnet50.py (BottleneckBlock +
+projection shortcut, Kaiming init at resnet50.py:84-93), resnet34.py (basic
+blocks), resnet152.py (3/8/36/3), and the pre-activation
+ResNet/tensorflow/models/resnet50v2.py:11-12. NHWC, he_normal init, BN with
+global-batch statistics under pjit (synced BN by construction).
+
+The flagship model of the framework: `resnet50` is the benchmark target
+(BASELINE.json: top-1 >= 75.3% on v5e-8 at >= 0.9x A100x8 images/sec).
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from deep_vision_tpu.models import register_model
+from deep_vision_tpu.nn.layers import ConvBN, global_avg_pool
+
+
+class BasicBlock(nn.Module):
+    features: int
+    strides: Tuple[int, int] = (1, 1)
+    dtype: Optional[jnp.dtype] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        residual = x
+        y = ConvBN(self.features, (3, 3), strides=self.strides, dtype=self.dtype)(x, train)
+        y = ConvBN(self.features, (3, 3), act=None, dtype=self.dtype)(y, train)
+        if residual.shape != y.shape:
+            residual = ConvBN(
+                self.features, (1, 1), strides=self.strides, act=None, dtype=self.dtype
+            )(x, train)
+        return nn.relu(y + residual)
+
+
+class BottleneckBlock(nn.Module):
+    features: int  # bottleneck width; output is 4x
+    strides: Tuple[int, int] = (1, 1)
+    dtype: Optional[jnp.dtype] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        residual = x
+        y = ConvBN(self.features, (1, 1), dtype=self.dtype)(x, train)
+        y = ConvBN(self.features, (3, 3), strides=self.strides, dtype=self.dtype)(y, train)
+        # zero-init the last BN scale so each block starts as identity
+        # (standard TPU ResNet recipe; improves large-batch training)
+        y = nn.Conv(self.features * 4, (1, 1), use_bias=False, dtype=self.dtype)(y)
+        y = nn.BatchNorm(
+            use_running_average=not train,
+            momentum=0.9,
+            scale_init=nn.initializers.zeros_init(),
+            dtype=self.dtype,
+        )(y)
+        if residual.shape != y.shape:
+            residual = ConvBN(
+                self.features * 4, (1, 1), strides=self.strides, act=None, dtype=self.dtype
+            )(x, train)
+        return nn.relu(y + residual)
+
+
+class PreActBottleneckBlock(nn.Module):
+    """ResNet V2: BN-ReLU-Conv ordering (resnet50v2.py cites He 2016)."""
+
+    features: int
+    strides: Tuple[int, int] = (1, 1)
+    dtype: Optional[jnp.dtype] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        pre = nn.BatchNorm(use_running_average=not train, momentum=0.9, dtype=self.dtype)(x)
+        pre = nn.relu(pre)
+        needs_proj = x.shape[-1] != self.features * 4 or self.strides != (1, 1)
+        residual = (
+            nn.Conv(self.features * 4, (1, 1), strides=self.strides, use_bias=False,
+                    dtype=self.dtype)(pre)
+            if needs_proj
+            else x
+        )
+        y = nn.Conv(self.features, (1, 1), use_bias=False, dtype=self.dtype)(pre)
+        y = ConvBN(self.features, (3, 3), strides=self.strides, dtype=self.dtype)(y, train)
+        y = nn.BatchNorm(use_running_average=not train, momentum=0.9, dtype=self.dtype)(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.features * 4, (1, 1), use_bias=False, dtype=self.dtype)(y)
+        return y + residual
+
+
+class ResNet(nn.Module):
+    stage_sizes: Sequence[int]
+    block: type = BottleneckBlock
+    num_classes: int = 1000
+    width: int = 64
+    preact: bool = False
+    dtype: Optional[jnp.dtype] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        if self.preact:
+            x = nn.Conv(64, (7, 7), strides=(2, 2), padding=[(3, 3), (3, 3)],
+                        use_bias=False, dtype=self.dtype)(x)
+        else:
+            x = ConvBN(64, (7, 7), strides=(2, 2), padding=[(3, 3), (3, 3)],
+                       dtype=self.dtype)(x, train)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=[(1, 1), (1, 1)])
+        for i, n_blocks in enumerate(self.stage_sizes):
+            features = self.width * (2**i)
+            for j in range(n_blocks):
+                strides = (2, 2) if i > 0 and j == 0 else (1, 1)
+                x = self.block(features, strides=strides, dtype=self.dtype)(x, train)
+        if self.preact:
+            x = nn.relu(nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                                     dtype=self.dtype)(x))
+        x = global_avg_pool(x)
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+
+
+@register_model("resnet34")
+def resnet34(num_classes: int = 1000, dtype=None, **_):
+    return ResNet(stage_sizes=(3, 4, 6, 3), block=BasicBlock,
+                  num_classes=num_classes, dtype=dtype)
+
+
+@register_model("resnet50")
+def resnet50(num_classes: int = 1000, dtype=None, **_):
+    return ResNet(stage_sizes=(3, 4, 6, 3), block=BottleneckBlock,
+                  num_classes=num_classes, dtype=dtype)
+
+
+@register_model("resnet152")
+def resnet152(num_classes: int = 1000, dtype=None, **_):
+    return ResNet(stage_sizes=(3, 8, 36, 3), block=BottleneckBlock,
+                  num_classes=num_classes, dtype=dtype)
+
+
+@register_model("resnet50v2")
+def resnet50v2(num_classes: int = 1000, dtype=None, **_):
+    return ResNet(stage_sizes=(3, 4, 6, 3), block=PreActBottleneckBlock,
+                  num_classes=num_classes, preact=True, dtype=dtype)

@@ -1,0 +1,69 @@
+"""ShuffleNet V1 (Zhang 2017): group conv + channel shuffle.
+
+The reference never implemented this — ShuffleNet/pytorch/models/shufflenet_v1.py
+is a 0-byte file and its train.py lacks the config (SURVEY.md §2.9) — so this
+is written from the paper (arch table 1, g=3 default, scale factor s).
+
+ShuffleNet unit: 1x1 group conv -> channel shuffle -> 3x3 depthwise ->
+1x1 group conv, with an avg-pool + concat shortcut for stride-2 units.
+"""
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from deep_vision_tpu.models import register_model
+from deep_vision_tpu.nn.layers import ConvBN, channel_shuffle, global_avg_pool
+
+# output channels per stage for each group count (paper table 1)
+_STAGE_CH = {1: (144, 288, 576), 2: (200, 400, 800), 3: (240, 480, 960),
+             4: (272, 544, 1088), 8: (384, 768, 1536)}
+_STAGE_REPEATS = (4, 8, 4)
+
+
+class ShuffleUnit(nn.Module):
+    features: int
+    groups: int
+    stride: int = 1
+    first_stage: bool = False  # no group conv on the very first 1x1 (paper sec 3.2)
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        in_ch = x.shape[-1]
+        bottleneck = self.features // 4
+        out_ch = self.features - in_ch if self.stride == 2 else self.features
+        g = 1 if self.first_stage else self.groups
+
+        y = ConvBN(bottleneck, (1, 1), groups=g)(x, train)
+        y = channel_shuffle(y, g) if g > 1 else y
+        y = ConvBN(bottleneck, (3, 3), strides=(self.stride, self.stride),
+                   groups=bottleneck, act=None)(y, train)
+        y = ConvBN(out_ch, (1, 1), groups=self.groups, act=None)(y, train)
+        if self.stride == 2:
+            shortcut = nn.avg_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+            return nn.relu(jnp.concatenate([shortcut, y], axis=-1))
+        return nn.relu(x + y)
+
+
+class ShuffleNetV1(nn.Module):
+    num_classes: int = 1000
+    groups: int = 3
+    scale: float = 1.0
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        stage_ch = [max(8, int(c * self.scale)) for c in _STAGE_CH[self.groups]]
+        x = ConvBN(24, (3, 3), strides=(2, 2))(x, train)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for stage, (ch, repeats) in enumerate(zip(stage_ch, _STAGE_REPEATS)):
+            x = ShuffleUnit(ch, self.groups, stride=2,
+                            first_stage=(stage == 0))(x, train)
+            for _ in range(repeats - 1):
+                x = ShuffleUnit(ch, self.groups)(x, train)
+        x = global_avg_pool(x)
+        return nn.Dense(self.num_classes)(x)
+
+
+@register_model("shufflenet1")
+def shufflenet_v1(num_classes: int = 1000, groups: int = 3, scale: float = 1.0, **_):
+    return ShuffleNetV1(num_classes=num_classes, groups=groups, scale=scale)

@@ -1,0 +1,48 @@
+"""VGG-16/19 (Simonyan & Zisserman 2014), configs D and E.
+
+Parity targets: VGG/pytorch/models/vgg16.py:25-40 and vgg19.py (plain 3x3
+stacks + maxpool, three FC-4096/4096/1000 head, dropout 0.5). The reference
+trains without BN (per the paper); we keep that for parity and expose
+`use_bn` for the modern variant.
+"""
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import flax.linen as nn
+
+from deep_vision_tpu.models import register_model
+from deep_vision_tpu.nn.layers import ConvBN
+
+_CFG_D: Tuple[Tuple[int, int], ...] = ((2, 64), (2, 128), (3, 256), (3, 512), (3, 512))
+_CFG_E: Tuple[Tuple[int, int], ...] = ((2, 64), (2, 128), (4, 256), (4, 512), (4, 512))
+
+
+class VGG(nn.Module):
+    cfg: Tuple[Tuple[int, int], ...]
+    num_classes: int = 1000
+    dropout: float = 0.5
+    use_bn: bool = False
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        for n_convs, ch in self.cfg:
+            for _ in range(n_convs):
+                x = ConvBN(ch, (3, 3), use_bn=self.use_bn, use_bias=True)(x, train)
+            x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(4096)(x))
+        x = nn.Dropout(self.dropout, deterministic=not train)(x)
+        x = nn.relu(nn.Dense(4096)(x))
+        x = nn.Dropout(self.dropout, deterministic=not train)(x)
+        return nn.Dense(self.num_classes)(x)
+
+
+@register_model("vgg16")
+def vgg16(num_classes: int = 1000, **kw):
+    return VGG(cfg=_CFG_D, num_classes=num_classes, **kw)
+
+
+@register_model("vgg19")
+def vgg19(num_classes: int = 1000, **kw):
+    return VGG(cfg=_CFG_E, num_classes=num_classes, **kw)

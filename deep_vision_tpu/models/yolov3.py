@@ -1,0 +1,140 @@
+"""Darknet-53 backbone + YOLOv3 multi-scale detection head (Redmon 2018).
+
+Parity targets: YOLO/tensorflow/yolov3.py — DarknetConv (:23-41, LeakyReLU 0.1
++ BN), DarknetResidual (:44-51), Darknet backbone returning 3 scales (:54-92),
+YoloV3 head with upsample+concat FPN-style necks (:95-235). In training mode
+returns raw per-scale tensors (B, g, g, 3, 5+C) exactly like yolov3.py:221-222;
+box decode to absolute coordinates lives in ops/boxes.py (the eval-mode Lambda
+appendix at yolov3.py:224-235).
+"""
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from deep_vision_tpu.models import register_model
+from deep_vision_tpu.nn.layers import ConvBN
+
+_leaky = lambda x: nn.leaky_relu(x, 0.1)
+
+
+class DarknetConv(nn.Module):
+    features: int
+    kernel: int = 3
+    strides: int = 1
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        # stride-2 darknet convs use top-left asymmetric padding (yolov3.py:30-33)
+        pad = "SAME" if self.strides == 1 else [(1, 0), (1, 0)]
+        return ConvBN(
+            self.features,
+            (self.kernel, self.kernel),
+            strides=(self.strides, self.strides),
+            padding=pad,
+            act=_leaky,
+        )(x, train)
+
+
+class DarknetResidual(nn.Module):
+    features: int  # block output channels
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        y = DarknetConv(self.features // 2, 1)(x, train)
+        y = DarknetConv(self.features, 3)(y, train)
+        return x + y
+
+
+class Darknet53(nn.Module):
+    """Backbone; returns (C3, C4, C5) feature maps at /8, /16, /32."""
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = DarknetConv(32, 3)(x, train)
+        x = DarknetConv(64, 3, strides=2)(x, train)
+        x = DarknetResidual(64)(x, train)
+        x = DarknetConv(128, 3, strides=2)(x, train)
+        for _ in range(2):
+            x = DarknetResidual(128)(x, train)
+        x = DarknetConv(256, 3, strides=2)(x, train)
+        for _ in range(8):
+            x = DarknetResidual(256)(x, train)
+        c3 = x
+        x = DarknetConv(512, 3, strides=2)(x, train)
+        for _ in range(8):
+            x = DarknetResidual(512)(x, train)
+        c4 = x
+        x = DarknetConv(1024, 3, strides=2)(x, train)
+        for _ in range(4):
+            x = DarknetResidual(1024)(x, train)
+        c5 = x
+        return c3, c4, c5
+
+
+class YoloNeck(nn.Module):
+    """5-conv block producing the scale's feature + the upsample branch input."""
+
+    features: int
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = DarknetConv(self.features, 1)(x, train)
+        x = DarknetConv(self.features * 2, 3)(x, train)
+        x = DarknetConv(self.features, 1)(x, train)
+        x = DarknetConv(self.features * 2, 3)(x, train)
+        x = DarknetConv(self.features, 1)(x, train)
+        return x
+
+
+class YoloHead(nn.Module):
+    features: int
+    num_anchors: int
+    num_classes: int
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = DarknetConv(self.features * 2, 3)(x, train)
+        x = nn.Conv(self.num_anchors * (5 + self.num_classes), (1, 1))(x)
+        b, g1, g2, _ = x.shape
+        return x.reshape(b, g1, g2, self.num_anchors, 5 + self.num_classes)
+
+
+def _upsample2x(x):
+    b, h, w, c = x.shape
+    return jax.image.resize(x, (b, h * 2, w * 2, c), method="nearest")
+
+
+class YoloV3(nn.Module):
+    """Returns 3 raw scale outputs (large->small stride): shapes
+    (B, s/32, s/32, 3, 5+C), (B, s/16, ...), (B, s/8, ...)."""
+
+    num_classes: int = 80
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        c3, c4, c5 = Darknet53()(x, train)
+        n5 = YoloNeck(512)(c5, train)
+        out_large = YoloHead(512, 3, self.num_classes)(n5, train)
+
+        u5 = DarknetConv(256, 1)(n5, train)
+        n4 = YoloNeck(256)(jnp.concatenate([_upsample2x(u5), c4], -1), train)
+        out_medium = YoloHead(256, 3, self.num_classes)(n4, train)
+
+        u4 = DarknetConv(128, 1)(n4, train)
+        n3 = YoloNeck(128)(jnp.concatenate([_upsample2x(u4), c3], -1), train)
+        out_small = YoloHead(128, 3, self.num_classes)(n3, train)
+        return out_large, out_medium, out_small
+
+
+@register_model("yolov3")
+def yolov3(num_classes: int = 80, **_):
+    return YoloV3(num_classes=num_classes)
+
+
+@register_model("darknet53")
+def darknet53(**_):
+    return Darknet53()

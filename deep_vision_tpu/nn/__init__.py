@@ -1,0 +1,8 @@
+from deep_vision_tpu.nn.layers import (
+    ConvBN,
+    DepthwiseSeparableConv,
+    LocalResponseNorm,
+    channel_shuffle,
+    global_avg_pool,
+    INITIALIZERS,
+)

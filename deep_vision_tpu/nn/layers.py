@@ -1,0 +1,139 @@
+"""Shared flax building blocks for the whole model zoo.
+
+The reference re-implements these per model (e.g. `BasicConv2d` at
+Inception/pytorch/models/inception_v1.py, `DarknetConv` at
+YOLO/tensorflow/yolov3.py:23-41, custom `SeparableConv2D` at
+MobileNet/tensorflow/models/mobilenet_v1.py:7-26). Here they are written once,
+NHWC, TPU-native:
+
+- depthwise/group conv lowers to `lax.conv_general_dilated` with
+  `feature_group_count` (the XLA-native form of torch's `groups=`);
+- BatchNorm under pjit computes batch statistics over the *global* batch
+  (XLA inserts the cross-replica psum), i.e. synced BN by construction —
+  resolving the DataParallel+BN pitfall the reference documents at
+  ResNet/pytorch/train.py:348-349;
+- LocalResponseNorm (AlexNet V1, alexnet_v1.py:33-89) is a vectorized
+  channel-window sum, fused by XLA.
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+INITIALIZERS = {
+    "he_normal": nn.initializers.he_normal(),
+    "he_uniform": nn.initializers.he_uniform(),
+    "xavier_normal": nn.initializers.xavier_normal(),
+    "xavier_uniform": nn.initializers.xavier_uniform(),
+    "lecun_normal": nn.initializers.lecun_normal(),
+    "normal02": nn.initializers.normal(0.02),  # DCGAN init
+}
+
+
+def global_avg_pool(x):
+    """NHWC -> NC global average pool (replaces AdaptiveAvgPool2d(1))."""
+    return jnp.mean(x, axis=(1, 2))
+
+
+def channel_shuffle(x, groups: int):
+    """ShuffleNet channel shuffle: (B,H,W,g*c) -> transpose group/channel.
+
+    The reference never implemented this (shufflenet_v1.py is a 0-byte file,
+    SURVEY.md §2.9); written from the ShuffleNet paper (sec 3.1).
+    """
+    b, h, w, c = x.shape
+    assert c % groups == 0, f"channels {c} not divisible by groups {groups}"
+    x = x.reshape(b, h, w, groups, c // groups)
+    x = jnp.swapaxes(x, 3, 4)
+    return x.reshape(b, h, w, c)
+
+
+class LocalResponseNorm(nn.Module):
+    """AlexNet V1's LRN (alexnet_v1.py:42,52): across-channel normalization."""
+
+    size: int = 5
+    alpha: float = 1e-4
+    beta: float = 0.75
+    k: float = 2.0
+
+    @nn.compact
+    def __call__(self, x):
+        half = self.size // 2
+        sq = jnp.square(x)
+        # sum over a channel window via padded cumulative trick
+        padded = jnp.pad(sq, [(0, 0)] * (x.ndim - 1) + [(half, half)])
+        window = sum(
+            jax.lax.dynamic_slice_in_dim(padded, i, x.shape[-1], axis=x.ndim - 1)
+            for i in range(self.size)
+        )
+        return x / jnp.power(self.k + self.alpha * window, self.beta)
+
+
+class ConvBN(nn.Module):
+    """Conv + BatchNorm + activation, the universal CNN building block."""
+
+    features: int
+    kernel: Tuple[int, int] = (3, 3)
+    strides: Tuple[int, int] = (1, 1)
+    padding: str | Sequence[Tuple[int, int]] = "SAME"
+    groups: int = 1
+    use_bn: bool = True
+    use_bias: bool = False
+    act: Optional[Callable] = nn.relu
+    kernel_init: Callable = nn.initializers.he_normal()
+    bn_momentum: float = 0.9
+    dtype: Optional[jnp.dtype] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = nn.Conv(
+            self.features,
+            self.kernel,
+            strides=self.strides,
+            padding=self.padding,
+            feature_group_count=self.groups,
+            use_bias=self.use_bias or not self.use_bn,
+            kernel_init=self.kernel_init,
+            dtype=self.dtype,
+        )(x)
+        if self.use_bn:
+            x = nn.BatchNorm(
+                use_running_average=not train,
+                momentum=self.bn_momentum,
+                dtype=self.dtype,
+            )(x)
+        if self.act is not None:
+            x = self.act(x)
+        return x
+
+
+class DepthwiseSeparableConv(nn.Module):
+    """MobileNet's depthwise 3x3 + pointwise 1x1 (mobilenet_v1.py:109-122).
+
+    Depthwise = grouped conv with feature_group_count == in_channels; XLA
+    lowers this to a TPU-native depthwise convolution.
+    """
+
+    features: int  # pointwise output channels
+    strides: Tuple[int, int] = (1, 1)
+    act: Callable = nn.relu
+    dtype: Optional[jnp.dtype] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        in_ch = x.shape[-1]
+        x = ConvBN(
+            features=in_ch,
+            kernel=(3, 3),
+            strides=self.strides,
+            groups=in_ch,
+            act=self.act,
+            dtype=self.dtype,
+        )(x, train)
+        x = ConvBN(
+            features=self.features, kernel=(1, 1), act=self.act, dtype=self.dtype
+        )(x, train)
+        return x

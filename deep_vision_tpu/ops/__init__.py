@@ -1,0 +1,10 @@
+from deep_vision_tpu.ops.boxes import (
+    xywh_to_xyxy,
+    xyxy_to_xywh,
+    broadcast_iou,
+    decode_yolo_boxes,
+    encode_yolo_boxes,
+)
+from deep_vision_tpu.ops.nms import non_maximum_suppression
+from deep_vision_tpu.ops.anchors import assign_anchors_to_grid, YOLO_ANCHORS, YOLO_ANCHOR_MASKS
+from deep_vision_tpu.ops.heatmaps import gaussian_heatmaps, gaussian_radius

@@ -1,0 +1,83 @@
+"""YOLO anchor assignment: padded boxes -> per-scale grid targets, vectorized.
+
+Replaces the TensorArray + tensor_scatter_nd_update autograph loops at
+YOLO/tensorflow/preprocess.py:137-269 (`preprocess_label_for_one_scale`,
+`find_best_anchor`) with a single masked scatter: every (padded, fixed-count)
+ground-truth box computes its best anchor by IoU against the 9 anchor shapes
+(:226-269), then scatters (xywh, obj, one-hot class) into the (g, g, A, 5+C)
+grid of the scale owning that anchor. Static shapes throughout — the TPU-native
+form of 'ragged boxes' (SURVEY.md §7 hard parts).
+"""
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# COCO anchors normalized by 416 (yolov3.py header); rows: (w, h)
+YOLO_ANCHORS = np.array(
+    [(10, 13), (16, 30), (33, 23), (30, 61), (62, 45), (59, 119),
+     (116, 90), (156, 198), (373, 326)],
+    np.float32,
+) / 416.0
+# scale 0 = stride 32 (large objects) gets anchors 6,7,8, etc.
+YOLO_ANCHOR_MASKS = np.array([[6, 7, 8], [3, 4, 5], [0, 1, 2]])
+
+
+def _anchor_iou(wh, anchors):
+    """IoU of box shapes (N,2) vs anchors (A,2), both centered at origin."""
+    inter = jnp.minimum(wh[:, None, 0], anchors[None, :, 0]) * jnp.minimum(
+        wh[:, None, 1], anchors[None, :, 1]
+    )
+    area_box = wh[:, 0] * wh[:, 1]
+    area_anchor = anchors[:, 0] * anchors[:, 1]
+    return inter / jnp.maximum(area_box[:, None] + area_anchor[None] - inter, 1e-9)
+
+
+def assign_anchors_to_grid(
+    boxes_xywh,
+    classes,
+    grid_sizes: Sequence[int],
+    anchors=YOLO_ANCHORS,
+    anchor_masks=YOLO_ANCHOR_MASKS,
+    num_classes: int = 80,
+):
+    """Build per-scale YOLO training targets from padded GT boxes.
+
+    boxes_xywh: (N, 4) normalized; padded rows have w == h == 0.
+    classes: (N,) int ids.
+    Returns a list over scales of (g, g, A, 5 + num_classes) targets with
+    layout [x, y, w, h, obj, onehot...] matching preprocess.py:137-224.
+    Use `jax.vmap` for a batch dimension.
+    """
+    anchors = jnp.asarray(anchors)
+    anchor_masks = jnp.asarray(anchor_masks)
+    n = boxes_xywh.shape[0]
+    valid = (boxes_xywh[..., 2] > 0) & (boxes_xywh[..., 3] > 0)
+
+    iou = _anchor_iou(boxes_xywh[:, 2:4], anchors)  # (N, 9)
+    best_anchor = jnp.argmax(iou, axis=-1)  # (N,)
+
+    onehot = jax.nn.one_hot(classes, num_classes, dtype=boxes_xywh.dtype)
+    targets = []
+    for s, g in enumerate(grid_sizes):
+        mask = anchor_masks[s]  # (A,) anchor ids owned by this scale
+        # which slot (if any) within this scale each box lands in
+        slot = jnp.argmax(best_anchor[:, None] == mask[None, :], axis=-1)
+        owned = jnp.any(best_anchor[:, None] == mask[None, :], axis=-1) & valid
+
+        cell = jnp.floor(boxes_xywh[:, :2] * g).astype(jnp.int32)
+        cell = jnp.clip(cell, 0, g - 1)
+        rows = jnp.where(owned, cell[:, 1], g)  # g = out-of-range drop row
+        cols = jnp.where(owned, cell[:, 0], g)
+
+        value = jnp.concatenate(
+            [boxes_xywh, jnp.ones((n, 1), boxes_xywh.dtype), onehot], axis=-1
+        )
+        grid = jnp.zeros((g + 1, g + 1, mask.shape[0], 5 + num_classes),
+                         boxes_xywh.dtype)
+        grid = grid.at[rows, cols, slot].set(value)
+        targets.append(grid[:g, :g])
+    return targets

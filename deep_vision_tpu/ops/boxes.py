@@ -1,0 +1,86 @@
+"""Box coordinate transforms, broadcast IoU, and YOLO box (de)coding.
+
+Parity targets: YOLO/tensorflow/utils.py — `xywh_to_x1x2y1y2`, broadcast_iou
+(:31-77); yolov3.py — `get_absolute_yolo_box` (:238-326) and
+`get_relative_yolo_box` (:329-349). Everything is vectorized, static-shape,
+NaN-safe, and differentiable where the loss needs it.
+
+Conventions: boxes are (..., 4); 'xywh' = center x, center y, width, height;
+'xyxy' = x1, y1, x2, y2. All normalized to [0, 1] image coordinates.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def xywh_to_xyxy(boxes):
+    xy, wh = boxes[..., :2], boxes[..., 2:4]
+    return jnp.concatenate([xy - wh / 2.0, xy + wh / 2.0], axis=-1)
+
+
+def xyxy_to_xywh(boxes):
+    mins, maxs = boxes[..., :2], boxes[..., 2:4]
+    return jnp.concatenate([(mins + maxs) / 2.0, maxs - mins], axis=-1)
+
+
+def broadcast_iou(box_a, box_b):
+    """IoU of (..., N, 4) vs (..., M, 4) xyxy boxes -> (..., N, M).
+
+    The (B, N, M) broadcast form of utils.py:31-77.
+    """
+    a = box_a[..., :, None, :]  # (..., N, 1, 4)
+    b = box_b[..., None, :, :]  # (..., 1, M, 4)
+    lt = jnp.maximum(a[..., :2], b[..., :2])
+    rb = jnp.minimum(a[..., 2:4], b[..., 2:4])
+    wh = jnp.clip(rb - lt, 0.0)
+    inter = wh[..., 0] * wh[..., 1]
+    area_a = jnp.clip(a[..., 2] - a[..., 0], 0.0) * jnp.clip(a[..., 3] - a[..., 1], 0.0)
+    area_b = jnp.clip(b[..., 2] - b[..., 0], 0.0) * jnp.clip(b[..., 3] - b[..., 1], 0.0)
+    union = area_a + area_b - inter
+    return inter / jnp.maximum(union, 1e-9)
+
+
+def _grid_offsets(gy: int, gx: int, dtype=jnp.float32):
+    """(gy, gx, 1, 2) cell top-left offsets (the meshgrid at yolov3.py:272-281)."""
+    ys = jnp.arange(gy, dtype=dtype)
+    xs = jnp.arange(gx, dtype=dtype)
+    gx_grid, gy_grid = jnp.meshgrid(xs, ys)  # each (gy, gx)
+    return jnp.stack([gx_grid, gy_grid], axis=-1)[:, :, None, :]
+
+
+def decode_yolo_boxes(pred, anchors):
+    """Raw per-scale head output -> absolute boxes + probs.
+
+    pred: (B, g, g, A, 5+C) raw; anchors: (A, 2) normalized w,h.
+    Returns (boxes_xyxy (B,g,g,A,4), objectness (B,g,g,A,1), class_probs).
+    bx = (sigmoid(tx) + cx) / g ; bw = pw * exp(tw)  (yolov3.py:238-326).
+    """
+    _, gy, gx, na, _ = pred.shape
+    t_xy = pred[..., 0:2]
+    t_wh = pred[..., 2:4]
+    objectness = jax.nn.sigmoid(pred[..., 4:5])
+    class_probs = jax.nn.sigmoid(pred[..., 5:])
+    grid = _grid_offsets(gy, gx, pred.dtype)
+    b_xy = (jax.nn.sigmoid(t_xy) + grid) / jnp.asarray([gx, gy], pred.dtype)
+    b_wh = jnp.exp(jnp.clip(t_wh, -10.0, 10.0)) * anchors  # clip: stable exp
+    boxes = xywh_to_xyxy(jnp.concatenate([b_xy, b_wh], axis=-1))
+    return boxes, objectness, class_probs
+
+
+def encode_yolo_boxes(boxes_xywh, anchors, grid_size):
+    """Absolute xywh -> the (tx, ty, tw, th) regression targets.
+
+    Inverse transform (get_relative_yolo_box, yolov3.py:329-349), with the
+    log guarded against empty/padded boxes the way :344-346 NaN-guards.
+    """
+    g = grid_size
+    b_xy, b_wh = boxes_xywh[..., :2], boxes_xywh[..., 2:4]
+    scaled = b_xy * g
+    cell = jnp.floor(scaled)
+    t_xy = scaled - cell  # in (0,1) within the cell
+    safe_wh = jnp.maximum(b_wh, 1e-9)
+    t_wh = jnp.log(safe_wh / jnp.maximum(anchors, 1e-9))
+    valid = (b_wh[..., 0] > 0) & (b_wh[..., 1] > 0)
+    t_wh = jnp.where(valid[..., None], t_wh, 0.0)
+    return jnp.concatenate([t_xy, t_wh], axis=-1)

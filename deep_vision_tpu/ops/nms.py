@@ -1,0 +1,86 @@
+"""Fixed-shape greedy NMS, jit-able on TPU.
+
+Replaces the hand-rolled dynamic-shape while-loop NMS at
+YOLO/tensorflow/postprocess.py:38-96 (tf.map_fn + boolean_mask per class) with
+a static-shape algorithm: select max_detections boxes iteratively with
+`lax.fori_loop`, suppressing by IoU mask — no dynamic shapes anywhere, so it
+compiles once and runs on-device. Multi-label (per-class scores thresholded
+independently, postprocess.py:58-63) with class offsets so one pass handles
+all classes.
+"""
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deep_vision_tpu.ops.boxes import broadcast_iou
+
+
+def _nms_single(boxes, scores, max_detections: int, iou_threshold: float,
+                score_threshold: float):
+    """boxes (N,4) xyxy, scores (N,) -> (max_det,) scores, (max_det,) idx.
+
+    Memory is O(N) per iteration: the IoU row of the selected box is computed
+    on the fly (max_det * N total work) instead of materializing the NxN
+    matrix, which at YOLO-scale N=10647 would be ~450MB/image.
+    """
+    n = boxes.shape[0]
+    scores = jnp.where(scores >= score_threshold, scores, -1.0)
+
+    def body(i, carry):
+        live_scores, sel_idx, sel_score = carry
+        best = jnp.argmax(live_scores)
+        best_score = live_scores[best]
+        keep = best_score > 0.0
+        sel_idx = sel_idx.at[i].set(jnp.where(keep, best, -1))
+        sel_score = sel_score.at[i].set(jnp.where(keep, best_score, 0.0))
+        # suppress: the chosen box and anything overlapping it (one IoU row)
+        iou_row = broadcast_iou(boxes[best][None, :], boxes)[0]  # (N,)
+        suppress = (iou_row >= iou_threshold) | (jnp.arange(n) == best)
+        live_scores = jnp.where(keep & suppress, -1.0, live_scores)
+        return live_scores, sel_idx, sel_score
+
+    sel_idx = jnp.full((max_detections,), -1, jnp.int32)
+    sel_score = jnp.zeros((max_detections,), scores.dtype)
+    _, sel_idx, sel_score = jax.lax.fori_loop(
+        0, max_detections, body, (scores, sel_idx, sel_score)
+    )
+    return sel_score, sel_idx
+
+
+def non_maximum_suppression(
+    boxes,
+    scores,
+    classes=None,
+    max_detections: int = 100,
+    iou_threshold: float = 0.5,
+    score_threshold: float = 0.5,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Batched class-aware NMS.
+
+    boxes: (B, N, 4) xyxy in [0,1]; scores: (B, N); classes: (B, N) int or None.
+    Returns (boxes (B,D,4), scores (B,D), classes (B,D), valid (B,) count),
+    D = max_detections. Padded entries have score 0 and class -1.
+    """
+    if classes is None:
+        classes = jnp.zeros(scores.shape, jnp.int32)
+
+    # class offset trick: translate boxes per class so cross-class IoU is 0
+    offsets = classes.astype(boxes.dtype)[..., None] * 2.0
+    shifted = boxes + offsets
+
+    def per_image(b, s, c, raw_b):
+        sel_s, sel_i = _nms_single(
+            b, s, max_detections, iou_threshold, score_threshold
+        )
+        sel_c = jnp.where(sel_i >= 0, c[jnp.maximum(sel_i, 0)], -1)
+        out_b = jnp.where((sel_i >= 0)[:, None], raw_b[jnp.maximum(sel_i, 0)], 0.0)
+        return out_b, sel_s, sel_c
+
+    out_boxes, out_scores, out_classes = jax.vmap(per_image)(
+        shifted, scores, classes, boxes
+    )
+    valid = jnp.sum((out_classes >= 0).astype(jnp.int32), axis=-1)
+    return out_boxes, out_scores, out_classes, valid

@@ -1,0 +1,8 @@
+from deep_vision_tpu.parallel.mesh import (
+    MeshSpec,
+    create_mesh,
+    data_sharding,
+    replicated,
+    shard_batch,
+    local_mesh_devices,
+)

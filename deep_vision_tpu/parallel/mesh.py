@@ -1,0 +1,125 @@
+"""Device mesh construction and sharding helpers.
+
+This is the TPU-native replacement for the reference's three single-host
+data-parallel wrappers (`nn.DataParallel` at ResNet/pytorch/train.py:353-355,
+`tf.distribute.MirroredStrategy` at YOLO/tensorflow/train.py:281, and
+`keras.utils.multi_gpu_model` at ResNet/tensorflow/train.py:249-251).
+
+Instead of wrapping a model, we build a named `jax.sharding.Mesh` once and
+express every parallelism flavor as a sharding of arrays over its axes:
+
+- ``data``  : batch (data parallel; the only axis the reference ever used)
+- ``model`` : tensor parallel (output features of wide layers)
+
+Sequence/context parallelism for attention workloads reuses the ``data``
+axis (see `parallel/ring_attention.py`) so long sequences shard over the
+same mesh without a dedicated axis.  XLA's SPMD partitioner inserts the
+all-reduce / all-gather / reduce-scatter collectives over ICI; cross-host
+meshes ride DCN transparently (`jax.distributed.initialize` in
+`parallel/multihost.py`).
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """How to lay a device list out as a (data, model) mesh."""
+
+    data: int = -1  # -1: all remaining devices
+    model: int = 1
+
+    def resolve(self, n_devices: int) -> tuple[int, int]:
+        model = max(1, self.model)
+        if n_devices % model != 0:
+            raise ValueError(f"model axis {model} does not divide {n_devices} devices")
+        data = self.data if self.data > 0 else n_devices // model
+        if data * model != n_devices:
+            raise ValueError(
+                f"mesh {data}x{model} != {n_devices} devices; pass data=-1 to infer"
+            )
+        return data, model
+
+
+def create_mesh(
+    spec: MeshSpec | None = None,
+    *,
+    devices: Optional[Sequence[jax.Device]] = None,
+    data: int = -1,
+    model: int = 1,
+) -> Mesh:
+    """Build a 2-D ('data', 'model') mesh over the given (default: all) devices.
+
+    ``create_mesh()`` -> all devices on the data axis: pure data parallel,
+    exactly mirroring the reference's `global_batch = batch * num_replicas`
+    contract (YOLO/tensorflow/train.py:282).
+    """
+    if spec is None:
+        spec = MeshSpec(data=data, model=model)
+    if devices is None:
+        devices = jax.devices()
+    d, m = spec.resolve(len(devices))
+    arr = np.asarray(devices).reshape(d, m)
+    return Mesh(arr, (DATA_AXIS, MODEL_AXIS))
+
+
+def local_mesh_devices(mesh: Mesh) -> list[jax.Device]:
+    """Devices of `mesh` that live on this host (for host-sharded input feed)."""
+    procid = jax.process_index()
+    return [d for d in mesh.devices.flat if d.process_index == procid]
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    """Fully-replicated sharding (params/opt state in plain data parallel)."""
+    return NamedSharding(mesh, P())
+
+
+def data_sharding(mesh: Mesh, ndim: int = 1) -> NamedSharding:
+    """Shard the leading (batch) dimension over the 'data' axis."""
+    return NamedSharding(mesh, P(DATA_AXIS, *([None] * (ndim - 1))))
+
+
+def shard_batch(mesh: Mesh, batch):
+    """Place a host batch (pytree of np/jnp arrays) with batch-dim sharding.
+
+    The device boundary of the framework: everything before this call is
+    host-side numpy; everything after is SPMD on the mesh.
+    """
+
+    def _place(x):
+        x = np.asarray(x)
+        return jax.device_put(x, data_sharding(mesh, x.ndim))
+
+    return jax.tree_util.tree_map(_place, batch)
+
+
+def pad_batch_to(batch, multiple: int):
+    """Pad the leading dim of every leaf up to `multiple` (TPU static shapes).
+
+    Returns (padded_batch, valid_count). Needed for the final partial batch
+    of an epoch: the reference simply let torch/TF handle ragged last batches
+    (ResNet/pytorch/train.py:431-485); under jit we pad and mask instead.
+    """
+    leaves = jax.tree_util.tree_leaves(batch)
+    if not leaves:
+        return batch, 0
+    n = leaves[0].shape[0]
+    target = math.ceil(n / multiple) * multiple if n % multiple else n
+
+    def _pad(x):
+        if x.shape[0] == target:
+            return x
+        pad = [(0, target - x.shape[0])] + [(0, 0)] * (x.ndim - 1)
+        return np.pad(np.asarray(x), pad)
+
+    return jax.tree_util.tree_map(_pad, batch), n

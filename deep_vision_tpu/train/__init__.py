@@ -1,0 +1,2 @@
+from deep_vision_tpu.train.optimizers import build_optimizer, ReduceLROnPlateau
+from deep_vision_tpu.train.trainer import Trainer

@@ -1,0 +1,233 @@
+"""The single Trainer shared by every model in the zoo.
+
+Replaces the reference's per-model copy-pasted loops (the 562-line
+`run_epochs`/`train`/`validate` at ResNet/pytorch/train.py:310-538, the TF2
+`Trainer` classes at YOLO/tensorflow/train.py:22-257 and
+Hourglass/tensorflow/train.py:15-172, and Keras `model.fit` at
+ResNet/tensorflow/train.py:283-297) with ONE jitted SPMD step over a device
+mesh:
+
+- `train_step`/`eval_step` are traced once (the pjit analog of the
+  `@tf.function distributed_train_epoch` boundary at YOLO/tensorflow/train.py:126);
+- the per-replica fan-out + `strategy.reduce(SUM)` pair
+  (YOLO/tensorflow/train.py:131-151) disappears: batches are sharded over the
+  mesh's 'data' axis and XLA inserts the gradient all-reduce;
+- stateful host logic (plateau LR, best-val checkpointing,
+  YOLO/tensorflow/train.py:56-68,243-247) stays outside jit and feeds the LR
+  back in through `opt_state.hyperparams`.
+"""
+from __future__ import annotations
+
+import time
+from typing import Callable, Iterable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from deep_vision_tpu.core.metrics import MetricLogger
+from deep_vision_tpu.core.train_state import TrainState, create_train_state
+from deep_vision_tpu.parallel.mesh import (
+    DATA_AXIS,
+    create_mesh,
+    pad_batch_to,
+    replicated,
+    shard_batch,
+)
+
+
+def _set_lr(opt_state, lr: float):
+    """Set the injected learning_rate hyperparam to an absolute value."""
+    hp = dict(opt_state.hyperparams)
+    hp["learning_rate"] = jnp.asarray(lr, jnp.asarray(hp["learning_rate"]).dtype)
+    return opt_state._replace(hyperparams=hp)
+
+
+class Trainer:
+    """One model + optimizer + loss over a mesh.
+
+    loss_fn(outputs, batch) -> (loss, metrics_dict). The model is applied to
+    `batch[input_key]` with `train=True/False` and a 'dropout' rng.
+    """
+
+    def __init__(
+        self,
+        model,
+        tx: optax.GradientTransformation,
+        loss_fn: Callable,
+        sample_input,
+        eval_loss_fn: Optional[Callable] = None,
+        mesh=None,
+        rng: Optional[jax.Array] = None,
+        input_key: str = "image",
+        checkpoint_manager=None,
+        plateau=None,  # ReduceLROnPlateau or None
+        plateau_metric: str = "top1",
+        logger: Optional[MetricLogger] = None,
+        eval_logger: Optional[MetricLogger] = None,
+    ):
+        self.mesh = mesh if mesh is not None else create_mesh()
+        self.loss_fn = loss_fn
+        self.eval_loss_fn = eval_loss_fn or loss_fn
+        self.input_key = input_key
+        self.ckpt = checkpoint_manager
+        self.plateau = plateau
+        self.plateau_metric = plateau_metric
+        self.logger = logger or MetricLogger(name="train")
+        self.eval_logger = eval_logger or MetricLogger(name="val", print_every=0)
+
+        state = create_train_state(model, tx, sample_input, rng)
+        # device boundary: state lives replicated on the mesh from here on
+        self.state = jax.device_put(state, replicated(self.mesh))
+        # base LR for plateau scaling: scale is applied to this absolute value,
+        # never compounded onto an already-scaled current LR
+        try:
+            self._base_lr = float(state.opt_state.hyperparams["learning_rate"])
+        except (AttributeError, KeyError, TypeError):
+            self._base_lr = None
+
+        self._train_step = jax.jit(self._train_step_impl, donate_argnums=0)
+        self._eval_step = jax.jit(self._eval_step_impl)
+
+    # -- jitted steps ------------------------------------------------------
+    def _train_step_impl(self, state: TrainState, batch):
+        step_rng = jax.random.fold_in(state.rng, state.step)
+
+        def loss_fn(params):
+            variables = {"params": params}
+            mutable = False
+            if state.batch_stats:
+                variables["batch_stats"] = state.batch_stats
+                mutable = ["batch_stats"]
+            out = state.apply_fn(
+                variables,
+                batch[self.input_key],
+                train=True,
+                rngs={"dropout": step_rng},
+                mutable=mutable,
+            )
+            outputs, new_model_state = out if mutable else (out, {})
+            loss, metrics = self.loss_fn(outputs, batch)
+            return loss, (metrics, new_model_state.get("batch_stats", {}))
+
+        grads, (metrics, new_bs) = jax.grad(loss_fn, has_aux=True)(state.params)
+        new_state = state.apply_gradients(grads)
+        if state.batch_stats:
+            new_state = new_state.replace(batch_stats=new_bs)
+        metrics["grad_norm"] = optax.global_norm(grads)
+        return new_state, metrics
+
+    def _eval_step_impl(self, state: TrainState, batch):
+        variables = {"params": state.params}
+        if state.batch_stats:
+            variables["batch_stats"] = state.batch_stats
+        outputs = state.apply_fn(variables, batch[self.input_key], train=False)
+        _, metrics = self.eval_loss_fn(outputs, batch)
+        return metrics
+
+    # -- host API ----------------------------------------------------------
+    def _pad_and_mask(self, batch):
+        """Pad the final partial batch up to the data-axis multiple and attach
+        a '_mask' row-validity array consumed by mask-aware losses/metrics
+        (TPU static shapes; the reference just let torch/TF handle ragged
+        last batches, ResNet/pytorch/train.py:431-485)."""
+        n_data = self.mesh.shape[DATA_AXIS]
+        batch, n_valid = pad_batch_to(dict(batch), n_data)
+        n_total = np.asarray(batch[self.input_key]).shape[0]
+        if "_mask" not in batch:
+            mask = np.zeros((n_total,), np.float32)
+            mask[:n_valid] = 1.0
+            batch["_mask"] = mask
+        return batch
+
+    def train_step(self, batch) -> dict:
+        batch = shard_batch(self.mesh, self._pad_and_mask(batch))
+        self.state, metrics = self._train_step(self.state, batch)
+        return metrics
+
+    def eval_step(self, batch) -> dict:
+        batch = shard_batch(self.mesh, self._pad_and_mask(batch))
+        return self._eval_step(self.state, batch)
+
+    @property
+    def current_lr(self) -> float:
+        try:
+            return float(self.state.opt_state.hyperparams["learning_rate"])
+        except (AttributeError, KeyError, TypeError):
+            return float("nan")
+
+    def evaluate(self, eval_data: Iterable, epoch: int = 0) -> dict:
+        self.eval_logger.start_epoch()
+        step = 0
+        for batch in eval_data:
+            n = np.asarray(batch[self.input_key]).shape[0]
+            metrics = self.eval_step(batch)
+            self.eval_logger.log_step(step, metrics, batch_size=n, epoch=epoch)
+            step += 1
+        return self.eval_logger.end_epoch(epoch)
+
+    def fit(
+        self,
+        train_data_fn: Callable[[], Iterable],
+        eval_data_fn: Optional[Callable[[], Iterable]] = None,
+        epochs: int = 1,
+        start_epoch: int = 0,
+        eval_first: bool = False,  # epoch-0 sanity pass (ResNet/pytorch/train.py:390)
+        save_every: int = 1,
+    ):
+        if eval_first and eval_data_fn is not None:
+            self.evaluate(eval_data_fn(), epoch=start_epoch)
+        for epoch in range(start_epoch, epochs):
+            self.logger.start_epoch()
+            for batch in train_data_fn():
+                n = np.asarray(batch[self.input_key]).shape[0]
+                metrics = self.train_step(batch)
+                self.logger.log_step(
+                    int(self.state.step), metrics, batch_size=n, epoch=epoch,
+                    lr=self.current_lr,
+                )
+            self.logger.end_epoch(epoch)
+
+            val_summary = {}
+            if eval_data_fn is not None:
+                val_summary = self.evaluate(eval_data_fn(), epoch=epoch)
+
+            if (
+                self.plateau is not None
+                and self.plateau_metric in val_summary
+                and self._base_lr is not None
+            ):
+                scale = self.plateau.step(val_summary[self.plateau_metric])
+                self.state = self.state.replace(
+                    opt_state=_set_lr(self.state.opt_state, self._base_lr * scale)
+                )
+
+            if self.ckpt is not None and (epoch + 1) % save_every == 0:
+                host_state = {
+                    "epoch": epoch,
+                    "train_logger": self.logger.state_dict(),
+                    "val_logger": self.eval_logger.state_dict(),
+                }
+                if self.plateau is not None:
+                    host_state["plateau"] = self.plateau.state_dict()
+                self.ckpt.save(
+                    int(self.state.step), self.state, host_state=host_state,
+                    metrics=val_summary,
+                )
+        if self.ckpt is not None:
+            self.ckpt.wait()
+        return self.state
+
+    def resume(self, step: Optional[int] = None) -> int:
+        """Restore state + host loggers/plateau; returns next epoch to run."""
+        assert self.ckpt is not None, "no CheckpointManager configured"
+        self.state, host_state = self.ckpt.restore(self.state, step)
+        self.state = jax.device_put(self.state, replicated(self.mesh))
+        if not host_state:
+            return 0
+        self.logger.load_state_dict(host_state.get("train_logger", {}))
+        self.eval_logger.load_state_dict(host_state.get("val_logger", {}))
+        if self.plateau is not None and "plateau" in host_state:
+            self.plateau.load_state_dict(host_state["plateau"])
+        return int(host_state.get("epoch", -1)) + 1

@@ -1,0 +1,39 @@
+"""Test configuration: force an 8-device virtual CPU mesh BEFORE jax import.
+
+This is the pjit analog of the reference's CPU-MirroredStrategy trick
+("CPU or single GPU also works", YOLO/tensorflow/README.md:2): multi-device
+sharding semantics are exercised without TPU hardware.
+"""
+import os
+
+# hard-set: the shell may carry JAX_PLATFORMS=axon (real TPU); tests always
+# run on the virtual 8-device CPU mesh. The axon sitecustomize imports jax at
+# interpreter startup, so the env var alone is read too early — update the
+# config explicitly as well.
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def mesh8():
+    from deep_vision_tpu.parallel import create_mesh
+
+    assert len(jax.devices()) == 8
+    return create_mesh()
+
+
+@pytest.fixture(scope="session")
+def mesh4x2():
+    from deep_vision_tpu.parallel import create_mesh
+
+    return create_mesh(data=4, model=2)

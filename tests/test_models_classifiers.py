@@ -1,0 +1,87 @@
+"""Shape tests for every classifier in the zoo (reference had none; SURVEY.md §4)."""
+import jax
+import jax.numpy as jnp
+import pytest
+
+from deep_vision_tpu.models import get_model
+
+RNG = jax.random.PRNGKey(0)
+
+
+def _init_apply(model, x, train=False):
+    variables = model.init({"params": RNG, "dropout": RNG}, x, train=train)
+    out = model.apply(
+        variables, x, train=train,
+        rngs={"dropout": RNG},
+        mutable=["batch_stats"] if "batch_stats" in variables else False,
+    )
+    if isinstance(out, tuple) and len(out) == 2 and isinstance(out[1], dict):
+        out = out[0]
+    return out, variables
+
+
+@pytest.mark.parametrize(
+    "name,shape,classes",
+    [
+        ("lenet5", (2, 32, 32, 1), 10),
+        ("alexnet1", (1, 227, 227, 3), 17),
+        ("alexnet2", (1, 224, 224, 3), 17),
+        ("vgg16", (1, 224, 224, 3), 17),
+        ("vgg19", (1, 224, 224, 3), 17),
+        ("resnet34", (1, 224, 224, 3), 17),
+        ("resnet50", (1, 224, 224, 3), 17),
+        ("resnet152", (1, 96, 96, 3), 17),
+        ("resnet50v2", (1, 224, 224, 3), 17),
+        ("mobilenet1", (1, 224, 224, 3), 17),
+        ("shufflenet1", (1, 224, 224, 3), 17),
+    ],
+)
+def test_classifier_eval_shapes(name, shape, classes):
+    model = get_model(name, num_classes=classes)
+    out, _ = _init_apply(model, jnp.zeros(shape))
+    assert out.shape == (shape[0], classes)
+    assert out.dtype == jnp.float32
+
+
+def test_inception_v1_aux_heads():
+    model = get_model("inception1", num_classes=11)
+    x = jnp.zeros((1, 224, 224, 3))
+    out, variables = _init_apply(model, x, train=True)
+    logits, aux1, aux2 = out
+    assert logits.shape == aux1.shape == aux2.shape == (1, 11)
+    # eval mode: single output
+    out_eval = model.apply(variables, x, train=False)
+    assert out_eval.shape == (1, 11)
+
+
+def test_inception_v3_aux_head():
+    model = get_model("inception3", num_classes=7)
+    x = jnp.zeros((1, 299, 299, 3))
+    out, _ = _init_apply(model, x, train=True)
+    logits, aux = out
+    assert logits.shape == (1, 7)
+    assert aux.shape == (1, 7)
+
+
+def test_mobilenet_alpha_shrinks_params():
+    import numpy as np
+
+    def nparams(model, x):
+        v = model.init({"params": RNG, "dropout": RNG}, x, train=False)
+        return sum(np.prod(p.shape) for p in jax.tree_util.tree_leaves(v["params"]))
+
+    x = jnp.zeros((1, 224, 224, 3))
+    full = nparams(get_model("mobilenet1", num_classes=10, alpha=1.0), x)
+    half = nparams(get_model("mobilenet1", num_classes=10, alpha=0.5), x)
+    assert half < full * 0.5
+
+
+def test_shufflenet_channel_shuffle_roundtrip():
+    from deep_vision_tpu.nn.layers import channel_shuffle
+
+    x = jnp.arange(2 * 1 * 1 * 12, dtype=jnp.float32).reshape(2, 1, 1, 12)
+    y = channel_shuffle(x, 3)
+    # shuffling with g then with c//g is the identity permutation inverse
+    z = channel_shuffle(y, 4)
+    assert jnp.allclose(z, x)
+    assert not jnp.allclose(y, x)
