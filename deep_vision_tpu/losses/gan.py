@@ -1,0 +1,48 @@
+"""GAN losses: DCGAN sigmoid-BCE and CycleGAN LSGAN/cycle/identity.
+
+Parity targets: DCGAN/tensorflow/main.py:42-53 (BinaryCrossentropy from_logits
+for G and D) and CycleGAN/tensorflow/train.py:14-17,58-72 (LSGAN = MSE against
+ones/zeros, cycle-consistency L1 with lambda=10, identity L1 with lambda=5).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import optax
+
+CYCLE_LAMBDA = 10.0
+IDENTITY_LAMBDA = 5.0
+
+
+# -- DCGAN (non-saturating BCE) ---------------------------------------------
+
+def bce_generator_loss(fake_logits):
+    return jnp.mean(
+        optax.sigmoid_binary_cross_entropy(fake_logits, jnp.ones_like(fake_logits))
+    )
+
+
+def bce_discriminator_loss(real_logits, fake_logits):
+    real = optax.sigmoid_binary_cross_entropy(real_logits, jnp.ones_like(real_logits))
+    fake = optax.sigmoid_binary_cross_entropy(fake_logits, jnp.zeros_like(fake_logits))
+    return jnp.mean(real) + jnp.mean(fake)
+
+
+# -- LSGAN (CycleGAN) --------------------------------------------------------
+
+def lsgan_generator_loss(fake_logits):
+    return jnp.mean(jnp.square(fake_logits - 1.0))
+
+
+def lsgan_discriminator_loss(real_logits, fake_logits):
+    # 0.5 factor per the CycleGAN paper (slows D relative to G)
+    return 0.5 * (
+        jnp.mean(jnp.square(real_logits - 1.0)) + jnp.mean(jnp.square(fake_logits))
+    )
+
+
+def cycle_consistency_loss(real, reconstructed, weight: float = CYCLE_LAMBDA):
+    return weight * jnp.mean(jnp.abs(real - reconstructed))
+
+
+def identity_loss(real, same, weight: float = IDENTITY_LAMBDA):
+    return weight * jnp.mean(jnp.abs(real - same))

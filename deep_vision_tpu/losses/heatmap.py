@@ -1,0 +1,73 @@
+"""Pose heatmap loss (Stacked Hourglass) and CenterNet losses.
+
+Parity targets:
+- Hourglass weighted MSE (Hourglass/tensorflow/train.py:65-76): foreground
+  pixels weighted x(81+1), summed over all stacks (intermediate supervision).
+- CenterNet focal + L1 losses: the reference left these EMPTY
+  (ObjectsAsPoints/tensorflow/train.py:35 `loss_objects = []`, SURVEY.md §2.9);
+  implemented here from the ObjectsAsPoints paper (eq. 1: penalty-reduced
+  pixel-wise focal loss with alpha=2/beta=4; eq. 3: L1 size loss weighted 0.1;
+  offset L1).
+
+CenterNet batch convention (dense, static-shape):
+  batch['heatmap'] : (B, H, W, C) gaussian class heatmaps in [0, 1]
+  batch['wh']      : (B, H, W, 2) box sizes written at center pixels
+  batch['offset']  : (B, H, W, 2) sub-pixel offsets at center pixels
+  batch['mask']    : (B, H, W)   1.0 exactly at object centers
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+FOREGROUND_WEIGHT = 81.0  # Hourglass/tensorflow/train.py:69
+
+
+def hourglass_loss_fn(outputs, batch, fg_threshold: float = 0.1):
+    """outputs: list of per-stack (B, H, W, K) heatmaps; batch['heatmap'] GT."""
+    gt = batch["heatmap"]
+    weights = jnp.where(gt > fg_threshold, 1.0 + FOREGROUND_WEIGHT, 1.0)
+    total = 0.0
+    for hm in outputs:
+        total = total + jnp.mean(jnp.square(hm - gt) * weights)
+    metrics = {"loss": total, "last_stack_mse": jnp.mean(jnp.square(outputs[-1] - gt))}
+    return total, metrics
+
+
+def centernet_focal_loss(pred_logits, gt, alpha: float = 2.0, beta: float = 4.0):
+    """Penalty-reduced pixel-wise focal loss, normalized by object count."""
+    p = jax.nn.sigmoid(pred_logits)
+    p = jnp.clip(p, 1e-6, 1.0 - 1e-6)
+    pos = jnp.where(gt >= 1.0 - 1e-6, 1.0, 0.0)
+    pos_loss = pos * jnp.power(1.0 - p, alpha) * jnp.log(p)
+    neg_loss = (
+        (1.0 - pos)
+        * jnp.power(1.0 - gt, beta)
+        * jnp.power(p, alpha)
+        * jnp.log(1.0 - p)
+    )
+    num_pos = jnp.maximum(jnp.sum(pos), 1.0)
+    return -(jnp.sum(pos_loss) + jnp.sum(neg_loss)) / num_pos
+
+
+def _masked_l1(pred, gt, mask):
+    num = jnp.maximum(jnp.sum(mask), 1.0)
+    return jnp.sum(jnp.abs(pred - gt) * mask[..., None]) / num
+
+
+def centernet_loss_fn(outputs, batch, wh_weight: float = 0.1,
+                      offset_weight: float = 1.0):
+    """outputs: list of per-stack dicts {'heatmap','wh','offset'} (raw logits)."""
+    total = 0.0
+    metrics = {}
+    for i, head in enumerate(outputs):
+        hm_loss = centernet_focal_loss(head["heatmap"], batch["heatmap"])
+        wh_loss = _masked_l1(head["wh"], batch["wh"], batch["mask"])
+        off_loss = _masked_l1(head["offset"], batch["offset"], batch["mask"])
+        total = total + hm_loss + wh_weight * wh_loss + offset_weight * off_loss
+        if i == len(outputs) - 1:
+            metrics.update(
+                {"hm_loss": hm_loss, "wh_loss": wh_loss, "offset_loss": off_loss}
+            )
+    metrics["loss"] = total
+    return total, metrics

@@ -1,0 +1,119 @@
+"""YOLOv3 loss, fully vectorized and static-shape.
+
+Parity target: YoloLoss at YOLO/tensorflow/yolov3.py:352-563 — per-scale loss
+with (xy, wh, class, obj) breakdown, lambda_coord=5 / lambda_noobj=0.5
+(:357-358), small-box weight 2 - w*h (:407), and the ignore mask computed by
+broadcast IoU of decoded predictions against the ground-truth boxes
+(:436-470; the reference gathers top-100 boxes out of the label grid — here
+the padded GT box list rides in the batch directly, which is both cheaper and
+exact).
+
+Batch convention (built by data/detection.py):
+  batch['labels']  : tuple over scales of (B, g, g, A, 5+C) target grids
+                     with [x, y, w, h, obj, onehot] (absolute normalized xywh)
+  batch['boxes']   : (B, max_boxes, 4) padded GT boxes, xywh normalized
+"""
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from deep_vision_tpu.ops.anchors import YOLO_ANCHOR_MASKS, YOLO_ANCHORS
+from deep_vision_tpu.ops.boxes import (
+    broadcast_iou,
+    decode_yolo_boxes,
+    encode_yolo_boxes,
+    xywh_to_xyxy,
+)
+
+LAMBDA_COORD = 5.0
+LAMBDA_NOOBJ = 0.5
+
+
+def yolo_loss_per_scale(
+    pred,
+    target,
+    gt_boxes,
+    anchors,
+    ignore_thresh: float = 0.5,
+):
+    """pred (B,g,g,A,5+C) raw logits; target same shape; gt_boxes (B,N,4) xywh."""
+    b, gy, gx, na, _ = pred.shape
+    obj_mask = target[..., 4]  # (B,g,g,A)
+    true_xywh = target[..., 0:4]
+    true_class = target[..., 5:]
+
+    # regression targets in t-space (inverse of the decode)
+    t_true = encode_yolo_boxes(true_xywh, anchors, gy)
+    pred_xy = jax.nn.sigmoid(pred[..., 0:2])
+    pred_twh = pred[..., 2:4]
+
+    # small boxes get up-weighted (yolov3.py:407)
+    box_scale = jnp.where(
+        obj_mask > 0, 2.0 - true_xywh[..., 2] * true_xywh[..., 3], 0.0
+    )
+
+    xy_loss = jnp.sum(
+        jnp.square(pred_xy - t_true[..., 0:2]), axis=-1
+    ) * box_scale * obj_mask
+    wh_loss = jnp.sum(
+        jnp.square(pred_twh - t_true[..., 2:4]), axis=-1
+    ) * box_scale * obj_mask
+
+    # ignore mask: decoded predictions overlapping ANY gt box are not
+    # penalized as background (yolov3.py:436-470)
+    pred_boxes, pred_obj, _ = decode_yolo_boxes(pred, anchors)
+    gt_xyxy = xywh_to_xyxy(gt_boxes)  # (B, N, 4)
+    flat_pred = pred_boxes.reshape(b, -1, 4)
+    best_iou = jnp.max(broadcast_iou(flat_pred, gt_xyxy), axis=-1)  # (B, g*g*A)
+    # padded gt rows are zero-area -> IoU 0, harmless
+    ignore = (best_iou > ignore_thresh).reshape(b, gy, gx, na)
+
+    obj_bce = optax.sigmoid_binary_cross_entropy(pred[..., 4], obj_mask)
+    obj_loss = obj_mask * obj_bce
+    noobj_loss = (1.0 - obj_mask) * (1.0 - ignore) * obj_bce
+
+    class_bce = optax.sigmoid_binary_cross_entropy(pred[..., 5:], true_class)
+    class_loss = obj_mask * jnp.sum(class_bce, axis=-1)
+
+    def _mean(x):  # per-image sum, batch mean (matches reduce_sum/batch)
+        return jnp.mean(jnp.sum(x, axis=(1, 2, 3)))
+
+    losses = {
+        "xy": LAMBDA_COORD * _mean(xy_loss),
+        "wh": LAMBDA_COORD * _mean(wh_loss),
+        "obj": _mean(obj_loss),
+        "noobj": LAMBDA_NOOBJ * _mean(noobj_loss),
+        "class": _mean(class_loss),
+    }
+    losses["total"] = sum(losses.values())
+    return losses
+
+
+def yolo_loss_fn(
+    outputs,
+    batch,
+    anchors=YOLO_ANCHORS,
+    anchor_masks=YOLO_ANCHOR_MASKS,
+    ignore_thresh: float = 0.5,
+):
+    """Trainer-compatible loss: sums the 3 per-scale losses (yolov3.py:81-95)."""
+    anchors = jnp.asarray(anchors)
+    total = 0.0
+    metrics = {}
+    names = ("large", "medium", "small")
+    for i, (pred, target) in enumerate(zip(outputs, batch["labels"])):
+        scale_anchors = anchors[jnp.asarray(anchor_masks[i])]
+        losses = yolo_loss_per_scale(
+            pred, target, batch["boxes"], scale_anchors, ignore_thresh
+        )
+        total = total + losses["total"]
+        metrics[f"loss_{names[i]}"] = losses["total"]
+        if i == 0:  # breakdown for one scale keeps metric volume sane
+            for k in ("xy", "wh", "obj", "noobj", "class"):
+                metrics[f"{names[i]}_{k}"] = losses[k]
+    metrics["loss"] = total
+    return total, metrics

@@ -1,0 +1,244 @@
+"""GAN trainers: DCGAN (twin-update) and CycleGAN (2G + 2D + image pool).
+
+Parity targets: the twin-GradientTape `train_step` at DCGAN/tensorflow/main.py:55-71
+(one noise batch drives both G and D updates) and the CycleGAN loop at
+CycleGAN/tensorflow/train.py:150-265: `train_generator` (one tape over both
+generators: adversarial + cycle + identity), host-side `ImagePool.query`
+between the G and D steps (utils.py:32-61 — eager-only in the reference;
+here it is host-side numpy state BETWEEN two jitted SPMD steps, which is the
+TPU-native factoring of the same replay buffer), then `train_discriminator`.
+
+Each sub-network is its own TrainState, so optimizers/schedules stay
+independent (Adam beta1=0.5 etc., train.py:130-131).
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from deep_vision_tpu.core.train_state import TrainState, create_train_state
+from deep_vision_tpu.losses.gan import (
+    bce_discriminator_loss,
+    bce_generator_loss,
+    cycle_consistency_loss,
+    identity_loss,
+    lsgan_discriminator_loss,
+    lsgan_generator_loss,
+)
+from deep_vision_tpu.parallel.mesh import create_mesh, replicated, shard_batch
+
+
+class ImagePool:
+    """Replay buffer of generated images (CycleGAN/tensorflow/utils.py:32-61).
+
+    Host-side by construction: lives between the jitted G and D steps.
+    """
+
+    def __init__(self, size: int = 50, seed: int = 0):
+        self.size = size
+        self.images: list[np.ndarray] = []
+        self.rng = np.random.RandomState(seed)
+
+    def query(self, batch: np.ndarray) -> np.ndarray:
+        if self.size == 0:
+            return batch
+        out = []
+        for img in np.asarray(batch):
+            if len(self.images) < self.size:
+                self.images.append(img)
+                out.append(img)
+            elif self.rng.rand() < 0.5:
+                idx = self.rng.randint(self.size)
+                out.append(self.images[idx])
+                self.images[idx] = img
+            else:
+                out.append(img)
+        return np.stack(out)
+
+
+def _apply(state: TrainState, x, rng, train=True):
+    variables = {"params": state.params}
+    mutable = False
+    if state.batch_stats:
+        variables["batch_stats"] = state.batch_stats
+        mutable = ["batch_stats"]
+    out = state.apply_fn(
+        variables, x, train=train, rngs={"dropout": rng}, mutable=mutable
+    )
+    if mutable:
+        return out[0], out[1].get("batch_stats", {})
+    return out, {}
+
+
+class DcganTrainer:
+    """Alternating (actually simultaneous, like the reference) G/D updates."""
+
+    def __init__(self, generator, discriminator, g_tx, d_tx,
+                 latent_dim: int = 100, image_shape=(28, 28, 1),
+                 mesh=None, rng: Optional[jax.Array] = None):
+        self.mesh = mesh if mesh is not None else create_mesh()
+        self.latent_dim = latent_dim
+        rng = rng if rng is not None else jax.random.PRNGKey(0)
+        g_rng, d_rng = jax.random.split(rng)
+        g_state = create_train_state(
+            generator, g_tx, jnp.zeros((2, latent_dim)), g_rng
+        )
+        d_state = create_train_state(
+            discriminator, d_tx, jnp.zeros((2, *image_shape)), d_rng
+        )
+        self.g_state = jax.device_put(g_state, replicated(self.mesh))
+        self.d_state = jax.device_put(d_state, replicated(self.mesh))
+        self._step = jax.jit(self._step_impl, donate_argnums=(0, 1))
+
+    def _step_impl(self, g_state: TrainState, d_state: TrainState, real):
+        rng = jax.random.fold_in(g_state.rng, g_state.step)
+        z_rng, g_rng, d_rng = jax.random.split(rng, 3)
+        noise = jax.random.normal(z_rng, (real.shape[0], self.latent_dim))
+
+        def g_loss_fn(g_params):
+            fake, g_bs = _apply(g_state.replace(params=g_params), noise, g_rng)
+            fake_logits, _ = _apply(d_state, fake, d_rng)
+            return bce_generator_loss(fake_logits), (g_bs, fake)
+
+        def d_loss_fn(d_params, fake):
+            ds = d_state.replace(params=d_params)
+            real_logits, d_bs = _apply(ds, real, d_rng)
+            fake_logits, _ = _apply(ds, fake, d_rng)
+            return bce_discriminator_loss(real_logits, fake_logits), d_bs
+
+        (g_loss, (g_bs, fake)), g_grads = jax.value_and_grad(
+            g_loss_fn, has_aux=True
+        )(g_state.params)
+        (d_loss, d_bs), d_grads = jax.value_and_grad(d_loss_fn, has_aux=True)(
+            d_state.params, jax.lax.stop_gradient(fake)
+        )
+        g_state = g_state.apply_gradients(g_grads)
+        d_state = d_state.apply_gradients(d_grads)
+        if g_bs:
+            g_state = g_state.replace(batch_stats=g_bs)
+        if d_bs:
+            d_state = d_state.replace(batch_stats=d_bs)
+        return g_state, d_state, {"g_loss": g_loss, "d_loss": d_loss}
+
+    def train_step(self, real_images) -> dict:
+        real = shard_batch(self.mesh, np.asarray(real_images))
+        self.g_state, self.d_state, metrics = self._step(
+            self.g_state, self.d_state, real
+        )
+        return metrics
+
+    def generate(self, n: int, seed: int = 0):
+        noise = jax.random.normal(jax.random.PRNGKey(seed), (n, self.latent_dim))
+        out, _ = _apply(self.g_state, noise, jax.random.PRNGKey(0), train=False)
+        return out
+
+
+class CycleGanTrainer:
+    """A<->B translation: G_ab, G_ba, D_a, D_b + two image pools."""
+
+    def __init__(self, gen_ab, gen_ba, disc_a, disc_b, g_tx_fn: Callable,
+                 d_tx_fn: Callable, image_shape=(256, 256, 3), mesh=None,
+                 pool_size: int = 50, rng: Optional[jax.Array] = None):
+        self.mesh = mesh if mesh is not None else create_mesh()
+        rng = rng if rng is not None else jax.random.PRNGKey(0)
+        rngs = jax.random.split(rng, 4)
+        sample = jnp.zeros((2, *image_shape))
+        put = lambda s: jax.device_put(s, replicated(self.mesh))
+        self.gab = put(create_train_state(gen_ab, g_tx_fn(), sample, rngs[0]))
+        self.gba = put(create_train_state(gen_ba, g_tx_fn(), sample, rngs[1]))
+        self.da = put(create_train_state(disc_a, d_tx_fn(), sample, rngs[2]))
+        self.db = put(create_train_state(disc_b, d_tx_fn(), sample, rngs[3]))
+        self.pool_a = ImagePool(pool_size, seed=1)
+        self.pool_b = ImagePool(pool_size, seed=2)
+        self._g_step = jax.jit(self._g_step_impl, donate_argnums=(0, 1))
+        self._d_step = jax.jit(self._d_step_impl, donate_argnums=(0, 1))
+
+    # generator step: one grad over BOTH generators (train.py:150-205)
+    def _g_step_impl(self, gab: TrainState, gba: TrainState, da, db, real_a, real_b):
+        rng = jax.random.fold_in(gab.rng, gab.step)
+
+        def loss_fn(params):
+            gab_p, gba_p = params
+            fake_b, gab_bs = _apply(gab.replace(params=gab_p), real_a, rng)
+            fake_a, gba_bs = _apply(gba.replace(params=gba_p), real_b, rng)
+            cycled_a, _ = _apply(gba.replace(params=gba_p), fake_b, rng)
+            cycled_b, _ = _apply(gab.replace(params=gab_p), fake_a, rng)
+            same_a, _ = _apply(gba.replace(params=gba_p), real_a, rng)
+            same_b, _ = _apply(gab.replace(params=gab_p), real_b, rng)
+            logits_fake_b, _ = _apply(db, fake_b, rng)
+            logits_fake_a, _ = _apply(da, fake_a, rng)
+            adv = lsgan_generator_loss(logits_fake_b) + lsgan_generator_loss(
+                logits_fake_a
+            )
+            cyc = cycle_consistency_loss(real_a, cycled_a) + cycle_consistency_loss(
+                real_b, cycled_b
+            )
+            ident = identity_loss(real_a, same_a) + identity_loss(real_b, same_b)
+            total = adv + cyc + ident
+            aux = {
+                "adv": adv, "cycle": cyc, "identity": ident,
+                "fake_a": fake_a, "fake_b": fake_b,
+                "gab_bs": gab_bs, "gba_bs": gba_bs,
+            }
+            return total, aux
+
+        (g_loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            (gab.params, gba.params)
+        )
+        gab = gab.apply_gradients(grads[0])
+        gba = gba.apply_gradients(grads[1])
+        if aux["gab_bs"]:
+            gab = gab.replace(batch_stats=aux["gab_bs"])
+        if aux["gba_bs"]:
+            gba = gba.replace(batch_stats=aux["gba_bs"])
+        metrics = {"g_loss": g_loss, "g_adv": aux["adv"], "g_cycle": aux["cycle"],
+                   "g_identity": aux["identity"]}
+        return gab, gba, metrics, jax.lax.stop_gradient(aux["fake_a"]), \
+            jax.lax.stop_gradient(aux["fake_b"])
+
+    def _d_step_impl(self, da: TrainState, db: TrainState, real_a, real_b,
+                     fake_a, fake_b):
+        rng = jax.random.fold_in(da.rng, da.step)
+
+        def loss_fn(params):
+            da_p, db_p = params
+            ra, da_bs = _apply(da.replace(params=da_p), real_a, rng)
+            fa, _ = _apply(da.replace(params=da_p), fake_a, rng)
+            rb, db_bs = _apply(db.replace(params=db_p), real_b, rng)
+            fb, _ = _apply(db.replace(params=db_p), fake_b, rng)
+            loss = lsgan_discriminator_loss(ra, fa) + lsgan_discriminator_loss(rb, fb)
+            return loss, (da_bs, db_bs)
+
+        (d_loss, (da_bs, db_bs)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True
+        )((da.params, db.params))
+        da = da.apply_gradients(grads[0])
+        db = db.apply_gradients(grads[1])
+        if da_bs:
+            da = da.replace(batch_stats=da_bs)
+        if db_bs:
+            db = db.replace(batch_stats=db_bs)
+        return da, db, {"d_loss": d_loss}
+
+    def train_step(self, real_a, real_b) -> dict:
+        real_a = shard_batch(self.mesh, np.asarray(real_a))
+        real_b = shard_batch(self.mesh, np.asarray(real_b))
+        self.gab, self.gba, g_metrics, fake_a, fake_b = self._g_step(
+            self.gab, self.gba, self.da, self.db, real_a, real_b
+        )
+        # host boundary: replay-buffer query between the two jitted steps
+        fake_a = shard_batch(self.mesh, self.pool_a.query(np.asarray(fake_a)))
+        fake_b = shard_batch(self.mesh, self.pool_b.query(np.asarray(fake_b)))
+        self.da, self.db, d_metrics = self._d_step(
+            self.da, self.db, real_a, real_b, fake_a, fake_b
+        )
+        return {**g_metrics, **d_metrics}
+
+    def translate(self, images_a):
+        out, _ = _apply(self.gab, jnp.asarray(images_a), jax.random.PRNGKey(0),
+                        train=False)
+        return out

@@ -1,0 +1,129 @@
+"""Golden-value and behavioral tests for task losses."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deep_vision_tpu.losses.classification import (
+    classification_loss_fn,
+    cross_entropy_loss,
+)
+from deep_vision_tpu.losses.heatmap import (
+    centernet_focal_loss,
+    centernet_loss_fn,
+    hourglass_loss_fn,
+)
+from deep_vision_tpu.losses.yolo import yolo_loss_fn, yolo_loss_per_scale
+from deep_vision_tpu.ops import YOLO_ANCHORS, assign_anchors_to_grid
+
+
+def test_cross_entropy_golden():
+    # uniform logits over 4 classes -> CE = log(4)
+    logits = jnp.zeros((3, 4))
+    labels = jnp.array([0, 1, 2])
+    assert cross_entropy_loss(logits, labels) == pytest.approx(np.log(4), abs=1e-5)
+
+
+def test_cross_entropy_masked_ignores_padding():
+    logits = jnp.array([[10.0, 0.0], [0.0, 10.0]])
+    labels = jnp.array([0, 0])  # second row is wrong on purpose
+    w = jnp.array([1.0, 0.0])  # ...but masked out
+    assert cross_entropy_loss(logits, labels, weights=w) == pytest.approx(0.0, abs=1e-3)
+
+
+def test_classification_aux_heads_add_loss():
+    labels = jnp.array([0, 1])
+    logits = jnp.zeros((2, 4))
+    loss_no_aux, _ = classification_loss_fn(logits, {"label": labels})
+    loss_aux, _ = classification_loss_fn(
+        (logits, logits, logits), {"label": labels}
+    )
+    assert loss_aux == pytest.approx(float(loss_no_aux) * 1.6, rel=1e-5)  # 1 + 2*0.3
+
+
+def _yolo_batch(g=13, num_classes=5):
+    boxes = jnp.array([[[0.5, 0.5, 0.4, 0.35], [0.0, 0.0, 0.0, 0.0]]])
+    classes = jnp.array([[3, 0]])
+    targets = jax.vmap(
+        lambda b, c: assign_anchors_to_grid(b, c, (13, 26, 52), num_classes=num_classes)
+    )(boxes, classes)
+    return {"labels": tuple(targets), "boxes": boxes}
+
+
+def test_yolo_loss_perfect_prediction_near_zero_regression():
+    """A prediction that decodes exactly to the target has ~zero xy/wh/class loss."""
+    num_classes = 5
+    batch = _yolo_batch()
+    target = batch["labels"][0]  # (1, 13, 13, 3, 10)
+    anchors = jnp.asarray(YOLO_ANCHORS[[6, 7, 8]])
+
+    from deep_vision_tpu.ops.boxes import encode_yolo_boxes
+
+    t = encode_yolo_boxes(target[..., 0:4], anchors, 13)
+    # build raw logits that reproduce the target exactly where obj=1
+    eps = 1e-6
+    t_xy = jnp.clip(t[..., 0:2], eps, 1 - eps)
+    raw_xy = jnp.log(t_xy / (1 - t_xy))  # inverse sigmoid
+    raw = jnp.concatenate(
+        [
+            raw_xy,
+            t[..., 2:4],
+            jnp.where(target[..., 4:5] > 0, 20.0, -20.0),  # obj logits
+            jnp.where(target[..., 5:] > 0, 20.0, -20.0),   # class logits
+        ],
+        axis=-1,
+    )
+    losses = yolo_loss_per_scale(raw, target, batch["boxes"], anchors)
+    assert float(losses["xy"]) == pytest.approx(0.0, abs=1e-3)
+    assert float(losses["wh"]) == pytest.approx(0.0, abs=1e-3)
+    assert float(losses["class"]) == pytest.approx(0.0, abs=1e-3)
+    assert float(losses["obj"]) == pytest.approx(0.0, abs=1e-3)
+    assert float(losses["total"]) < 0.01
+
+
+def test_yolo_loss_fn_runs_and_decreases_with_better_obj():
+    batch = _yolo_batch()
+    preds_bad = tuple(jnp.zeros((1, g, g, 3, 10)) for g in (13, 26, 52))
+    loss_bad, metrics = yolo_loss_fn(preds_bad, batch)
+    assert np.isfinite(float(loss_bad))
+    assert "loss_large" in metrics
+
+
+def test_hourglass_loss_foreground_weighting():
+    gt = jnp.zeros((1, 8, 8, 2)).at[0, 4, 4, 0].set(1.0)
+    # same squared error magnitude, but a foreground miss costs 82x
+    pred_bg_err = [gt.at[0, 0, 0, 0].set(1.0)]  # perfect fg, 1.0 err at bg
+    pred_fg_err = [gt.at[0, 4, 4, 0].set(0.0)]  # 1.0 err at the fg pixel
+    loss_bg, _ = hourglass_loss_fn(pred_bg_err, {"heatmap": gt})
+    loss_fg, _ = hourglass_loss_fn(pred_fg_err, {"heatmap": gt})
+    assert float(loss_fg) == pytest.approx(float(loss_bg) * 82.0, rel=1e-4)
+
+
+def test_centernet_focal_confident_correct_is_small():
+    gt = jnp.zeros((1, 8, 8, 3)).at[0, 4, 4, 1].set(1.0)
+    good = jnp.full((1, 8, 8, 3), -10.0).at[0, 4, 4, 1].set(10.0)
+    bad = jnp.full((1, 8, 8, 3), -10.0).at[0, 4, 4, 1].set(-10.0)
+    assert float(centernet_focal_loss(good, gt)) < 0.01
+    assert float(centernet_focal_loss(bad, gt)) > 1.0
+
+
+def test_centernet_loss_fn_complete():
+    """The loss ObjectsAsPoints never got (reference train.py:35): runs + finite."""
+    h = w = 8
+    batch = {
+        "heatmap": jnp.zeros((1, h, w, 3)).at[0, 4, 4, 1].set(1.0),
+        "wh": jnp.zeros((1, h, w, 2)).at[0, 4, 4].set(jnp.array([2.0, 3.0])),
+        "offset": jnp.zeros((1, h, w, 2)).at[0, 4, 4].set(jnp.array([0.3, 0.7])),
+        "mask": jnp.zeros((1, h, w)).at[0, 4, 4].set(1.0),
+    }
+    outputs = [
+        {
+            "heatmap": jnp.zeros((1, h, w, 3)),
+            "wh": jnp.zeros((1, h, w, 2)),
+            "offset": jnp.zeros((1, h, w, 2)),
+        }
+    ]
+    loss, metrics = centernet_loss_fn(outputs, batch)
+    assert np.isfinite(float(loss))
+    assert metrics["wh_loss"] == pytest.approx(5.0)  # |2|+|3| over 1 object
+    assert metrics["offset_loss"] == pytest.approx(1.0)  # 0.3+0.7

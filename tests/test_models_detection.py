@@ -1,0 +1,60 @@
+"""Shape tests for detection / pose / generative models (small inputs for CPU)."""
+import jax
+import jax.numpy as jnp
+import pytest
+
+from deep_vision_tpu.models import get_model
+
+RNG = jax.random.PRNGKey(0)
+
+
+def _init_apply(model, x, train=False):
+    variables = model.init({"params": RNG, "dropout": RNG}, x, train=train)
+    return model.apply(variables, x, train=train)
+
+
+def test_yolov3_three_scales():
+    model = get_model("yolov3", num_classes=6)
+    out = _init_apply(model, jnp.zeros((1, 64, 64, 3)))
+    assert len(out) == 3
+    assert out[0].shape == (1, 2, 2, 3, 11)   # /32
+    assert out[1].shape == (1, 4, 4, 3, 11)   # /16
+    assert out[2].shape == (1, 8, 8, 3, 11)   # /8
+
+
+def test_darknet53_feature_pyramid():
+    model = get_model("darknet53")
+    c3, c4, c5 = _init_apply(model, jnp.zeros((1, 64, 64, 3)))
+    assert c3.shape == (1, 8, 8, 256)
+    assert c4.shape == (1, 4, 4, 512)
+    assert c5.shape == (1, 2, 2, 1024)
+
+
+def test_hourglass_stacked_heatmaps():
+    model = get_model("hourglass", num_stack=2, num_heatmap=4)
+    out = _init_apply(model, jnp.zeros((1, 64, 64, 3)))
+    assert len(out) == 2
+    for hm in out:
+        assert hm.shape == (1, 16, 16, 4)  # /4 resolution
+
+
+def test_objects_as_points_heads():
+    model = get_model("objects_as_points", num_classes=3, num_stack=1)
+    out = _init_apply(model, jnp.zeros((1, 128, 128, 3)))
+    assert len(out) == 1
+    head = out[0]
+    assert head["heatmap"].shape == (1, 32, 32, 3)
+    assert head["wh"].shape == (1, 32, 32, 2)
+    assert head["offset"].shape == (1, 32, 32, 2)
+
+
+def test_cyclegan_generator_preserves_shape():
+    model = get_model("cyclegan_generator", n_blocks=1, base=8)
+    out = _init_apply(model, jnp.zeros((1, 64, 64, 3)))
+    assert out.shape == (1, 64, 64, 3)
+
+
+def test_patchgan_downsamples_8x():
+    model = get_model("cyclegan_discriminator", base=8)
+    out = _init_apply(model, jnp.zeros((1, 64, 64, 3)))
+    assert out.shape == (1, 8, 8, 1)

@@ -1,0 +1,137 @@
+"""Unit tests for vision ops: IoU, boxes, NMS, anchors, heatmaps.
+
+Exact closed-form cases per SURVEY.md §4's test plan (the reference had none).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deep_vision_tpu.ops import (
+    YOLO_ANCHORS,
+    YOLO_ANCHOR_MASKS,
+    assign_anchors_to_grid,
+    broadcast_iou,
+    decode_yolo_boxes,
+    encode_yolo_boxes,
+    gaussian_heatmaps,
+    non_maximum_suppression,
+    xywh_to_xyxy,
+    xyxy_to_xywh,
+)
+from deep_vision_tpu.ops.heatmaps import centernet_class_heatmap, gaussian_radius
+
+
+def test_box_conversion_roundtrip():
+    boxes = jnp.array([[0.5, 0.5, 0.2, 0.4], [0.1, 0.9, 0.05, 0.1]])
+    assert jnp.allclose(xyxy_to_xywh(xywh_to_xyxy(boxes)), boxes, atol=1e-6)
+    xyxy = xywh_to_xyxy(boxes)
+    assert jnp.allclose(xyxy[0], jnp.array([0.4, 0.3, 0.6, 0.7]), atol=1e-6)
+
+
+def test_broadcast_iou_exact():
+    a = jnp.array([[0.0, 0.0, 1.0, 1.0], [0.0, 0.0, 0.5, 0.5]])
+    b = jnp.array([[0.0, 0.0, 1.0, 1.0], [0.5, 0.5, 1.0, 1.0], [2.0, 2.0, 3.0, 3.0]])
+    iou = broadcast_iou(a, b)
+    assert iou.shape == (2, 3)
+    assert iou[0, 0] == pytest.approx(1.0)
+    assert iou[0, 1] == pytest.approx(0.25)
+    assert iou[0, 2] == pytest.approx(0.0)
+    assert iou[1, 1] == pytest.approx(0.0)  # touching, zero overlap
+
+
+def test_yolo_box_decode_encode_roundtrip():
+    anchors = jnp.asarray(YOLO_ANCHORS[6:9])
+    g = 13
+    raw = jax.random.normal(jax.random.PRNGKey(0), (2, g, g, 3, 9)) * 0.5
+    boxes, obj, probs = decode_yolo_boxes(raw, anchors)
+    assert boxes.shape == (2, g, g, 3, 4)
+    xywh = xyxy_to_xywh(boxes)
+    t = encode_yolo_boxes(xywh, anchors, g)
+    # t_wh must invert exactly; t_xy matches sigmoid(raw_xy)
+    assert jnp.allclose(t[..., 2:4], raw[..., 2:4], atol=1e-4)
+    assert jnp.allclose(t[..., 0:2], jax.nn.sigmoid(raw[..., 0:2]), atol=1e-4)
+
+
+def test_nms_suppresses_overlaps_keeps_distinct():
+    boxes = jnp.array([[[0.1, 0.1, 0.4, 0.4],
+                        [0.12, 0.12, 0.42, 0.42],   # overlaps box 0
+                        [0.6, 0.6, 0.9, 0.9],       # distinct
+                        [0.0, 0.0, 0.0, 0.0]]])     # padding
+    scores = jnp.array([[0.9, 0.8, 0.7, 0.0]])
+    out_b, out_s, out_c, valid = non_maximum_suppression(
+        boxes, scores, max_detections=4, iou_threshold=0.5, score_threshold=0.1
+    )
+    assert int(valid[0]) == 2
+    assert out_s[0, 0] == pytest.approx(0.9)
+    assert out_s[0, 1] == pytest.approx(0.7)
+    assert jnp.allclose(out_b[0, 0], boxes[0, 0])
+    assert jnp.allclose(out_b[0, 1], boxes[0, 2])
+
+
+def test_nms_multilabel_classes_dont_suppress_each_other():
+    boxes = jnp.tile(jnp.array([[[0.1, 0.1, 0.4, 0.4]]]), (1, 2, 1))
+    scores = jnp.array([[0.9, 0.8]])
+    classes = jnp.array([[0, 1]])  # same box, two classes
+    _, out_s, out_c, valid = non_maximum_suppression(
+        boxes, scores, classes, max_detections=4, iou_threshold=0.5,
+        score_threshold=0.1,
+    )
+    assert int(valid[0]) == 2
+    assert set(np.asarray(out_c[0, :2]).tolist()) == {0, 1}
+
+
+def test_anchor_assignment_places_box_in_right_cell():
+    # one large box -> best anchor is in scale 0 (stride 32, anchors 6-8)
+    boxes = jnp.array([[0.5, 0.5, 0.4, 0.35], [0.0, 0.0, 0.0, 0.0]])
+    classes = jnp.array([3, 0])
+    targets = assign_anchors_to_grid(
+        boxes, classes, grid_sizes=(13, 26, 52), num_classes=5
+    )
+    assert [t.shape for t in targets] == [
+        (13, 13, 3, 10), (26, 26, 3, 10), (52, 52, 3, 10)
+    ]
+    # box center 0.5*13 = 6.5 -> cell (6, 6)
+    cell = targets[0][6, 6]  # (3, 10)
+    slot = int(jnp.argmax(cell[:, 4]))
+    assert cell[slot, 4] == 1.0  # objectness
+    assert jnp.allclose(cell[slot, 0:4], boxes[0])
+    assert cell[slot, 5 + 3] == 1.0  # one-hot class
+    # nothing else anywhere: total objectness == 1
+    assert sum(float(jnp.sum(t[..., 4])) for t in targets) == 1.0
+
+
+def test_anchor_assignment_batch_via_vmap():
+    boxes = jnp.zeros((4, 10, 4))
+    classes = jnp.zeros((4, 10), jnp.int32)
+    fn = jax.vmap(
+        lambda b, c: assign_anchors_to_grid(b, c, (13,), num_classes=5)[0]
+    )
+    out = fn(boxes, classes)
+    assert out.shape == (4, 13, 13, 3, 10)
+    assert float(jnp.sum(out)) == 0.0  # all padding -> empty grids
+
+
+def test_gaussian_heatmap_peak_and_visibility():
+    pts = jnp.array([[10.0, 5.0], [-1.0, -1.0]])
+    hm = gaussian_heatmaps(pts, 16, 32, sigma=1.0, visible=jnp.array([1, 1]))
+    assert hm.shape == (16, 32, 2)
+    assert hm[5, 10, 0] == pytest.approx(1.0)  # peak at (y=5, x=10)
+    assert hm[5, 11, 0] == pytest.approx(np.exp(-0.5), abs=1e-5)
+    assert float(jnp.sum(hm[..., 1])) == 0.0  # invisible point -> zeros
+
+
+def test_centernet_heatmap_max_over_objects():
+    centers = jnp.array([[4.0, 4.0], [4.0, 4.0], [0.0, 0.0]])
+    classes = jnp.array([2, 2, 0])
+    wh = jnp.array([[3.0, 3.0], [6.0, 6.0], [0.0, 0.0]])  # third is padding
+    hm = centernet_class_heatmap(centers, classes, wh, 16, 16, num_classes=3)
+    assert hm.shape == (16, 16, 3)
+    assert hm[4, 4, 2] == pytest.approx(1.0)
+    assert float(jnp.sum(hm[..., 0])) == 0.0  # padded object contributes nothing
+
+
+def test_gaussian_radius_monotone_in_box_size():
+    r_small = float(gaussian_radius(jnp.array([4.0, 4.0])))
+    r_big = float(gaussian_radius(jnp.array([40.0, 40.0])))
+    assert 0 < r_small < r_big
