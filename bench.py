@@ -1,0 +1,120 @@
+"""Benchmark: ResNet-50 training throughput (images/sec) on the local chip(s).
+
+Runs the framework's real jitted train step (forward + loss + backward + SGD
+update + BN stat update) on the flagship model with synthetic ImageNet-shaped
+data in bfloat16 compute (fp32 params), and prints ONE JSON line:
+
+    {"metric": ..., "value": N, "unit": "images/sec", "vs_baseline": N}
+
+Baseline: the reference repo publishes no throughput for its classifiers (its
+only perf number is YOLOv3 epoch time, BASELINE.md); the driver's north star
+is ">= 0.9x A100x8 images/sec" for ResNet-50 (BASELINE.json). We normalize
+per chip: an A100 sustains ~2900 images/sec on ResNet-50/224 mixed-precision
+training (MLPerf-class recipe), so the per-chip target is 0.9 * 2900 = 2610
+and vs_baseline = value_per_chip / 2610.
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+A100_IMG_PER_SEC = 2900.0
+TARGET_PER_CHIP = 0.9 * A100_IMG_PER_SEC
+
+BATCH_PER_CHIP = 256
+IMAGE_SIZE = 224
+WARMUP_STEPS = 5
+TIMED_STEPS = 30
+
+
+def main() -> None:
+    from deep_vision_tpu.core.train_state import create_train_state
+    from deep_vision_tpu.losses.classification import classification_loss_fn
+    from deep_vision_tpu.models import get_model
+    from deep_vision_tpu.parallel.mesh import create_mesh, data_sharding, replicated
+    from deep_vision_tpu.train.optimizers import build_optimizer
+
+    devices = jax.devices()
+    n_chips = len(devices)
+    mesh = create_mesh(devices=devices)
+    batch_size = BATCH_PER_CHIP * n_chips
+    print(
+        f"bench: {n_chips}x {devices[0].device_kind} | resnet50 bf16 "
+        f"batch={batch_size} image={IMAGE_SIZE}",
+        file=sys.stderr,
+    )
+
+    model = get_model("resnet50", num_classes=1000, dtype=jnp.bfloat16)
+    tx = build_optimizer("sgd", learning_rate=0.1, momentum=0.9, weight_decay=1e-4)
+    sample = jnp.ones((8, IMAGE_SIZE, IMAGE_SIZE, 3), jnp.float32)
+    state = create_train_state(model, tx, sample)
+    state = jax.device_put(state, replicated(mesh))
+
+    rng = np.random.RandomState(0)
+    batch = {
+        "image": rng.rand(batch_size, IMAGE_SIZE, IMAGE_SIZE, 3).astype(np.float32),
+        "label": rng.randint(0, 1000, size=(batch_size,)).astype(np.int32),
+    }
+    batch = {
+        k: jax.device_put(v, data_sharding(mesh, v.ndim)) for k, v in batch.items()
+    }
+
+    def train_step(state, batch):
+        step_rng = jax.random.fold_in(state.rng, state.step)
+
+        def loss_fn(params):
+            variables = {"params": params, "batch_stats": state.batch_stats}
+            outputs, new_model_state = state.apply_fn(
+                variables,
+                batch["image"],
+                train=True,
+                rngs={"dropout": step_rng},
+                mutable=["batch_stats"],
+            )
+            loss, _ = classification_loss_fn(outputs, batch)
+            return loss, new_model_state["batch_stats"]
+
+        (loss, new_bs), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            state.params
+        )
+        return state.apply_gradients(grads).replace(batch_stats=new_bs), loss
+
+    step = jax.jit(train_step, donate_argnums=0)
+
+    # Timing is closed by a host fetch of the step's loss scalar: on the
+    # experimental axon platform block_until_ready() on a mesh-sharded state
+    # can return before execution completes, but a device->host scalar
+    # transfer cannot.
+    t0 = time.perf_counter()
+    for _ in range(WARMUP_STEPS):
+        state, loss = step(state, batch)
+    float(loss)
+    print(f"bench: warmup {time.perf_counter() - t0:.1f}s", file=sys.stderr)
+
+    t0 = time.perf_counter()
+    for _ in range(TIMED_STEPS):
+        state, loss = step(state, batch)
+    float(loss)
+    dt = time.perf_counter() - t0
+
+    img_per_sec = TIMED_STEPS * batch_size / dt
+    per_chip = img_per_sec / n_chips
+    print(
+        json.dumps(
+            {
+                "metric": "resnet50_train_images_per_sec_per_chip",
+                "value": round(per_chip, 1),
+                "unit": "images/sec/chip",
+                "vs_baseline": round(per_chip / TARGET_PER_CHIP, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -23,8 +23,13 @@ import jax.numpy as jnp
 FOREGROUND_WEIGHT = 81.0  # Hourglass/tensorflow/train.py:69
 
 
-def hourglass_loss_fn(outputs, batch, fg_threshold: float = 0.1):
-    """outputs: list of per-stack (B, H, W, K) heatmaps; batch['heatmap'] GT."""
+def hourglass_loss_fn(outputs, batch, fg_threshold: float = 0.0):
+    """outputs: list of per-stack (B, H, W, K) heatmaps; batch['heatmap'] GT.
+
+    Any strictly-positive GT pixel is foreground (weight 82), exactly matching
+    `cast(labels > 0) * 81 + 1` at Hourglass/tensorflow/train.py:69 — gaussian
+    tail pixels count as foreground too.
+    """
     gt = batch["heatmap"]
     weights = jnp.where(gt > fg_threshold, 1.0 + FOREGROUND_WEIGHT, 1.0)
     total = 0.0

@@ -103,6 +103,33 @@ def shard_batch(mesh: Mesh, batch):
     return jax.tree_util.tree_map(_place, batch)
 
 
+def infer_tp_sharding(tree, mesh: Mesh, min_size: int = 4096):
+    """Tensor-parallel sharding rule for a params/state pytree.
+
+    Shards the output-feature (last) dim of large kernels over the 'model'
+    axis when it divides evenly; everything else (biases, BN stats, scalars)
+    is replicated. XLA's SPMD partitioner propagates the layout through the
+    matmuls/convs and inserts the ICI collectives — the explicit Megatron-style
+    plumbing the reference never had (its only parallelism was single-host DP,
+    SURVEY.md §2.5) falls out of the sharding annotation alone.
+    """
+    m = mesh.shape[MODEL_AXIS]
+
+    def rule(x):
+        shape = getattr(x, "shape", ())
+        size = int(np.prod(shape)) if shape else 0
+        if (
+            m > 1
+            and len(shape) >= 2
+            and shape[-1] % m == 0
+            and size >= min_size
+        ):
+            return NamedSharding(mesh, P(*([None] * (len(shape) - 1) + [MODEL_AXIS])))
+        return NamedSharding(mesh, P())
+
+    return jax.tree_util.tree_map(rule, tree)
+
+
 def pad_batch_to(batch, multiple: int):
     """Pad the leading dim of every leaf up to `multiple` (TPU static shapes).
 

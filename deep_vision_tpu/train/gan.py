@@ -48,13 +48,14 @@ class ImagePool:
             return batch
         out = []
         for img in np.asarray(batch):
+            # copy: a row view would pin the whole batch array in the pool
             if len(self.images) < self.size:
-                self.images.append(img)
+                self.images.append(img.copy())
                 out.append(img)
             elif self.rng.rand() < 0.5:
                 idx = self.rng.randint(self.size)
                 out.append(self.images[idx])
-                self.images[idx] = img
+                self.images[idx] = img.copy()
             else:
                 out.append(img)
         return np.stack(out)

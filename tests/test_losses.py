@@ -81,12 +81,19 @@ def test_yolo_loss_perfect_prediction_near_zero_regression():
     assert float(losses["total"]) < 0.01
 
 
-def test_yolo_loss_fn_runs_and_decreases_with_better_obj():
+def test_yolo_loss_fn_decreases_with_better_obj():
     batch = _yolo_batch()
     preds_bad = tuple(jnp.zeros((1, g, g, 3, 10)) for g in (13, 26, 52))
     loss_bad, metrics = yolo_loss_fn(preds_bad, batch)
     assert np.isfinite(float(loss_bad))
     assert "loss_large" in metrics
+    # objectness logits that match the GT obj mask must lower the loss
+    preds_good = tuple(
+        p.at[..., 4].set(jnp.where(t[..., 4] > 0, 20.0, -20.0))
+        for p, t in zip(preds_bad, batch["labels"])
+    )
+    loss_good, _ = yolo_loss_fn(preds_good, batch)
+    assert float(loss_good) < float(loss_bad)
 
 
 def test_hourglass_loss_foreground_weighting():
