@@ -1,0 +1,47 @@
+"""Data layer: record IO, dataset readers, host-side transforms, device feed.
+
+TPU-native replacement for the reference's two input stacks — the per-model
+cv2/PIL python Datasets (ResNet/pytorch/data_load.py:14-69) and the
+tf.data+TFRecord pipelines (YOLO/tensorflow/train.py:260-273,
+ResNet/tensorflow/train.py:148-214). One layer, shared by every model:
+
+- `records` / `example_codec`: TFRecord-compatible container + tf.train.Example
+  wire codec, implemented natively (no TensorFlow dependency) so the same
+  shard files the reference's converters produced remain readable;
+- `datasets`: MNIST idx, ImageNet folder, and record-backed datasets with the
+  reference's Example schemas (ImageNet 9-field, VOC/COCO boxes, MPII joints);
+- `transforms`: the hand-written numpy/PIL augmentation set
+  (Rescale/RandomCrop/CenterCrop/Flip/ColorJitter/Normalize) plus the
+  bbox-preserving detection augments;
+- `pipeline`: threaded decode/augment workers -> fixed-shape batches ->
+  `shard_batch` onto the mesh (the host->device boundary).
+"""
+from deep_vision_tpu.data.example_codec import decode_example, encode_example
+from deep_vision_tpu.data.records import (
+    RecordWriter,
+    read_records,
+    record_iterator,
+    write_records,
+)
+from deep_vision_tpu.data.datasets import (
+    ImageFolderDataset,
+    MnistDataset,
+    RecordDataset,
+)
+from deep_vision_tpu.data import transforms
+from deep_vision_tpu.data.pipeline import DataLoader, Compose
+
+__all__ = [
+    "decode_example",
+    "encode_example",
+    "RecordWriter",
+    "read_records",
+    "record_iterator",
+    "write_records",
+    "ImageFolderDataset",
+    "MnistDataset",
+    "RecordDataset",
+    "transforms",
+    "DataLoader",
+    "Compose",
+]

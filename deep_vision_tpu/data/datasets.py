@@ -1,0 +1,216 @@
+"""Dataset readers: MNIST idx, ImageNet folder, record-backed with schemas.
+
+Parity targets: MnistDataset's idx parser (LeNet/pytorch/data_load.py:24-48),
+ImageNet2012Dataset's flattened-folder reader with filename-prefix labels
+(ResNet/pytorch/data_load.py:14-69), and the Example schemas of the
+reference's converters (ImageNet: build_imagenet_tfrecord.py:184+; VOC/COCO:
+Datasets/VOC2007/tfrecords.py:38-95; MPII: tfrecords_mpii.py:65-84).
+
+A Dataset is anything with __len__ + __getitem__(i) -> sample dict (the torch
+Dataset contract, kept because it composes with the threaded DataLoader), or
+an iterable of sample dicts for record streams.
+"""
+from __future__ import annotations
+
+import io
+import os
+import struct
+from typing import Callable, Dict, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from deep_vision_tpu.data.example_codec import decode_example
+from deep_vision_tpu.data.records import expand_shards, read_records
+
+
+def decode_image(data: bytes, channels: int = 3) -> np.ndarray:
+    """JPEG/PNG bytes -> HWC uint8 RGB numpy (cv2 fast path, BGR->RGB like
+    ResNet/pytorch/data_load.py:53-54; PIL fallback)."""
+    try:
+        import cv2
+
+        img = cv2.imdecode(np.frombuffer(data, np.uint8), cv2.IMREAD_COLOR)
+        if img is None:
+            raise ValueError("cv2.imdecode failed")
+        return img[:, :, ::-1].copy()  # BGR -> RGB
+    except Exception:
+        from PIL import Image
+
+        img = Image.open(io.BytesIO(data))
+        img = img.convert("RGB" if channels == 3 else "L")
+        arr = np.asarray(img)
+        if arr.ndim == 2:
+            arr = arr[:, :, None]
+        return arr
+
+
+# -- MNIST idx ---------------------------------------------------------------
+
+class MnistDataset:
+    """MNIST idx-format reader (LeNet/pytorch/data_load.py:24-48).
+
+    Unlike the reference (whole set normalized eagerly in __init__), decoding
+    is lazy per item; `pad_to_32` reproduces the 28->32 zero-pad for LeNet-5.
+    """
+
+    def __init__(self, images_path: str, labels_path: str, pad_to_32: bool = True):
+        self.images = self._read_idx(images_path)
+        self.labels = self._read_idx(labels_path)
+        assert len(self.images) == len(self.labels)
+        self.pad_to_32 = pad_to_32
+
+    @staticmethod
+    def _read_idx(path: str) -> np.ndarray:
+        with open(path, "rb") as f:
+            data = f.read()
+        zero, dtype_code, ndim = data[0] << 8 | data[1], data[2], data[3]
+        assert zero == 0, f"bad idx magic in {path}"
+        dtypes = {0x08: np.uint8, 0x09: np.int8, 0x0B: np.int16,
+                  0x0C: np.int32, 0x0D: np.float32, 0x0E: np.float64}
+        shape = struct.unpack(f">{ndim}I", data[4:4 + 4 * ndim])
+        arr = np.frombuffer(data, dtypes[dtype_code], offset=4 + 4 * ndim)
+        return arr.reshape(shape)
+
+    def __len__(self) -> int:
+        return len(self.images)
+
+    def __getitem__(self, i: int) -> dict:
+        img = self.images[i]
+        if img.ndim == 2:
+            img = img[:, :, None]
+        if self.pad_to_32 and img.shape[0] == 28:
+            img = np.pad(img, ((2, 2), (2, 2), (0, 0)))
+        return {"image": img, "label": np.int32(self.labels[i])}
+
+
+# -- ImageNet folder ---------------------------------------------------------
+
+class ImageFolderDataset:
+    """Flattened-folder ImageNet reader: label parsed from the filename's
+    synset prefix, vocab from synsets.txt (ResNet/pytorch/data_load.py:14-69).
+    """
+
+    def __init__(
+        self,
+        root: str,
+        synsets_path: Optional[str] = None,
+        extensions: Sequence[str] = (".jpeg", ".jpg", ".png"),
+    ):
+        self.root = root
+        self.files = sorted(
+            f for f in os.listdir(root)
+            if f.lower().endswith(tuple(extensions))
+        )
+        if synsets_path:
+            with open(synsets_path) as f:
+                synsets = [line.strip().split()[0] for line in f if line.strip()]
+        else:
+            synsets = sorted({f.split("_")[0] for f in self.files})
+        self.label_of = {s: i for i, s in enumerate(synsets)}
+
+    def __len__(self) -> int:
+        return len(self.files)
+
+    def __getitem__(self, i: int) -> dict:
+        name = self.files[i]
+        with open(os.path.join(self.root, name), "rb") as f:
+            img = decode_image(f.read())
+        synset = name.split("_")[0]
+        return {"image": img, "label": np.int32(self.label_of[synset])}
+
+
+# -- record-backed datasets --------------------------------------------------
+
+def imagenet_schema(feats: Dict[str, list]) -> dict:
+    """9-field ImageNet Example (_parse_function at
+    ResNet/tensorflow/train.py:150-160; writer build_imagenet_tfrecord.py:184+).
+    Labels there are 1-based (0 is background): shift to 0-based."""
+    return {
+        "image": decode_image(feats["image/encoded"][0]),
+        "label": np.int32(feats["image/class/label"][0] - 1),
+    }
+
+
+def _box_schema(feats: Dict[str, list], class_key: str) -> dict:
+    n = len(feats.get("image/object/bbox/xmin", ()))
+    boxes = np.zeros((n, 4), np.float32)
+    if n:
+        boxes[:, 0] = feats["image/object/bbox/xmin"]
+        boxes[:, 1] = feats["image/object/bbox/ymin"]
+        boxes[:, 2] = feats["image/object/bbox/xmax"]
+        boxes[:, 3] = feats["image/object/bbox/ymax"]
+    classes = np.asarray(feats.get(class_key, [0] * n), np.int32)
+    return {
+        "image": decode_image(feats["image/encoded"][0]),
+        "boxes": boxes,
+        "classes": classes,
+    }
+
+
+def voc_schema(feats: Dict[str, list]) -> dict:
+    """Normalized-bbox VOC Example (Datasets/VOC2007/tfrecords.py:38-95)."""
+    return _box_schema(feats, "image/object/class/label")
+
+
+def coco_schema(feats: Dict[str, list]) -> dict:
+    """COCO Example (Datasets/MSCOCO/tfrecords.py): same bbox layout."""
+    return _box_schema(feats, "image/object/class/label")
+
+
+def mpii_schema(feats: Dict[str, list]) -> dict:
+    """MPII keypoint Example (Datasets/MPII/tfrecords_mpii.py:65-84):
+    normalized joint x/y + visibility, 16 joints."""
+    x = np.asarray(feats["image/person/keypoints/x"], np.float32)
+    y = np.asarray(feats["image/person/keypoints/y"], np.float32)
+    v = np.asarray(feats["image/person/keypoints/visibility"], np.float32)
+    return {
+        "image": decode_image(feats["image/encoded"][0]),
+        "keypoints": np.stack([x, y], axis=-1),
+        "visibility": v,
+    }
+
+
+def image_only_schema(feats: Dict[str, list]) -> dict:
+    """Single-image Example (CycleGAN/tensorflow/tfrecords.py)."""
+    return {"image": decode_image(feats["image/encoded"][0])}
+
+
+SCHEMAS: Dict[str, Callable] = {
+    "imagenet": imagenet_schema,
+    "voc": voc_schema,
+    "coco": coco_schema,
+    "mpii": mpii_schema,
+    "image_only": image_only_schema,
+}
+
+
+class RecordDataset:
+    """Iterable dataset over record shards with an Example schema.
+
+    Streams (no random access — record files are sequential by design);
+    reshuffles shard order per epoch when `shuffle_shards`.
+    """
+
+    def __init__(
+        self,
+        pattern,
+        schema: str | Callable = "imagenet",
+        shuffle_shards: bool = False,
+        seed: int = 0,
+        shard_index: int = 0,
+        num_shards: int = 1,
+    ):
+        self.files = expand_shards(pattern)[shard_index::num_shards]
+        self.schema = SCHEMAS[schema] if isinstance(schema, str) else schema
+        self.shuffle_shards = shuffle_shards
+        self.seed = seed
+        self._epoch = 0
+
+    def __iter__(self) -> Iterator[dict]:
+        files = list(self.files)
+        if self.shuffle_shards:
+            np.random.RandomState(self.seed + self._epoch).shuffle(files)
+        self._epoch += 1
+        for path in files:
+            for raw in read_records(path):
+                yield self.schema(decode_example(raw))

@@ -1,0 +1,174 @@
+"""Host input pipeline: transform workers -> shuffle -> fixed-shape batches.
+
+The TPU-feed replacement for both reference input stacks: torch DataLoader
+with worker processes (ResNet/pytorch/train.py:218-257) and
+tf.data map(AUTOTUNE)/shuffle/batch/prefetch chains
+(YOLO/tensorflow/train.py:260-273). Decode+augment run on a thread pool
+(cv2/PIL release the GIL for the heavy work), a sample-level shuffle buffer
+reproduces `shuffle(512)`/`shuffle(10000)` semantics, and batches are
+collated into fixed-shape numpy dicts ready for `shard_batch` onto the mesh.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, Iterable, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+
+class Compose:
+    """Chain of transforms, each `(sample, rng) -> sample`."""
+
+    def __init__(self, transforms: Sequence[Callable]):
+        self.transforms = list(transforms)
+
+    def __call__(self, sample: dict, rng: np.random.Generator) -> dict:
+        for t in self.transforms:
+            sample = t(sample, rng)
+        return sample
+
+
+def collate(samples: List[dict]) -> dict:
+    """Stack a list of sample dicts into one batch dict of arrays."""
+    keys = samples[0].keys()
+    return {k: np.stack([np.asarray(s[k]) for s in samples]) for k in keys}
+
+
+class DataLoader:
+    """dataset (+ transforms) -> iterator of batch dicts.
+
+    dataset: __len__/__getitem__ map-style OR any iterable of sample dicts.
+    Map-style datasets get a full index shuffle per epoch (torch DataLoader
+    shuffle=True semantics); iterable datasets get a reservoir-style shuffle
+    buffer (tf.data shuffle(buffer) semantics, YOLO/tensorflow/train.py:267).
+    """
+
+    def __init__(
+        self,
+        dataset,
+        batch_size: int,
+        transform: Optional[Callable] = None,
+        shuffle: bool = False,
+        shuffle_buffer: int = 512,
+        num_workers: int = 8,
+        drop_remainder: bool = False,
+        seed: int = 0,
+        collate_fn: Callable = collate,
+        prefetch: int = 2,
+    ):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.transform = transform
+        self.shuffle = shuffle
+        self.shuffle_buffer = shuffle_buffer
+        self.num_workers = max(1, num_workers)
+        self.drop_remainder = drop_remainder
+        self.seed = seed
+        self.collate_fn = collate_fn
+        self.prefetch = prefetch
+        self._epoch = 0
+        self._map_style = hasattr(dataset, "__getitem__") and hasattr(
+            dataset, "__len__"
+        )
+
+    def __len__(self) -> int:
+        if not self._map_style:
+            raise TypeError("length unknown for iterable datasets")
+        n = len(self.dataset)
+        return n // self.batch_size if self.drop_remainder else -(-n // self.batch_size)
+
+    # -- internals ---------------------------------------------------------
+
+    def _samples(self, epoch_rng: np.random.Generator) -> Iterator[dict]:
+        if self._map_style:
+            idx = np.arange(len(self.dataset))
+            if self.shuffle:
+                epoch_rng.shuffle(idx)
+            for i in idx:
+                yield self.dataset[int(i)]
+        else:
+            it = iter(self.dataset)
+            if not self.shuffle:
+                yield from it
+                return
+            buf: List[dict] = []
+            for s in it:
+                if len(buf) < self.shuffle_buffer:
+                    buf.append(s)
+                    continue
+                j = int(epoch_rng.integers(0, len(buf)))
+                out, buf[j] = buf[j], s
+                yield out
+            epoch_rng.shuffle(buf)  # type: ignore[arg-type]
+            yield from buf
+
+    def _transformed(self, epoch_seed: int) -> Iterator[dict]:
+        epoch_rng = np.random.default_rng(epoch_seed)
+        samples = self._samples(epoch_rng)
+        if self.transform is None:
+            yield from samples
+            return
+        # ordered parallel map: worker i gets its own derived rng stream
+        with ThreadPoolExecutor(self.num_workers) as pool:
+            window: "queue.Queue" = queue.Queue()
+            in_flight = 0
+            max_in_flight = self.num_workers * 2
+
+            def submit(sample, k):
+                rng = np.random.default_rng((epoch_seed, k))
+                return pool.submit(self.transform, sample, rng)
+
+            k = 0
+            for sample in samples:
+                window.put(submit(sample, k))
+                k += 1
+                in_flight += 1
+                if in_flight >= max_in_flight:
+                    yield window.get().result()
+                    in_flight -= 1
+            while in_flight:
+                yield window.get().result()
+                in_flight -= 1
+
+    def _batches(self) -> Iterator[dict]:
+        epoch_seed = self.seed + self._epoch
+        self._epoch += 1
+        buf: List[dict] = []
+        for s in self._transformed(epoch_seed):
+            buf.append(s)
+            if len(buf) == self.batch_size:
+                yield self.collate_fn(buf)
+                buf = []
+        if buf and not self.drop_remainder:
+            yield self.collate_fn(buf)
+
+    def __iter__(self) -> Iterator[dict]:
+        """Yield batches, producing up to `prefetch` ahead on a thread."""
+        if self.prefetch <= 0:
+            yield from self._batches()
+            return
+        q: "queue.Queue" = queue.Queue(maxsize=self.prefetch)
+        sentinel = object()
+        err: List[BaseException] = []
+
+        def producer():
+            try:
+                for b in self._batches():
+                    q.put(b)
+            except BaseException as e:  # propagate to consumer
+                err.append(e)
+            finally:
+                q.put(sentinel)
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        while True:
+            item = q.get()
+            if item is sentinel:
+                break
+            yield item
+        t.join()
+        if err:
+            raise err[0]

@@ -1,0 +1,120 @@
+"""TFRecord-compatible record container IO (no TensorFlow dependency).
+
+The shard files every reference converter writes
+(`Datasets/VOC2007/tfrecords.py:110-121`, `Datasets/MSCOCO/tfrecords.py`,
+`build_imagenet_tfrecord.py`) use the TFRecord framing:
+
+    uint64 length | uint32 masked_crc32c(length) | data | uint32 masked_crc32c(data)
+
+crc32c comes from `google_crc32c` (C extension) so the Python reader sustains
+record throughput; a C++ reader (`native/`) is the fast path for training.
+"""
+from __future__ import annotations
+
+import glob as _glob
+import os
+import random
+import struct
+from typing import Iterable, Iterator, List, Optional, Sequence, Union
+
+import google_crc32c
+
+_MASK_DELTA = 0xA282EAD8
+
+
+def _masked_crc(data: bytes) -> int:
+    crc = google_crc32c.value(data)
+    return ((crc >> 15 | crc << 17) + _MASK_DELTA) & 0xFFFFFFFF
+
+
+class RecordWriter:
+    """Append-only TFRecord-framing writer."""
+
+    def __init__(self, path: str):
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._f = open(path, "wb")
+
+    def write(self, record: bytes) -> None:
+        header = struct.pack("<Q", len(record))
+        self._f.write(header)
+        self._f.write(struct.pack("<I", _masked_crc(header)))
+        self._f.write(record)
+        self._f.write(struct.pack("<I", _masked_crc(record)))
+
+    def flush(self) -> None:
+        self._f.flush()
+
+    def close(self) -> None:
+        self._f.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def write_records(path: str, records: Iterable[bytes]) -> int:
+    n = 0
+    with RecordWriter(path) as w:
+        for r in records:
+            w.write(r)
+            n += 1
+    return n
+
+
+def read_records(path: str, verify: bool = True) -> Iterator[bytes]:
+    """Yield raw record payloads from one file."""
+    with open(path, "rb") as f:
+        while True:
+            header = f.read(8)
+            if not header:
+                return
+            if len(header) < 8:
+                raise EOFError(f"truncated record header in {path}")
+            (length,) = struct.unpack("<Q", header)
+            (hcrc,) = struct.unpack("<I", f.read(4))
+            if verify and _masked_crc(header) != hcrc:
+                raise IOError(f"corrupt record header in {path}")
+            data = f.read(length)
+            if len(data) < length:
+                raise EOFError(f"truncated record in {path}")
+            (dcrc,) = struct.unpack("<I", f.read(4))
+            if verify and _masked_crc(data) != dcrc:
+                raise IOError(f"corrupt record in {path}")
+            yield data
+
+
+def expand_shards(pattern: Union[str, Sequence[str]]) -> List[str]:
+    """Glob pattern(s) -> sorted shard list (list_files analog, deterministic)."""
+    patterns = [pattern] if isinstance(pattern, str) else list(pattern)
+    files: List[str] = []
+    for p in patterns:
+        matched = sorted(_glob.glob(p)) if any(c in p for c in "*?[") else [p]
+        files.extend(matched)
+    if not files:
+        raise FileNotFoundError(f"no record shards match {pattern!r}")
+    return files
+
+
+def record_iterator(
+    pattern: Union[str, Sequence[str]],
+    *,
+    shuffle_shards: bool = False,
+    seed: Optional[int] = None,
+    shard_index: int = 0,
+    num_shards: int = 1,
+) -> Iterator[bytes]:
+    """Iterate records across shards.
+
+    `shard_index/num_shards` split the *file list* across hosts — the
+    host-sharded input feed for multi-host training (each host reads only its
+    shard subset, the pjit analog of `experimental_distribute_dataset` at
+    YOLO/tensorflow/train.py:291-294).
+    """
+    files = expand_shards(pattern)
+    files = files[shard_index::num_shards]
+    if shuffle_shards:
+        random.Random(seed).shuffle(files)
+    for path in files:
+        yield from read_records(path)

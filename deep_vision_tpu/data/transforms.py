@@ -1,0 +1,281 @@
+"""Host-side numpy image transforms (the reference's hand-written set).
+
+Parity targets — the deliberately hand-written transform classes at
+ResNet/pytorch/data_load.py:72-296 (Rescale, RandomHorizontalFlip, RandomCrop,
+CenterCrop, ToTensor, Normalize, ColorJitter), the TF "ResNet preprocessing"
+(ResNet/tensorflow/data_load.py:158-193: aspect resize, central crop, mean
+subtraction), and the bbox-preserving detection augments at
+YOLO/tensorflow/preprocess.py:37-119.
+
+All transforms are `__call__(sample: dict, rng) -> dict` over
+{'image': HWC uint8/float numpy, 'label'/'boxes'/...}. They run on host CPU
+workers; the device boundary is `parallel.mesh.shard_batch`. Layout stays HWC
+(NHWC batches) — the TPU-native layout; the reference's CHW ToTensor
+(data_load.py:176-194) has no analog here by design.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+try:  # cv2 for fast resize; PIL fallback
+    import cv2
+
+    _HAS_CV2 = True
+except Exception:  # pragma: no cover
+    from PIL import Image
+
+    _HAS_CV2 = False
+
+# ImageNet channel stats (Normalize at ResNet/pytorch/train.py:327-329 uses
+# torchvision's 0-1 stats; the TF path uses 0-255 means data_load.py:35-38)
+IMAGENET_MEAN = np.array([0.485, 0.456, 0.406], np.float32)
+IMAGENET_STD = np.array([0.229, 0.224, 0.225], np.float32)
+
+
+def _resize(image: np.ndarray, h: int, w: int) -> np.ndarray:
+    if _HAS_CV2:
+        out = cv2.resize(image, (w, h), interpolation=cv2.INTER_LINEAR)
+        if out.ndim == 2:  # cv2 drops the channel dim for single-channel
+            out = out[:, :, None]
+        return out
+    pil = Image.fromarray(image.squeeze().astype(np.uint8))
+    out = np.asarray(pil.resize((w, h), Image.BILINEAR))
+    if out.ndim == 2:
+        out = out[:, :, None]
+    return out
+
+
+class Rescale:
+    """Aspect-preserving resize: shorter side -> `size`
+    (ResNet/pytorch/data_load.py:72-101; _aspect_preserving_resize at
+    ResNet/tensorflow/data_load.py:123-137)."""
+
+    def __init__(self, size: int):
+        self.size = size
+
+    def __call__(self, sample: dict, rng: np.random.Generator) -> dict:
+        image = sample["image"]
+        h, w = image.shape[:2]
+        if h < w:
+            nh, nw = self.size, max(1, round(w * self.size / h))
+        else:
+            nh, nw = max(1, round(h * self.size / w)), self.size
+        sample["image"] = _resize(image, nh, nw)
+        return sample
+
+
+class Resize:
+    """Fixed-size (square) resize — YOLO 416 input (preprocess.py:24-27)."""
+
+    def __init__(self, height: int, width: Optional[int] = None):
+        self.h, self.w = height, width or height
+
+    def __call__(self, sample: dict, rng) -> dict:
+        image = sample["image"]
+        sample["image"] = _resize(image, self.h, self.w)
+        # normalized box coords are resize-invariant; nothing else to fix
+        return sample
+
+
+class RandomCrop:
+    """Random fixed-size crop (ResNet/pytorch/data_load.py:116-143)."""
+
+    def __init__(self, size: int):
+        self.size = size
+
+    def __call__(self, sample: dict, rng: np.random.Generator) -> dict:
+        image = sample["image"]
+        h, w = image.shape[:2]
+        top = int(rng.integers(0, h - self.size + 1))
+        left = int(rng.integers(0, w - self.size + 1))
+        sample["image"] = image[top:top + self.size, left:left + self.size]
+        return sample
+
+
+class CenterCrop:
+    """Center crop (ResNet/pytorch/data_load.py:146-173; _central_crop at
+    ResNet/tensorflow/data_load.py:46-63)."""
+
+    def __init__(self, size: int):
+        self.size = size
+
+    def __call__(self, sample: dict, rng) -> dict:
+        image = sample["image"]
+        h, w = image.shape[:2]
+        top = (h - self.size) // 2
+        left = (w - self.size) // 2
+        sample["image"] = image[top:top + self.size, left:left + self.size]
+        return sample
+
+
+class RandomHorizontalFlip:
+    """p=0.5 flip (ResNet/pytorch/data_load.py:104-113). Flips normalized
+    [x1,y1,x2,y2] 'boxes' too (random_flip_image_and_label,
+    YOLO/tensorflow/preprocess.py:37-50)."""
+
+    def __init__(self, p: float = 0.5):
+        self.p = p
+
+    def __call__(self, sample: dict, rng: np.random.Generator) -> dict:
+        if rng.random() >= self.p:
+            return sample
+        sample["image"] = sample["image"][:, ::-1]
+        if "boxes" in sample and len(sample["boxes"]):
+            b = np.array(sample["boxes"], np.float32)
+            x1 = 1.0 - b[:, 2]
+            x2 = 1.0 - b[:, 0]
+            b[:, 0], b[:, 2] = x1, x2
+            sample["boxes"] = b
+        if "keypoints" in sample and len(sample["keypoints"]):
+            k = np.array(sample["keypoints"], np.float32)
+            k[:, 0] = 1.0 - k[:, 0]
+            sample["keypoints"] = k
+        return sample
+
+
+class RandomCropWithBoxes:
+    """Bbox-preserving random crop: the crop window always contains every box
+    (random_crop_image_and_label, YOLO/tensorflow/preprocess.py:79-119).
+
+    Boxes are normalized [x1,y1,x2,y2]; rows of zeros are padding and ignored.
+    """
+
+    def __call__(self, sample: dict, rng: np.random.Generator) -> dict:
+        image = sample["image"]
+        boxes = np.array(sample.get("boxes", ()), np.float32)
+        h, w = image.shape[:2]
+        valid = boxes.any(axis=-1) if len(boxes) else np.zeros((0,), bool)
+        if valid.any():
+            vb = boxes[valid]
+            min_x1, min_y1 = vb[:, 0].min(), vb[:, 1].min()
+            max_x2, max_y2 = vb[:, 2].max(), vb[:, 3].max()
+        else:
+            min_x1 = min_y1 = 1.0
+            max_x2 = max_y2 = 0.0
+        # sample crop edges outside the union of boxes
+        left = rng.uniform(0.0, min(min_x1, 1.0))
+        top = rng.uniform(0.0, min(min_y1, 1.0))
+        right = rng.uniform(max(max_x2, 0.0), 1.0)
+        bottom = rng.uniform(max(max_y2, 0.0), 1.0)
+        x1p, y1p = int(left * w), int(top * h)
+        x2p, y2p = max(int(right * w), x1p + 1), max(int(bottom * h), y1p + 1)
+        sample["image"] = image[y1p:y2p, x1p:x2p]
+        if len(boxes):
+            nw, nh = (x2p - x1p) / w, (y2p - y1p) / h
+            out = boxes.copy()
+            out[valid, 0] = (boxes[valid, 0] - x1p / w) / nw
+            out[valid, 2] = (boxes[valid, 2] - x1p / w) / nw
+            out[valid, 1] = (boxes[valid, 1] - y1p / h) / nh
+            out[valid, 3] = (boxes[valid, 3] - y1p / h) / nh
+            sample["boxes"] = np.clip(out, 0.0, 1.0)
+        return sample
+
+
+class ColorJitter:
+    """Brightness/contrast/saturation/hue jitter
+    (ResNet/pytorch/data_load.py:213-296, PIL-based there; vectorized here)."""
+
+    def __init__(self, brightness=0.0, contrast=0.0, saturation=0.0, hue=0.0):
+        self.brightness = brightness
+        self.contrast = contrast
+        self.saturation = saturation
+        self.hue = hue
+
+    @staticmethod
+    def _factor(rng, amount):
+        return float(rng.uniform(max(0.0, 1.0 - amount), 1.0 + amount))
+
+    def __call__(self, sample: dict, rng: np.random.Generator) -> dict:
+        was_uint8 = sample["image"].dtype == np.uint8
+        img = sample["image"].astype(np.float32)
+        if was_uint8 or img.max() > 1.5:  # uint8 range
+            scale = 255.0
+        else:
+            scale = 1.0
+        if self.brightness:
+            img = img * self._factor(rng, self.brightness)
+        if self.contrast:
+            f = self._factor(rng, self.contrast)
+            # grayscale via ITU-R 601 luma, matching PIL ImageEnhance.Contrast
+            mean = (
+                img[..., :3] @ np.array([0.299, 0.587, 0.114], np.float32)
+            ).mean()
+            img = (img - mean) * f + mean
+        if self.saturation and img.shape[-1] == 3:
+            f = self._factor(rng, self.saturation)
+            gray = img @ np.array([0.299, 0.587, 0.114], np.float32)
+            img = (img - gray[..., None]) * f + gray[..., None]
+        if self.hue and img.shape[-1] == 3:
+            # hue rotation in YIQ space (cheap, differentiable-free host op)
+            theta = float(rng.uniform(-self.hue, self.hue)) * 2 * np.pi
+            u, w_ = np.cos(theta), np.sin(theta)
+            t = np.array(
+                [
+                    [0.299 + 0.701 * u + 0.168 * w_, 0.587 - 0.587 * u + 0.330 * w_, 0.114 - 0.114 * u - 0.497 * w_],
+                    [0.299 - 0.299 * u - 0.328 * w_, 0.587 + 0.413 * u + 0.035 * w_, 0.114 - 0.114 * u + 0.292 * w_],
+                    [0.299 - 0.300 * u + 1.250 * w_, 0.587 - 0.588 * u - 1.050 * w_, 0.114 + 0.886 * u - 0.203 * w_],
+                ],
+                np.float32,
+            )
+            img = img @ t.T
+        img = np.clip(img, 0.0, scale)
+        # preserve dtype so a later ToFloat still rescales 0-255 -> 0-1
+        sample["image"] = img.astype(np.uint8) if was_uint8 else img
+        return sample
+
+
+class ToFloat:
+    """uint8 [0,255] -> float32 [0,1]; grayscale stays single-channel
+    unless `expand_gray_to_rgb` (ToTensor's 3-channel expand,
+    ResNet/pytorch/data_load.py:176-194 — layout conversion dropped: NHWC)."""
+
+    def __init__(self, expand_gray_to_rgb: bool = False):
+        self.expand = expand_gray_to_rgb
+
+    def __call__(self, sample: dict, rng) -> dict:
+        img = sample["image"]
+        if img.dtype == np.uint8:
+            img = img.astype(np.float32) / 255.0
+        else:
+            img = img.astype(np.float32)
+        if img.ndim == 2:
+            img = img[:, :, None]
+        if self.expand and img.shape[-1] == 1:
+            img = np.repeat(img, 3, axis=-1)
+        sample["image"] = img
+        return sample
+
+
+class Normalize:
+    """(x - mean) / std per channel (ResNet/pytorch/data_load.py:197-210)."""
+
+    def __init__(self, mean=IMAGENET_MEAN, std=IMAGENET_STD):
+        self.mean = np.asarray(mean, np.float32)
+        self.std = np.asarray(std, np.float32)
+
+    def __call__(self, sample: dict, rng) -> dict:
+        sample["image"] = (sample["image"] - self.mean) / self.std
+        return sample
+
+
+class PadBoxes:
+    """Pad/truncate 'boxes' (+aligned 'classes') to a fixed count — ragged ->
+    static shapes for jit (the reference's TensorArray loops become masked
+    scatters; max 100 boxes matches yolov3.py:452-454)."""
+
+    def __init__(self, max_boxes: int = 100):
+        self.max_boxes = max_boxes
+
+    def __call__(self, sample: dict, rng) -> dict:
+        boxes = np.array(sample.get("boxes", ()), np.float32).reshape(-1, 4)
+        classes = np.array(sample.get("classes", ()), np.int32).reshape(-1)
+        n = min(len(boxes), self.max_boxes)
+        out_b = np.zeros((self.max_boxes, 4), np.float32)
+        out_c = np.zeros((self.max_boxes,), np.int32)
+        out_b[:n] = boxes[:n]
+        out_c[:n] = classes[:n] if len(classes) else 0
+        sample["boxes"] = out_b
+        sample["classes"] = out_c
+        return sample

@@ -117,3 +117,42 @@ def yolo_loss_fn(
                 metrics[f"{names[i]}_{k}"] = losses[k]
     metrics["loss"] = total
     return total, metrics
+
+
+def yolo_train_loss_fn(
+    outputs,
+    batch,
+    grid_sizes: Sequence[int] = (13, 26, 52),
+    num_classes: int = 80,
+    anchors=YOLO_ANCHORS,
+    anchor_masks=YOLO_ANCHOR_MASKS,
+    ignore_thresh: float = 0.5,
+):
+    """YOLO loss with ON-DEVICE label assignment from padded GT boxes.
+
+    The reference assigns anchors on the host inside tf.data
+    (preprocess_label_for_one_scale, YOLO/tensorflow/preprocess.py:137-224,
+    a TensorArray autograph loop per image). Here the data pipeline ships only
+    padded `batch['boxes']` (x1y1x2y2 normalized) + `batch['classes']`, and
+    the target grids are built inside the jitted train step as a vectorized
+    scatter (ops/anchors.assign_anchors_to_grid) — host CPU off the critical
+    path, assignment on the MXU's host-free timeline.
+    """
+    from deep_vision_tpu.ops.anchors import assign_anchors_to_grid
+    from deep_vision_tpu.ops.boxes import xyxy_to_xywh
+
+    xywh = xyxy_to_xywh(batch["boxes"])
+    labels = jax.vmap(
+        lambda b, c: tuple(
+            assign_anchors_to_grid(
+                b, c, grid_sizes, anchors, anchor_masks, num_classes
+            )
+        )
+    )(xywh, batch["classes"])
+    return yolo_loss_fn(
+        outputs,
+        {"labels": tuple(labels), "boxes": xywh},
+        anchors,
+        anchor_masks,
+        ignore_thresh,
+    )

@@ -1,0 +1,390 @@
+"""CLI: `python train.py -m <config> [-c <ckpt>]` — the reference's entry
+contract (argparse mains at ResNet/pytorch/train.py:541-562, resume-by-flag
+at :293-307) over the shared config registry.
+
+`--fake-data` swaps in synthetic datasets of the exact task shapes — the
+fleshed-out version of the CPU fake-data harness the reference kept commented
+out (CycleGAN/tensorflow/train.py:338-342) — so every config trains end to
+end on any host, TPU or CPU.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+from typing import List, Optional
+
+import numpy as np
+
+from deep_vision_tpu.configs import CONFIG_REGISTRY, ExperimentConfig, get_config
+
+
+# -- fake datasets -----------------------------------------------------------
+
+def _fake_classification(cfg: ExperimentConfig, n_batches: int):
+    rng = np.random.RandomState(0)
+    h, w, c = cfg.input_shape
+    return [
+        {
+            "image": rng.rand(cfg.batch_size, h, w, c).astype(np.float32),
+            "label": rng.randint(0, cfg.num_classes, (cfg.batch_size,)).astype(np.int32),
+        }
+        for _ in range(n_batches)
+    ]
+
+
+def _fake_detection(cfg: ExperimentConfig, n_batches: int, max_boxes=20):
+    rng = np.random.RandomState(0)
+    h, w, c = cfg.input_shape
+    out = []
+    for _ in range(n_batches):
+        boxes = np.zeros((cfg.batch_size, max_boxes, 4), np.float32)
+        classes = np.zeros((cfg.batch_size, max_boxes), np.int32)
+        for b in range(cfg.batch_size):
+            n = rng.randint(1, 5)
+            x1 = rng.uniform(0, 0.6, n)
+            y1 = rng.uniform(0, 0.6, n)
+            boxes[b, :n, 0], boxes[b, :n, 1] = x1, y1
+            boxes[b, :n, 2] = x1 + rng.uniform(0.1, 0.35, n)
+            boxes[b, :n, 3] = y1 + rng.uniform(0.1, 0.35, n)
+            classes[b, :n] = rng.randint(0, cfg.num_classes, n)
+        out.append(
+            {
+                "image": rng.rand(cfg.batch_size, h, w, c).astype(np.float32),
+                "boxes": boxes,
+                "classes": classes,
+            }
+        )
+    return out
+
+
+def _fake_pose(cfg: ExperimentConfig, n_batches: int, hm_size=64):
+    from deep_vision_tpu.data.labels import make_pose_heatmaps
+
+    rng = np.random.RandomState(0)
+    h, w, c = cfg.input_shape
+    out = []
+    for _ in range(n_batches):
+        hms = []
+        for _b in range(cfg.batch_size):
+            s = {
+                "keypoints": rng.rand(cfg.num_classes, 2).astype(np.float32),
+                "visibility": np.ones((cfg.num_classes,), np.float32),
+            }
+            hms.append(make_pose_heatmaps(s, size=hm_size,
+                                          num_joints=cfg.num_classes)["heatmap"])
+        out.append(
+            {
+                "image": rng.rand(cfg.batch_size, h, w, c).astype(np.float32),
+                "heatmap": np.stack(hms),
+            }
+        )
+    return out
+
+
+def _fake_centernet(cfg: ExperimentConfig, n_batches: int):
+    from deep_vision_tpu.data.labels import make_centernet_targets
+
+    det = _fake_detection(cfg, n_batches)
+    out_size = cfg.input_shape[0] // 4
+    out = []
+    for batch in det:
+        tgts = [
+            make_centernet_targets(
+                {"boxes": batch["boxes"][b], "classes": batch["classes"][b]},
+                out_size=out_size, num_classes=cfg.num_classes,
+            )
+            for b in range(len(batch["image"]))
+        ]
+        out.append(
+            {
+                "image": batch["image"],
+                "heatmap": np.stack([t["heatmap"] for t in tgts]),
+                "wh": np.stack([t["wh"] for t in tgts]),
+                "offset": np.stack([t["offset"] for t in tgts]),
+                "mask": np.stack([t["mask"] for t in tgts]),
+            }
+        )
+    return out
+
+
+# -- real datasets -----------------------------------------------------------
+
+def build_dataloaders(cfg: ExperimentConfig, data_dir: str, fake: bool,
+                      fake_batches: int, num_workers: int):
+    """Returns (train_fn, eval_fn) thunks yielding batch dicts per epoch."""
+    if fake or cfg.dataset.get("kind") == "fake":
+        maker = {
+            "classification": _fake_classification,
+            "detection": _fake_detection,
+            "pose": _fake_pose,
+            "centernet": _fake_centernet,
+            "dcgan": _fake_classification,
+            "cyclegan": _fake_classification,
+        }[cfg.task]
+        data = maker(cfg, fake_batches)
+        return (lambda: data), (lambda: data)
+
+    from deep_vision_tpu.data import DataLoader, Compose, MnistDataset, RecordDataset
+    from deep_vision_tpu.data import transforms as T
+    from deep_vision_tpu.data.datasets import ImageFolderDataset
+    from deep_vision_tpu.data.labels import MakeCenternetTargets, MakePoseHeatmaps
+
+    kind = cfg.dataset["kind"]
+    if kind == "mnist":
+        train_ds = MnistDataset(
+            os.path.join(data_dir, "train-images-idx3-ubyte"),
+            os.path.join(data_dir, "train-labels-idx1-ubyte"),
+        )
+        eval_ds = MnistDataset(
+            os.path.join(data_dir, "t10k-images-idx3-ubyte"),
+            os.path.join(data_dir, "t10k-labels-idx1-ubyte"),
+        )
+        tf_ = Compose([T.ToFloat(), T.Normalize(mean=[0.1307], std=[0.3081])])
+        train = DataLoader(train_ds, cfg.batch_size, tf_, shuffle=True,
+                           num_workers=num_workers)
+        evl = DataLoader(eval_ds, cfg.batch_size, tf_, num_workers=num_workers)
+        return (lambda: train), (lambda: evl)
+
+    if kind == "imagenet":
+        # records if present, else flattened folder (data_load.py:14-69)
+        rec_glob = os.path.join(data_dir, "tfrecord_train", "*")
+        import glob as _g
+
+        train_tf = Compose([
+            T.Rescale(cfg.train_resize), T.RandomHorizontalFlip(),
+            T.RandomCrop(cfg.eval_crop),
+            T.ColorJitter(0.4, 0.4, 0.4),
+            T.ToFloat(expand_gray_to_rgb=True), T.Normalize(),
+        ])  # transforms.Compose at ResNet/pytorch/train.py:315-331
+        eval_tf = Compose([
+            T.Rescale(cfg.train_resize), T.CenterCrop(cfg.eval_crop),
+            T.ToFloat(expand_gray_to_rgb=True), T.Normalize(),
+        ])
+        if _g.glob(rec_glob):
+            train_ds = RecordDataset(rec_glob, "imagenet", shuffle_shards=True)
+            eval_ds = RecordDataset(
+                os.path.join(data_dir, "tfrecord_val", "*"), "imagenet"
+            )
+            train = DataLoader(train_ds, cfg.batch_size, train_tf, shuffle=True,
+                               shuffle_buffer=10000, num_workers=num_workers)
+        else:
+            train_ds = ImageFolderDataset(os.path.join(data_dir, "train_flatten"))
+            eval_ds = ImageFolderDataset(os.path.join(data_dir, "val_flatten"))
+            train = DataLoader(train_ds, cfg.batch_size, train_tf, shuffle=True,
+                               num_workers=num_workers)
+        evl = DataLoader(eval_ds, cfg.batch_size, eval_tf, num_workers=num_workers)
+        return (lambda: train), (lambda: evl)
+
+    if kind == "records":
+        schema = cfg.dataset["schema"]
+        size = cfg.input_shape[0]
+        # eval chains carry no random augments (the imagenet split above does
+        # the same): plateau schedules key on val metrics, which must be
+        # deterministic for a fixed checkpoint
+        if cfg.task == "detection":
+            train_chain = [T.RandomHorizontalFlip(), T.RandomCropWithBoxes(),
+                           T.Resize(size), T.ToFloat(), T.PadBoxes(100)]
+            eval_chain = [T.Resize(size), T.ToFloat(), T.PadBoxes(100)]
+        elif cfg.task == "pose":
+            train_chain = [T.Resize(size), T.ToFloat(),
+                           MakePoseHeatmaps(num_joints=cfg.num_classes)]
+            eval_chain = train_chain
+        elif cfg.task == "centernet":
+            targets = MakeCenternetTargets(size // 4, cfg.num_classes)
+            train_chain = [T.RandomHorizontalFlip(), T.Resize(size),
+                           T.ToFloat(), T.PadBoxes(100), targets]
+            eval_chain = [T.Resize(size), T.ToFloat(), T.PadBoxes(100), targets]
+        else:  # image_only (GANs): scale to [-1, 1]
+            train_chain = [T.Resize(size), T.ToFloat(),
+                           T.Normalize(mean=[0.5] * cfg.input_shape[2],
+                                       std=[0.5] * cfg.input_shape[2])]
+            eval_chain = train_chain
+        train_ds = RecordDataset(
+            os.path.join(data_dir, cfg.dataset.get("train_glob", "train*")),
+            schema, shuffle_shards=True,
+        )
+        eval_ds = RecordDataset(
+            os.path.join(data_dir, cfg.dataset.get("val_glob", "val*")), schema
+        )
+        train = DataLoader(train_ds, cfg.batch_size, Compose(train_chain),
+                           shuffle=True, num_workers=num_workers,
+                           drop_remainder=True)
+        evl = DataLoader(eval_ds, cfg.batch_size, Compose(eval_chain),
+                         num_workers=num_workers, drop_remainder=True)
+        return (lambda: train), (lambda: evl)
+
+    raise ValueError(f"unknown dataset kind {kind!r}")
+
+
+# -- trainer assembly --------------------------------------------------------
+
+def _steps_per_epoch(cfg: ExperimentConfig, train_fn) -> int:
+    data = train_fn()
+    try:
+        return len(data)
+    except TypeError:
+        return 1000  # streaming: nominal epoch length
+
+
+def _build_schedule(cfg: ExperimentConfig, steps_per_epoch: int):
+    from deep_vision_tpu.train.optimizers import make_schedule
+
+    base_lr = cfg.optimizer["learning_rate"]
+    if cfg.schedule is None:
+        return base_lr
+    kw = dict(cfg.schedule)
+    kind = kw.pop("kind")
+    if "step_size_epochs" in kw:
+        kw["step_size"] = kw.pop("step_size_epochs") * steps_per_epoch
+    if "total_epochs" in kw:
+        kw["total_steps"] = kw.pop("total_epochs") * steps_per_epoch
+    if "hold_epochs" in kw:
+        kw["hold_steps"] = kw.pop("hold_epochs") * steps_per_epoch
+    return make_schedule(kind, base_lr, **kw)
+
+
+def build_trainer(cfg: ExperimentConfig, train_fn, ckpt_dir: Optional[str]):
+    import functools
+
+    import jax.numpy as jnp
+
+    from deep_vision_tpu.core import CheckpointManager
+    from deep_vision_tpu.losses import (
+        centernet_loss_fn,
+        classification_loss_fn,
+        hourglass_loss_fn,
+        yolo_train_loss_fn,
+    )
+    from deep_vision_tpu.models import get_model
+    from deep_vision_tpu.train import Trainer, build_optimizer
+    from deep_vision_tpu.train.optimizers import ReduceLROnPlateau
+
+    steps = _steps_per_epoch(cfg, train_fn)
+    opt_kw = dict(cfg.optimizer)
+    name = opt_kw.pop("name")
+    opt_kw.pop("learning_rate")
+    lr = _build_schedule(cfg, steps)
+    wd = opt_kw.pop("weight_decay", 0.0)
+    tx = build_optimizer(name, lr, weight_decay=wd, decay_bn_bias=True, **opt_kw)
+
+    if cfg.task == "classification":
+        model = get_model(cfg.model, num_classes=cfg.num_classes, **cfg.model_kwargs)
+        loss_fn = functools.partial(classification_loss_fn, **cfg.loss_kwargs)
+        plateau_metric = cfg.plateau_metric
+    elif cfg.task == "detection":
+        model = get_model(cfg.model, num_classes=cfg.num_classes, **cfg.model_kwargs)
+        size = cfg.input_shape[0]
+        loss_fn = functools.partial(
+            yolo_train_loss_fn,
+            grid_sizes=(size // 32, size // 16, size // 8),
+            num_classes=cfg.num_classes, **cfg.loss_kwargs,
+        )
+        plateau_metric = cfg.plateau_metric
+    elif cfg.task == "pose":
+        model = get_model(cfg.model, **cfg.model_kwargs)
+        loss_fn = functools.partial(hourglass_loss_fn, **cfg.loss_kwargs)
+        plateau_metric = cfg.plateau_metric
+    elif cfg.task == "centernet":
+        model = get_model(cfg.model, num_classes=cfg.num_classes, **cfg.model_kwargs)
+        loss_fn = functools.partial(centernet_loss_fn, **cfg.loss_kwargs)
+        plateau_metric = cfg.plateau_metric
+    else:
+        raise ValueError(f"task {cfg.task!r} uses a GAN trainer, not Trainer")
+
+    plateau = ReduceLROnPlateau(**cfg.plateau) if cfg.plateau else None
+    ckpt = CheckpointManager(ckpt_dir) if ckpt_dir else None
+    sample = jnp.ones((2, *cfg.input_shape), jnp.float32)
+    return Trainer(
+        model, tx, loss_fn, sample, plateau=plateau,
+        plateau_metric=plateau_metric, checkpoint_manager=ckpt,
+    )
+
+
+def build_gan_trainer(cfg: ExperimentConfig):
+    from deep_vision_tpu.models import get_model
+    from deep_vision_tpu.train import build_optimizer
+    from deep_vision_tpu.train.gan import CycleGanTrainer, DcganTrainer
+
+    opt_kw = dict(cfg.optimizer)
+    name = opt_kw.pop("name")
+    lr = opt_kw.pop("learning_rate")
+    if cfg.task == "dcgan":
+        return DcganTrainer(
+            get_model("dcgan_generator"),
+            get_model("dcgan_discriminator"),
+            build_optimizer(name, lr, **opt_kw),
+            build_optimizer(name, lr, **opt_kw),
+            image_shape=cfg.input_shape,
+        )
+    tx_fn = lambda: build_optimizer(name, lr, **dict(opt_kw))
+    return CycleGanTrainer(
+        get_model("cyclegan_generator"), get_model("cyclegan_generator"),
+        get_model("cyclegan_discriminator"), get_model("cyclegan_discriminator"),
+        tx_fn, tx_fn, image_shape=cfg.input_shape,
+    )
+
+
+# -- main --------------------------------------------------------------------
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        description="deep_vision_tpu trainer (train.py -m <config> [-c ckpt])"
+    )
+    parser.add_argument("-m", "--model", required=True,
+                        choices=sorted(CONFIG_REGISTRY))
+    parser.add_argument("-c", "--checkpoint", default=None,
+                        help="resume: checkpoint dir (or 'auto' for default dir)")
+    parser.add_argument("--data-dir", default="./dataset")
+    parser.add_argument("--ckpt-dir", default=None)
+    parser.add_argument("--epochs", type=int, default=None)
+    parser.add_argument("--batch-size", type=int, default=None)
+    parser.add_argument("--num-workers", type=int, default=8)
+    parser.add_argument("--fake-data", action="store_true")
+    parser.add_argument("--fake-batches", type=int, default=4)
+    parser.add_argument("--eval-first", action="store_true",
+                        help="epoch-0 sanity validate (ResNet/pytorch/train.py:390)")
+    args = parser.parse_args(argv)
+
+    cfg = get_config(args.model)
+    if args.epochs is not None:
+        cfg.epochs = args.epochs
+    if args.batch_size is not None:
+        cfg.batch_size = args.batch_size
+
+    train_fn, eval_fn = build_dataloaders(
+        cfg, args.data_dir, args.fake_data, args.fake_batches, args.num_workers
+    )
+
+    if cfg.task in ("dcgan", "cyclegan"):
+        trainer = build_gan_trainer(cfg)
+        for epoch in range(cfg.epochs):
+            for batch in train_fn():
+                if cfg.task == "dcgan":
+                    metrics = trainer.train_step(batch["image"])
+                else:
+                    half = len(batch["image"]) // 2 or 1
+                    metrics = trainer.train_step(
+                        batch["image"][:half], batch["image"][half:half * 2]
+                    )
+            print(f"epoch {epoch}: " + " ".join(
+                f"{k}={float(v):.4f}" for k, v in sorted(metrics.items())
+            ))
+        return 0
+
+    ckpt_dir = args.ckpt_dir or os.path.join("checkpoints", cfg.name)
+    trainer = build_trainer(cfg, train_fn, ckpt_dir)
+    start_epoch = 0
+    if args.checkpoint:
+        if args.checkpoint != "auto":
+            trainer.ckpt = type(trainer.ckpt)(args.checkpoint)
+        start_epoch = trainer.resume()
+        print(f"resumed from step {int(trainer.state.step)} -> epoch {start_epoch}")
+    trainer.fit(
+        train_fn, eval_fn, epochs=cfg.epochs, start_epoch=start_epoch,
+        eval_first=args.eval_first,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

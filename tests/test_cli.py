@@ -1,0 +1,78 @@
+"""Config registry + CLI tests (fast paths only; heavy models are smoke-tested
+via `train.py --fake-data` out of band)."""
+import numpy as np
+import pytest
+
+from deep_vision_tpu.configs import CONFIG_REGISTRY, get_config
+from deep_vision_tpu.models import get_model
+from deep_vision_tpu.train_cli import build_dataloaders, build_trainer, main
+
+
+def test_every_config_resolves_to_a_model():
+    # parity check: the registry covers the union of the reference's
+    # training_config dicts (ResNet/pytorch/train.py:26-215 et al.)
+    expected = {
+        "lenet5", "alexnet1", "alexnet2", "vgg16", "vgg19", "inception1",
+        "inception3", "resnet34", "resnet50", "resnet152", "resnet50v2",
+        "mobilenet1", "shufflenet1", "yolov3_coco", "yolov3_voc",
+        "hourglass_mpii", "centernet_coco", "dcgan_mnist", "cyclegan",
+    }
+    assert expected <= set(CONFIG_REGISTRY)
+    for name, cfg in CONFIG_REGISTRY.items():
+        if cfg.task in ("dcgan", "cyclegan"):
+            continue
+        kwargs = dict(cfg.model_kwargs)
+        if cfg.task != "pose":
+            kwargs["num_classes"] = cfg.num_classes
+        assert get_model(cfg.model, **kwargs) is not None
+
+
+def test_get_config_returns_copy():
+    a = get_config("lenet5")
+    a.epochs = 1
+    assert CONFIG_REGISTRY["lenet5"].epochs == 50
+
+
+@pytest.mark.parametrize("task,keys", [
+    ("classification", {"image", "label"}),
+    ("detection", {"image", "boxes", "classes"}),
+    ("pose", {"image", "heatmap"}),
+    ("centernet", {"image", "heatmap", "wh", "offset", "mask"}),
+])
+def test_fake_dataloaders_shapes(task, keys):
+    name = {"classification": "lenet5", "detection": "yolov3_voc",
+            "pose": "hourglass_mpii", "centernet": "centernet_coco"}[task]
+    cfg = get_config(name)
+    cfg.batch_size = 2
+    train_fn, eval_fn = build_dataloaders(cfg, ".", fake=True, fake_batches=2,
+                                          num_workers=1)
+    batches = list(train_fn())
+    assert len(batches) == 2
+    assert set(batches[0]) == keys
+    assert batches[0]["image"].shape == (2, *cfg.input_shape)
+    if task == "centernet":
+        s = cfg.input_shape[0] // 4
+        assert batches[0]["heatmap"].shape == (2, s, s, cfg.num_classes)
+
+
+def test_cli_lenet5_trains_and_resumes(tmp_path, mesh8):
+    ck = str(tmp_path / "ck")
+    rc = main(["-m", "lenet5", "--fake-data", "--epochs", "1",
+               "--batch-size", "16", "--fake-batches", "2",
+               "--ckpt-dir", ck])
+    assert rc == 0
+    rc = main(["-m", "lenet5", "--fake-data", "--epochs", "2",
+               "--batch-size", "16", "--fake-batches", "2",
+               "--ckpt-dir", ck, "-c", "auto"])
+    assert rc == 0
+
+
+def test_schedule_epoch_to_step_conversion():
+    cfg = get_config("vgg16")
+    from deep_vision_tpu.train_cli import _build_schedule
+
+    sched = _build_schedule(cfg, steps_per_epoch=100)
+    # StepLR(10 epochs, 0.5): constant within the first 10 epochs
+    assert float(sched(0)) == pytest.approx(0.01)
+    assert float(sched(999)) == pytest.approx(0.01)
+    assert float(sched(1000)) == pytest.approx(0.005)

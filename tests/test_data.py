@@ -1,0 +1,297 @@
+"""Data layer tests: Example codec, record framing, datasets, transforms,
+DataLoader. The codec/framing tests cross-check against TensorFlow's own
+implementations when TF is importable (byte-level format parity with the
+shard files the reference's converters produce)."""
+import os
+import struct
+
+import numpy as np
+import pytest
+
+from deep_vision_tpu.data import (
+    Compose,
+    DataLoader,
+    MnistDataset,
+    RecordDataset,
+    decode_example,
+    encode_example,
+    read_records,
+    write_records,
+)
+from deep_vision_tpu.data import transforms as T
+
+try:
+    import tensorflow as tf
+
+    HAS_TF = True
+except Exception:
+    HAS_TF = False
+
+
+FEATS = {
+    "image/encoded": [b"\x00\x01jpegbytes\xff"],
+    "image/width": [416],
+    "image/object/bbox/xmin": [0.125, 0.5],
+    "name": [b"img_001"],
+}
+
+
+def test_example_codec_roundtrip():
+    out = decode_example(encode_example(FEATS))
+    assert out["image/encoded"] == FEATS["image/encoded"]
+    assert out["image/width"] == [416]
+    assert out["name"] == [b"img_001"]
+    np.testing.assert_allclose(
+        out["image/object/bbox/xmin"], FEATS["image/object/bbox/xmin"], rtol=1e-6
+    )
+
+
+def test_example_codec_negative_int_and_empty():
+    out = decode_example(encode_example({"a": [-5, 3], "b": []}))
+    assert out["a"] == [-5, 3]
+    assert out["b"] == []
+
+
+@pytest.mark.skipif(not HAS_TF, reason="tensorflow unavailable")
+def test_example_codec_tf_cross_parity():
+    # our encoder -> TF parser
+    parsed = tf.train.Example.FromString(encode_example(FEATS))
+    f = parsed.features.feature
+    assert f["image/encoded"].bytes_list.value[0] == FEATS["image/encoded"][0]
+    assert list(f["image/width"].int64_list.value) == [416]
+    np.testing.assert_allclose(
+        list(f["image/object/bbox/xmin"].float_list.value), [0.125, 0.5]
+    )
+    # TF encoder -> our parser
+    ex = tf.train.Example(
+        features=tf.train.Features(
+            feature={
+                "label": tf.train.Feature(
+                    int64_list=tf.train.Int64List(value=[7])
+                ),
+                "xy": tf.train.Feature(
+                    float_list=tf.train.FloatList(value=[0.25, -1.5])
+                ),
+                "raw": tf.train.Feature(
+                    bytes_list=tf.train.BytesList(value=[b"abc"])
+                ),
+            }
+        )
+    )
+    out = decode_example(ex.SerializeToString())
+    assert out["label"] == [7]
+    np.testing.assert_allclose(out["xy"], [0.25, -1.5])
+    assert out["raw"] == [b"abc"]
+
+
+def test_records_roundtrip(tmp_path):
+    path = str(tmp_path / "a.tfrecord")
+    payloads = [b"first", b"", b"x" * 1000]
+    assert write_records(path, payloads) == 3
+    assert list(read_records(path)) == payloads
+
+
+def test_records_corruption_detected(tmp_path):
+    path = str(tmp_path / "a.tfrecord")
+    write_records(path, [b"hello world"])
+    with open(path, "r+b") as f:
+        f.seek(14)  # inside payload
+        f.write(b"X")
+    with pytest.raises(IOError):
+        list(read_records(path))
+    # verify=False skips the check
+    assert len(list(read_records(path, verify=False))) == 1
+
+
+@pytest.mark.skipif(not HAS_TF, reason="tensorflow unavailable")
+def test_records_tf_cross_parity(tmp_path):
+    ours = str(tmp_path / "ours.tfrecord")
+    theirs = str(tmp_path / "tf.tfrecord")
+    payloads = [b"alpha", b"beta" * 100]
+    write_records(ours, payloads)
+    got = [bytes(r.numpy()) for r in tf.data.TFRecordDataset(ours)]
+    assert got == payloads
+    with tf.io.TFRecordWriter(theirs) as w:
+        for p in payloads:
+            w.write(p)
+    assert list(read_records(theirs)) == payloads
+
+
+def test_record_dataset_voc_schema(tmp_path):
+    import cv2
+
+    img = np.full((20, 30, 3), 128, np.uint8)
+    ok, enc = cv2.imencode(".png", img)
+    assert ok
+    ex = encode_example(
+        {
+            "image/encoded": [enc.tobytes()],
+            "image/object/bbox/xmin": [0.1],
+            "image/object/bbox/ymin": [0.2],
+            "image/object/bbox/xmax": [0.5],
+            "image/object/bbox/ymax": [0.6],
+            "image/object/class/label": [3],
+        }
+    )
+    path = str(tmp_path / "voc-00000-of-00001.tfrecord")
+    write_records(path, [ex, ex])
+    ds = RecordDataset(str(tmp_path / "voc-*"), schema="voc")
+    samples = list(ds)
+    assert len(samples) == 2
+    assert samples[0]["image"].shape == (20, 30, 3)
+    np.testing.assert_allclose(samples[0]["boxes"], [[0.1, 0.2, 0.5, 0.6]])
+    assert samples[0]["classes"].tolist() == [3]
+
+
+def test_mnist_idx_dataset(tmp_path):
+    imgs = (np.arange(3 * 28 * 28) % 255).astype(np.uint8).reshape(3, 28, 28)
+    labels = np.array([5, 0, 9], np.uint8)
+    ipath, lpath = str(tmp_path / "imgs.idx"), str(tmp_path / "labels.idx")
+    with open(ipath, "wb") as f:
+        f.write(struct.pack(">HBB", 0, 0x08, 3))
+        f.write(struct.pack(">3I", 3, 28, 28))
+        f.write(imgs.tobytes())
+    with open(lpath, "wb") as f:
+        f.write(struct.pack(">HBB", 0, 0x08, 1))
+        f.write(struct.pack(">I", 3))
+        f.write(labels.tobytes())
+    ds = MnistDataset(ipath, lpath)
+    assert len(ds) == 3
+    s = ds[0]
+    assert s["image"].shape == (32, 32, 1)  # 28 padded to 32
+    assert s["label"] == 5
+    np.testing.assert_array_equal(s["image"][2:-2, 2:-2, 0], imgs[0])
+
+
+def test_rescale_aspect_preserving():
+    rng = np.random.default_rng(0)
+    s = {"image": np.zeros((100, 200, 3), np.uint8)}
+    out = T.Rescale(50)(s, rng)
+    assert out["image"].shape == (50, 100, 3)
+    s = {"image": np.zeros((200, 100, 3), np.uint8)}
+    out = T.Rescale(50)(s, rng)
+    assert out["image"].shape == (100, 50, 3)
+
+
+def test_crops_and_flip_boxes():
+    rng = np.random.default_rng(0)
+    img = np.arange(10 * 10).reshape(10, 10, 1).astype(np.uint8)
+    out = T.CenterCrop(4)({"image": img}, rng)
+    assert out["image"].shape == (4, 4, 1)
+    np.testing.assert_array_equal(out["image"], img[3:7, 3:7])
+    out = T.RandomCrop(4)({"image": img}, rng)
+    assert out["image"].shape == (4, 4, 1)
+
+    boxes = np.array([[0.1, 0.2, 0.4, 0.6]], np.float32)
+    out = T.RandomHorizontalFlip(p=1.0)(
+        {"image": img, "boxes": boxes}, rng
+    )
+    np.testing.assert_allclose(out["boxes"], [[0.6, 0.2, 0.9, 0.6]], atol=1e-6)
+    np.testing.assert_array_equal(out["image"], img[:, ::-1])
+
+
+def test_random_crop_with_boxes_preserves_all_boxes():
+    rng = np.random.default_rng(3)
+    img = np.zeros((100, 100, 3), np.uint8)
+    boxes = np.array(
+        [[0.3, 0.3, 0.5, 0.5], [0.6, 0.2, 0.8, 0.4], [0, 0, 0, 0]], np.float32
+    )
+    for _ in range(20):
+        out = T.RandomCropWithBoxes()({"image": img.copy(), "boxes": boxes.copy()}, rng)
+        b = out["boxes"][:2]
+        assert (b[:, 2] > b[:, 0]).all() and (b[:, 3] > b[:, 1]).all()
+        assert (b >= 0).all() and (b <= 1).all()
+
+
+def test_pad_boxes_fixed_shape():
+    rng = np.random.default_rng(0)
+    out = T.PadBoxes(5)(
+        {"boxes": np.ones((2, 4), np.float32), "classes": np.array([1, 2])}, rng
+    )
+    assert out["boxes"].shape == (5, 4)
+    assert out["classes"].tolist() == [1, 2, 0, 0, 0]
+
+
+def test_colorjitter_preserves_uint8_for_downstream_tofloat():
+    # regression: jitter between decode and ToFloat must not break the
+    # 0-255 -> 0-1 rescale (imagenet train chain in train_cli.py)
+    rng = np.random.default_rng(0)
+    img = np.full((4, 4, 3), 200, np.uint8)
+    out = T.ColorJitter(0.4, 0.4, 0.4)({"image": img}, rng)
+    assert out["image"].dtype == np.uint8
+    s = Compose([T.ColorJitter(0.4, 0.4, 0.4), T.ToFloat()])(
+        {"image": img}, rng
+    )
+    assert s["image"].max() <= 1.0
+
+
+def test_normalize_and_tofloat():
+    rng = np.random.default_rng(0)
+    img = np.full((4, 4, 3), 255, np.uint8)
+    s = Compose([T.ToFloat(), T.Normalize()])({"image": img}, rng)
+    np.testing.assert_allclose(
+        s["image"][0, 0], (1.0 - T.IMAGENET_MEAN) / T.IMAGENET_STD, rtol=1e-5
+    )
+    g = T.ToFloat(expand_gray_to_rgb=True)({"image": np.zeros((4, 4), np.uint8)}, rng)
+    assert g["image"].shape == (4, 4, 3)
+
+
+class _SquaresDataset:
+    def __len__(self):
+        return 10
+
+    def __getitem__(self, i):
+        return {"image": np.full((4, 4, 1), i, np.float32), "label": np.int32(i)}
+
+
+def test_dataloader_map_style_shuffle_and_batching():
+    dl = DataLoader(_SquaresDataset(), batch_size=4, shuffle=True, seed=7,
+                    num_workers=2)
+    epoch1 = [b["label"].tolist() for b in dl]
+    assert sorted(sum(epoch1, [])) == list(range(10))
+    assert [len(x) for x in epoch1] == [4, 4, 2]  # remainder kept
+    epoch2 = [b["label"].tolist() for b in dl]
+    assert epoch1 != epoch2  # reshuffled per epoch
+
+    dl2 = DataLoader(_SquaresDataset(), batch_size=4, shuffle=True, seed=7,
+                     num_workers=2)
+    assert [b["label"].tolist() for b in dl2] == epoch1  # seed-deterministic
+    assert len(dl2) == 3
+
+
+def test_dataloader_transform_applied_in_order():
+    calls = []
+
+    def t1(s, rng):
+        s["image"] = s["image"] + 1
+        return s
+
+    dl = DataLoader(_SquaresDataset(), batch_size=10, transform=Compose([t1]),
+                    num_workers=4, prefetch=0)
+    (batch,) = list(dl)
+    # order preserved despite parallel map
+    np.testing.assert_allclose(batch["image"][:, 0, 0, 0], np.arange(10) + 1)
+
+
+def test_dataloader_iterable_with_shuffle_buffer():
+    def gen():
+        for i in range(20):
+            yield {"x": np.int32(i)}
+
+    class It:
+        def __iter__(self):
+            return gen()
+
+    dl = DataLoader(It(), batch_size=5, shuffle=True, shuffle_buffer=8, seed=1)
+    vals = sum((b["x"].tolist() for b in dl), [])
+    assert sorted(vals) == list(range(20))
+    assert vals != list(range(20))  # actually shuffled
+
+
+def test_dataloader_error_propagates():
+    def boom(s, rng):
+        raise RuntimeError("decode failed")
+
+    dl = DataLoader(_SquaresDataset(), batch_size=4, transform=boom)
+    with pytest.raises(RuntimeError, match="decode failed"):
+        list(dl)

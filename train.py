@@ -1,0 +1,10 @@
+#!/usr/bin/env python
+"""`python train.py -m <config> [-c ckpt]` — see deep_vision_tpu/train_cli.py.
+
+The single entry point replacing the reference's 12 per-model train scripts
+(`python train.py -m resnet50` contract, ResNet/pytorch/train.py:541-562).
+"""
+from deep_vision_tpu.train_cli import main
+
+if __name__ == "__main__":
+    raise SystemExit(main())
