@@ -1,0 +1,89 @@
+"""TensorBoard event-file writer, implemented natively (no TF dependency).
+
+The reference logs scalars through Keras TensorBoard callbacks and
+`tf.summary.scalar` (ResNet/tensorflow/train.py:268-269,
+YOLO/tensorflow/train.py:159-179, 12 CycleGAN scalars at
+CycleGAN/tensorflow/train.py:267-304). This writer produces the same on-disk
+artifact — `events.out.tfevents.*` files TensorBoard reads — using the
+record framing from `data.records` plus a hand-rolled Event/Summary proto
+encoder (wire schema below), so dashboards work without TF on the host.
+
+    Event   { 1: wall_time (double), 2: step (int64),
+              3: file_version (string), 5: summary (Summary) }
+    Summary { repeated 1: Value { 1: tag (string), 2: simple_value (float) } }
+"""
+from __future__ import annotations
+
+import os
+import socket
+import struct
+import time
+from typing import Optional
+
+from deep_vision_tpu.data.example_codec import _tag, _write_varint
+from deep_vision_tpu.data.records import RecordWriter
+
+
+def _encode_event(
+    wall_time: float,
+    step: int = 0,
+    file_version: Optional[str] = None,
+    tag: Optional[str] = None,
+    simple_value: Optional[float] = None,
+) -> bytes:
+    buf = bytearray()
+    _write_varint(buf, _tag(1, 1))  # wall_time: double (wire type I64)
+    buf += struct.pack("<d", wall_time)
+    if step:
+        _write_varint(buf, _tag(2, 0))
+        _write_varint(buf, step)
+    if file_version is not None:
+        fv = file_version.encode()
+        _write_varint(buf, _tag(3, 2))
+        _write_varint(buf, len(fv))
+        buf += fv
+    if tag is not None:
+        value = bytearray()
+        tb = tag.encode()
+        _write_varint(value, _tag(1, 2))
+        _write_varint(value, len(tb))
+        value += tb
+        _write_varint(value, _tag(2, 5))  # simple_value: float (wire I32)
+        value += struct.pack("<f", float(simple_value))
+        summary = bytearray()
+        _write_varint(summary, _tag(1, 2))
+        _write_varint(summary, len(value))
+        summary += value
+        _write_varint(buf, _tag(5, 2))
+        _write_varint(buf, len(summary))
+        buf += summary
+    return bytes(buf)
+
+
+class SummaryWriter:
+    """Minimal TensorBoard scalar writer: `scalar(tag, value, step)`.
+
+    Satisfies the `tb_writer` interface MetricLogger consumes.
+    """
+
+    def __init__(self, logdir: str):
+        os.makedirs(logdir, exist_ok=True)
+        fname = (
+            f"events.out.tfevents.{int(time.time())}.{socket.gethostname()}"
+        )
+        self.path = os.path.join(logdir, fname)
+        self._w = RecordWriter(self.path)
+        self._w.write(_encode_event(time.time(), file_version="brain.Event:2"))
+        self._w.flush()
+
+    def scalar(self, tag: str, value: float, step: int) -> None:
+        self._w.write(
+            _encode_event(time.time(), step=int(step), tag=tag,
+                          simple_value=float(value))
+        )
+
+    def flush(self) -> None:
+        self._w.flush()
+
+    def close(self) -> None:
+        self._w.close()

@@ -1,0 +1,114 @@
+"""Multi-host distributed runtime: initialization, global mesh, host sync.
+
+The reference advertises but never ships multi-host training (`train_dist.py`
+is referenced at ResNet/pytorch/README.md:15 and absent — SURVEY.md §2.9);
+its real distributed story is single-host NCCL via MirroredStrategy
+(YOLO/tensorflow/train.py:281). The TPU-native equivalent is radically
+simpler: every host runs the SAME SPMD program, `jax.distributed.initialize`
+wires the cluster, the mesh spans all hosts' devices, and XLA routes
+collectives over ICI within a slice and DCN across slices. There is no
+NCCL/MPI code to write — the comm backend IS the mesh + partitioner.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+import numpy as np
+
+from deep_vision_tpu.parallel.mesh import MeshSpec, create_mesh
+
+
+def initialize_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Wire this host into the cluster (idempotent; no-op single-process).
+
+    With no args, reads the standard env (JAX_COORDINATOR_ADDRESS /
+    JAX_NUM_PROCESSES / JAX_PROCESS_ID, or the TPU metadata server on Cloud
+    TPU pods where initialize() autodetects everything).
+    """
+    coordinator_address = coordinator_address or os.environ.get(
+        "JAX_COORDINATOR_ADDRESS"
+    )
+    if num_processes is None:
+        num_processes = int(os.environ.get("JAX_NUM_PROCESSES", "0")) or None
+    if process_id is None:
+        pid = os.environ.get("JAX_PROCESS_ID")
+        process_id = int(pid) if pid is not None else None
+    if coordinator_address is None and num_processes in (None, 1):
+        return  # single host, nothing to wire
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def global_mesh(data: int = -1, model: int = 1):
+    """Mesh over every device in the cluster (all hosts).
+
+    Device order from `jax.devices()` keeps each host's devices contiguous,
+    so a (data, model) reshape puts the model axis inside a host whenever
+    model <= devices-per-host — TP collectives ride ICI, only DP gradient
+    reduction crosses DCN (the layout recipe from the scaling playbook).
+    """
+    return create_mesh(MeshSpec(data=data, model=model), devices=jax.devices())
+
+
+def process_count() -> int:
+    return jax.process_count()
+
+
+def process_index() -> int:
+    return jax.process_index()
+
+
+def is_primary() -> bool:
+    """True on the host that should write checkpoints/logs (process 0)."""
+    return jax.process_index() == 0
+
+
+def host_shard() -> tuple[int, int]:
+    """(shard_index, num_shards) for host-sharded input pipelines: each host
+    reads files[shard_index::num_shards] (records.record_iterator contract)."""
+    return jax.process_index(), jax.process_count()
+
+
+def sync_hosts(name: str = "barrier") -> None:
+    """Cross-host barrier (a real one: all-device collective rendezvous)."""
+    if jax.process_count() == 1:
+        return
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices(name)
+
+
+def per_host_batch_size(global_batch_size: int) -> int:
+    """Rows this host must feed per step (global batch / host count); the
+    global-batch contract mirrors `batch * num_replicas` at
+    YOLO/tensorflow/train.py:282 but spans hosts."""
+    n = jax.process_count()
+    if global_batch_size % n:
+        raise ValueError(f"global batch {global_batch_size} not divisible by {n} hosts")
+    return global_batch_size // n
+
+
+def form_global_array(local_batch, mesh, ndim: Optional[int] = None):
+    """Assemble per-host numpy rows into one globally-sharded jax.Array.
+
+    Each host passes only ITS rows; `make_array_from_process_local_data`
+    stitches them into the global batch laid out over the mesh's data axis —
+    the multi-host device_put (single-host path stays `shard_batch`).
+    """
+    from deep_vision_tpu.parallel.mesh import data_sharding
+
+    def _make(x):
+        x = np.asarray(x)
+        sharding = data_sharding(mesh, x.ndim)
+        return jax.make_array_from_process_local_data(sharding, x)
+
+    return jax.tree_util.tree_map(_make, local_batch)

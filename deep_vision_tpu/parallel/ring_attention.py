@@ -1,0 +1,122 @@
+"""Ring attention: sequence/context parallelism over the mesh's data axis.
+
+The reference is a CNN zoo with no attention or sequence dimension anywhere
+(SURVEY.md §5 'long-context: N/A'), but this framework treats long-context as
+first-class so attention workloads scale past one chip's HBM. Design follows
+the blockwise-parallel / ring-attention recipe (Liu et al. 2023): shard the
+sequence across devices, keep Q resident, rotate K/V blocks around the ring
+with `ppermute` (one ICI hop per step, compute overlapping communication),
+and merge per-block attention with a numerically-stable online softmax — the
+same log-sum-exp accumulation flash attention uses, so the result is exact,
+not approximate.
+
+Layout contract: (batch, seq, heads, head_dim) with seq sharded over
+`axis_name`. Collectives ride ICI inside a slice, DCN across hosts — no
+NCCL/MPI analog needed (cf. SURVEY.md §2.5 comm-backend row).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from deep_vision_tpu.parallel.mesh import DATA_AXIS
+
+
+def _block_attend(q, k, v, scale, mask):
+    """Scores + masked stable-softmax pieces for one (q_blk, kv_blk) pair.
+
+    Returns (numerator (B,T,H,D), TRUE row max (B,H,T) — -inf for rows with
+    no visible keys in this block — and row sumexp (B,H,T)). Carrying the
+    true max (not a 0-clamped one) keeps the online-softmax merge exact even
+    when every real score is far below zero.
+    """
+    s = jnp.einsum("bthd,bshd->bhts", q, k) * scale  # (B,H,Tq,Ts)
+    s = jnp.where(mask, s, -jnp.inf)
+    m = jnp.max(s, axis=-1)  # (B,H,Tq); -inf when fully masked
+    m_safe = jnp.where(jnp.isfinite(m), m, 0.0)
+    p = jnp.exp(s - m_safe[..., None])  # fully-masked rows: exp(-inf) = 0
+    l = jnp.sum(p, axis=-1)  # (B,H,Tq)
+    o = jnp.einsum("bhts,bshd->bthd", p, v)
+    return o, m, l
+
+
+def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool,
+                          scale: Optional[float]):
+    """Per-shard body (runs under shard_map). q/k/v: (B, T_loc, H, D)."""
+    n = jax.lax.psum(1, axis_name)
+    my = jax.lax.axis_index(axis_name)
+    t_loc = q.shape[1]
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    q_pos = my * t_loc + jnp.arange(t_loc)  # global positions of local queries
+
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    def step(i, carry):
+        o, m, l, k_blk, v_blk = carry
+        src = (my - i) % n  # which shard this K/V block came from
+        k_pos = src * t_loc + jnp.arange(t_loc)
+        if causal:
+            mask = q_pos[:, None] >= k_pos[None, :]  # (Tq, Ts)
+        else:
+            mask = jnp.ones((t_loc, t_loc), bool)
+        o_i, m_i, l_i = _block_attend(q, k_blk, v_blk, scale,
+                                      mask[None, None, :, :])
+        # online-softmax merge of (o, m, l) with the new block; maxes are the
+        # TRUE row maxes (possibly -inf), so guard the -inf - -inf case
+        m_new = jnp.maximum(m, m_i)
+        m_new_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        a = jnp.where(jnp.isfinite(m), jnp.exp(m - m_new_safe), 0.0)
+        b = jnp.where(jnp.isfinite(m_i), jnp.exp(m_i - m_new_safe), 0.0)
+        o = o * a.transpose(0, 2, 1)[..., None] + o_i * b.transpose(0, 2, 1)[..., None]
+        l = l * a + l_i * b
+        # rotate K/V one hop around the ring (overlaps with next block's FLOPs)
+        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+        return o, m_new, l, k_blk, v_blk
+
+    o0 = jnp.zeros_like(q)
+    m0 = jnp.full((q.shape[0], q.shape[2], t_loc), -jnp.inf, q.dtype)
+    l0 = jnp.zeros((q.shape[0], q.shape[2], t_loc), q.dtype)
+    # constants start axis-unvarying under shard_map; mark them varying so the
+    # loop carry type is stable across iterations
+    m0 = jax.lax.pvary(m0, (axis_name,))
+    l0 = jax.lax.pvary(l0, (axis_name,))
+    o, m, l, _, _ = jax.lax.fori_loop(0, n, step, (o0, m0, l0, k, v))
+    denom = jnp.maximum(l, 1e-20).transpose(0, 2, 1)[..., None]
+    return o / denom
+
+
+def ring_attention(
+    q, k, v, mesh: Mesh, *, causal: bool = False,
+    axis_name: str = DATA_AXIS, scale: Optional[float] = None,
+):
+    """Exact attention over a sequence sharded across `axis_name`.
+
+    q, k, v: (B, T, H, D) global shapes, T divisible by the axis size.
+    Returns (B, T, H, D) with the same sharding.
+    """
+    spec = P(None, axis_name, None, None)
+    fn = functools.partial(
+        _ring_attention_local, axis_name=axis_name, causal=causal, scale=scale
+    )
+    mapped = jax.shard_map(
+        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
+    )
+    return mapped(q, k, v)
+
+
+def dense_attention(q, k, v, *, causal: bool = False,
+                    scale: Optional[float] = None):
+    """Single-device reference implementation (golden for tests)."""
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    s = jnp.einsum("bthd,bshd->bhts", q, k) * scale
+    if causal:
+        t, s_ = s.shape[-2], s.shape[-1]
+        mask = jnp.arange(t)[:, None] >= jnp.arange(s_)[None, :]
+        s = jnp.where(mask[None, None], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhts,bshd->bthd", p, v)

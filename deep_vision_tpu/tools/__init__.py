@@ -1,0 +1,27 @@
+"""Offline dataset tooling: converters to sharded record files.
+
+Replaces the reference's `Datasets/` scripts (VOC2007/VOC2012/MSCOCO/MPII
+Ray-parallel TFRecord builders, the 710-line threaded ImageNet converter,
+CycleGAN's single-file builder) with one process-parallel fan-out
+(`converters.build_shards`) plus per-dataset Example builders that write the
+SAME field names the reference's schemas use — shards are interchangeable.
+"""
+from deep_vision_tpu.tools.converters import (
+    build_shards,
+    chunkify,
+    coco_annotations,
+    cyclegan_examples,
+    imagenet_annotations,
+    mpii_annotations,
+    voc_annotations,
+)
+
+__all__ = [
+    "build_shards",
+    "chunkify",
+    "coco_annotations",
+    "cyclegan_examples",
+    "imagenet_annotations",
+    "mpii_annotations",
+    "voc_annotations",
+]

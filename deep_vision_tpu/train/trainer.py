@@ -66,6 +66,8 @@ class Trainer:
         plateau_metric: str = "top1",
         logger: Optional[MetricLogger] = None,
         eval_logger: Optional[MetricLogger] = None,
+        profile_dir: Optional[str] = None,
+        profile_steps: tuple = (10, 20),
     ):
         self.mesh = mesh if mesh is not None else create_mesh()
         self.loss_fn = loss_fn
@@ -76,6 +78,12 @@ class Trainer:
         self.plateau_metric = plateau_metric
         self.logger = logger or MetricLogger(name="train")
         self.eval_logger = eval_logger or MetricLogger(name="val", print_every=0)
+        # profiler hook: the instrumentation the reference never had
+        # (SURVEY.md §2.7 'tracing/profilers: NONE'); trace is captured for
+        # steps [start, stop) and viewed with tensorboard-plugin-profile/xprof
+        self.profile_dir = profile_dir
+        self.profile_steps = profile_steps
+        self._profiling = False
 
         state = create_train_state(model, tx, sample_input, rng)
         # device boundary: state lives replicated on the mesh from here on
@@ -141,7 +149,22 @@ class Trainer:
             batch["_mask"] = mask
         return batch
 
+    def _profiler_hook(self):
+        if self.profile_dir is None:
+            return
+        # int() syncs on the in-flight state; only pay it when profiling
+        step = int(self.state.step)
+        start, stop = self.profile_steps
+        if not self._profiling and step == start:
+            jax.profiler.start_trace(self.profile_dir)
+            self._profiling = True
+        elif self._profiling and step >= stop:
+            jax.block_until_ready(self.state.params)
+            jax.profiler.stop_trace()
+            self._profiling = False
+
     def train_step(self, batch) -> dict:
+        self._profiler_hook()
         batch = shard_batch(self.mesh, self._pad_and_mask(batch))
         self.state, metrics = self._train_step(self.state, batch)
         return metrics
@@ -215,6 +238,9 @@ class Trainer:
                     int(self.state.step), self.state, host_state=host_state,
                     metrics=val_summary,
                 )
+        if self._profiling:  # stop gate never reached (short run)
+            jax.profiler.stop_trace()
+            self._profiling = False
         if self.ckpt is not None:
             self.ckpt.wait()
         return self.state

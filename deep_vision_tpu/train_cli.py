@@ -243,7 +243,9 @@ def _build_schedule(cfg: ExperimentConfig, steps_per_epoch: int):
     return make_schedule(kind, base_lr, **kw)
 
 
-def build_trainer(cfg: ExperimentConfig, train_fn, ckpt_dir: Optional[str]):
+def build_trainer(cfg: ExperimentConfig, train_fn, ckpt_dir: Optional[str],
+                  tb_dir: Optional[str] = None,
+                  profile_dir: Optional[str] = None):
     import functools
 
     import jax.numpy as jnp
@@ -294,9 +296,18 @@ def build_trainer(cfg: ExperimentConfig, train_fn, ckpt_dir: Optional[str]):
     plateau = ReduceLROnPlateau(**cfg.plateau) if cfg.plateau else None
     ckpt = CheckpointManager(ckpt_dir) if ckpt_dir else None
     sample = jnp.ones((2, *cfg.input_shape), jnp.float32)
+    logger = eval_logger = None
+    if tb_dir:
+        from deep_vision_tpu.core.metrics import MetricLogger
+        from deep_vision_tpu.core.tensorboard import SummaryWriter
+
+        tb = SummaryWriter(tb_dir)
+        logger = MetricLogger(tb_writer=tb, name="train")
+        eval_logger = MetricLogger(tb_writer=tb, name="val", print_every=0)
     return Trainer(
         model, tx, loss_fn, sample, plateau=plateau,
         plateau_metric=plateau_metric, checkpoint_manager=ckpt,
+        logger=logger, eval_logger=eval_logger, profile_dir=profile_dir,
     )
 
 
@@ -341,6 +352,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--num-workers", type=int, default=8)
     parser.add_argument("--fake-data", action="store_true")
     parser.add_argument("--fake-batches", type=int, default=4)
+    parser.add_argument("--tensorboard-dir", default=None)
+    parser.add_argument("--profile-dir", default=None,
+                        help="capture a jax.profiler trace of steps 10-20")
     parser.add_argument("--eval-first", action="store_true",
                         help="epoch-0 sanity validate (ResNet/pytorch/train.py:390)")
     args = parser.parse_args(argv)
@@ -372,7 +386,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 0
 
     ckpt_dir = args.ckpt_dir or os.path.join("checkpoints", cfg.name)
-    trainer = build_trainer(cfg, train_fn, ckpt_dir)
+    trainer = build_trainer(cfg, train_fn, ckpt_dir,
+                            tb_dir=args.tensorboard_dir,
+                            profile_dir=args.profile_dir)
     start_epoch = 0
     if args.checkpoint:
         if args.checkpoint != "auto":

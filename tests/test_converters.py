@@ -1,0 +1,137 @@
+"""Converter round-trips: build tiny datasets on disk, convert to shards,
+read back through the data layer's schemas (end-to-end format compatibility:
+what `tools.convert` writes, `RecordDataset` trains from)."""
+import json
+import os
+
+import cv2
+import numpy as np
+import pytest
+
+from deep_vision_tpu.data import RecordDataset
+from deep_vision_tpu.tools import converters as C
+from deep_vision_tpu.tools.convert import main as convert_main
+
+
+def _write_jpeg(path, h=24, w=32):
+    img = np.random.RandomState(0).randint(0, 255, (h, w, 3), np.uint8)
+    cv2.imwrite(str(path), img)
+
+
+def _make_voc(tmp_path):
+    root = tmp_path / "VOC2007"
+    for d in ("Annotations", "JPEGImages", "ImageSets/Main"):
+        os.makedirs(root / d, exist_ok=True)
+    ids = ["000001", "000002", "000003"]
+    for i in ids:
+        _write_jpeg(root / "JPEGImages" / f"{i}.jpg")
+        (root / "Annotations" / f"{i}.xml").write_text(f"""
+<annotation>
+  <size><width>32</width><height>24</height><depth>3</depth></size>
+  <object><name>dog</name>
+    <bndbox><xmin>4</xmin><ymin>6</ymin><xmax>20</xmax><ymax>18</ymax></bndbox>
+  </object>
+  <object><name>person</name>
+    <bndbox><xmin>8</xmin><ymin>2</ymin><xmax>30</xmax><ymax>22</ymax></bndbox>
+  </object>
+</annotation>""")
+    (root / "ImageSets/Main/train.txt").write_text("\n".join(ids) + "\n")
+    return root
+
+
+def test_voc_convert_roundtrip(tmp_path):
+    root = _make_voc(tmp_path)
+    out = tmp_path / "records"
+    rc = convert_main([
+        "voc", "--voc-root", str(root), "--split", "train",
+        "--out-dir", str(out), "--num-shards", "2", "--workers", "1",
+    ])
+    assert rc == 0
+    shards = sorted(os.listdir(out))
+    assert len(shards) == 2
+    ds = RecordDataset(str(out / "train_*"), schema="voc")
+    samples = list(ds)
+    assert len(samples) == 3
+    s = samples[0]
+    assert s["image"].shape == (24, 32, 3)
+    np.testing.assert_allclose(
+        s["boxes"][0], [4 / 32, 6 / 24, 20 / 32, 18 / 24], atol=1e-6
+    )
+    assert s["classes"].tolist() == [
+        C.VOC_CLASSES.index("dog"), C.VOC_CLASSES.index("person")
+    ]
+
+
+def test_coco_convert_roundtrip(tmp_path):
+    imgs = tmp_path / "images"
+    os.makedirs(imgs)
+    _write_jpeg(imgs / "img1.jpg", h=40, w=60)
+    coco = {
+        "images": [{"id": 7, "file_name": "img1.jpg", "width": 60, "height": 40}],
+        "categories": [{"id": 18, "name": "dog"}, {"id": 1, "name": "person"}],
+        "annotations": [
+            {"image_id": 7, "category_id": 18, "bbox": [6, 8, 12, 16],
+             "iscrowd": 0},
+            {"image_id": 7, "category_id": 1, "bbox": [0, 0, 30, 20],
+             "iscrowd": 1},  # crowd: dropped
+        ],
+    }
+    jpath = tmp_path / "instances.json"
+    jpath.write_text(json.dumps(coco))
+    out = tmp_path / "records"
+    convert_main([
+        "coco", "--instances-json", str(jpath), "--images-dir", str(imgs),
+        "--out-dir", str(out), "--num-shards", "1", "--workers", "1",
+    ])
+    (sample,) = list(RecordDataset(str(out / "train_*"), schema="coco"))
+    assert sample["image"].shape == (40, 60, 3)
+    np.testing.assert_allclose(
+        sample["boxes"], [[6 / 60, 8 / 40, 18 / 60, 24 / 40]], atol=1e-6
+    )
+    # dense remap sorted by original id: person(1)->0, dog(18)->1
+    assert sample["classes"].tolist() == [1]
+
+
+def test_mpii_convert_roundtrip(tmp_path):
+    imgs = tmp_path / "images"
+    os.makedirs(imgs)
+    _write_jpeg(imgs / "p.jpg", h=50, w=100)
+    people = [{
+        "image": "p.jpg",
+        "joints": [[10 * j, 2 * j] for j in range(16)],
+        "joints_vis": [1] * 8 + [0] * 8,
+    }]
+    jpath = tmp_path / "train.json"
+    jpath.write_text(json.dumps(people))
+    out = tmp_path / "records"
+    convert_main([
+        "mpii", "--json", str(jpath), "--images-dir", str(imgs),
+        "--out-dir", str(out), "--num-shards", "1", "--workers", "1",
+    ])
+    (s,) = list(RecordDataset(str(out / "train_*"), schema="mpii"))
+    assert s["keypoints"].shape == (16, 2)
+    np.testing.assert_allclose(s["keypoints"][2], [20 / 100, 4 / 50], atol=1e-6)
+    assert s["visibility"].tolist() == [1.0] * 8 + [0.0] * 8
+
+
+def test_imagenet_convert_roundtrip(tmp_path):
+    root = tmp_path / "train_flatten"
+    os.makedirs(root)
+    _write_jpeg(root / "n01440764_1.JPEG")
+    _write_jpeg(root / "n01443537_1.JPEG")
+    synsets = tmp_path / "synsets.txt"
+    synsets.write_text("n01440764\nn01443537\n")
+    out = tmp_path / "records"
+    convert_main([
+        "imagenet", "--root", str(root), "--synsets", str(synsets),
+        "--out-dir", str(out), "--num-shards", "2", "--workers", "2",
+    ])
+    samples = list(RecordDataset(str(out / "train_*"), schema="imagenet"))
+    # writer labels are 1-based (background=0); schema shifts to 0-based
+    assert sorted(int(s["label"]) for s in samples) == [0, 1]
+
+
+def test_chunkify():
+    assert C.chunkify(list(range(10)), 3) == [[0, 1, 2, 3], [4, 5, 6, 7], [8, 9]]
+    assert C.chunkify([], 4) == []
+    assert C.chunkify([1], 5) == [[1]]
