@@ -211,6 +211,9 @@ class RecordDataset:
         if self.shuffle_shards:
             np.random.RandomState(self.seed + self._epoch).shuffle(files)
         self._epoch += 1
+        from deep_vision_tpu.data.records import best_reader
+
+        reader = best_reader()
         for path in files:
-            for raw in read_records(path):
+            for raw in reader(path):
                 yield self.schema(decode_example(raw))

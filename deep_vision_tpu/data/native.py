@@ -1,0 +1,119 @@
+"""ctypes binding to the native record-IO runtime (native/libdvtpu.so).
+
+The C++ reader (native/record_reader.cc) parses record framing + crc32c off
+the GIL and prefetches multiple shards with a thread pool; this module makes
+it a drop-in for the pure-Python `data.records` functions. Falls back to
+None when the library hasn't been built (`make -C native`) — callers gate on
+`load_library() is not None`.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Iterator, List, Optional, Sequence
+
+_OK, _EOF, _CORRUPT, _IOERR, _TRUNCATED = 0, 1, 2, 3, 4
+
+_lib: Optional[ctypes.CDLL] = None
+_lib_tried = False
+
+
+def _repo_lib_path() -> str:
+    here = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    return os.path.join(here, "native", "libdvtpu.so")
+
+
+def load_library(path: Optional[str] = None) -> Optional[ctypes.CDLL]:
+    """Load libdvtpu.so (env DVTPU_NATIVE_LIB > repo native/). None if absent."""
+    global _lib, _lib_tried
+    if _lib is not None:
+        return _lib
+    if _lib_tried and path is None:
+        return None
+    _lib_tried = True
+    candidates = (
+        [path] if path else
+        [os.environ.get("DVTPU_NATIVE_LIB", ""), _repo_lib_path()]
+    )
+    for cand in candidates:
+        if not cand or not os.path.exists(cand):
+            continue
+        lib = ctypes.CDLL(cand)
+        lib.dv_reader_open.restype = ctypes.c_void_p
+        lib.dv_reader_open.argtypes = [ctypes.c_char_p, ctypes.c_int]
+        lib.dv_reader_next.restype = ctypes.c_int
+        lib.dv_reader_next.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+            ctypes.POINTER(ctypes.c_uint64),
+        ]
+        lib.dv_reader_close.argtypes = [ctypes.c_void_p]
+        lib.dv_pool_open.restype = ctypes.c_void_p
+        lib.dv_pool_open.argtypes = [
+            ctypes.POINTER(ctypes.c_char_p), ctypes.c_int, ctypes.c_int,
+            ctypes.c_uint64, ctypes.c_int,
+        ]
+        lib.dv_pool_next.restype = ctypes.c_int
+        lib.dv_pool_next.argtypes = lib.dv_reader_next.argtypes
+        lib.dv_pool_close.argtypes = [ctypes.c_void_p]
+        lib.dv_masked_crc32c.restype = ctypes.c_uint32
+        lib.dv_masked_crc32c.argtypes = [
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_uint64
+        ]
+        _lib = lib
+        return _lib
+    return None
+
+
+def _drain(lib, handle, next_fn, close_fn, what: str) -> Iterator[bytes]:
+    data = ctypes.POINTER(ctypes.c_uint8)()
+    length = ctypes.c_uint64()
+    try:
+        while True:
+            rc = next_fn(handle, ctypes.byref(data), ctypes.byref(length))
+            if rc == _EOF:
+                return
+            # exception parity with records.read_records: truncation is
+            # EOFError (records.py), CRC mismatch is IOError
+            if rc == _TRUNCATED:
+                raise EOFError(f"truncated record in {what}")
+            if rc == _CORRUPT:
+                raise IOError(f"corrupt record in {what}")
+            if rc == _IOERR:
+                raise IOError(f"io error reading {what}")
+            yield ctypes.string_at(data, length.value)
+    finally:
+        close_fn(handle)
+
+
+def read_records_native(path: str, verify: bool = True) -> Iterator[bytes]:
+    """Native twin of records.read_records (same exceptions, same output)."""
+    lib = load_library()
+    assert lib is not None, "native library not built (make -C native)"
+    handle = lib.dv_reader_open(path.encode(), int(verify))
+    if not handle:
+        if not os.path.exists(path):
+            raise FileNotFoundError(path)
+        raise IOError(f"cannot open {path}")
+    yield from _drain(lib, handle, lib.dv_reader_next, lib.dv_reader_close,
+                      path)
+
+
+def pool_records_native(
+    paths: Sequence[str], num_threads: int = 4, capacity: int = 256,
+    verify: bool = True,
+) -> Iterator[bytes]:
+    """Multi-shard threaded prefetch. NOTE: records from different shards
+    interleave nondeterministically (throughput mode; use
+    read_records_native per file when order matters)."""
+    lib = load_library()
+    assert lib is not None, "native library not built (make -C native)"
+    arr = (ctypes.c_char_p * len(paths))(*[p.encode() for p in paths])
+    handle = lib.dv_pool_open(arr, len(paths), num_threads, capacity,
+                              int(verify))
+    yield from _drain(lib, handle, lib.dv_pool_next, lib.dv_pool_close,
+                      f"pool of {len(paths)} shards")
+
+
+def native_available() -> bool:
+    return load_library() is not None

@@ -1,0 +1,80 @@
+#include "crc32c.h"
+
+namespace dvtpu {
+namespace {
+
+// 8 tables of 256 entries, generated at first use (slice-by-8).
+struct Tables {
+  uint32_t t[8][256];
+  Tables() {
+    constexpr uint32_t kPoly = 0x82f63b78u;  // reversed Castagnoli
+    for (uint32_t i = 0; i < 256; ++i) {
+      uint32_t crc = i;
+      for (int j = 0; j < 8; ++j)
+        crc = (crc >> 1) ^ ((crc & 1) ? kPoly : 0);
+      t[0][i] = crc;
+    }
+    for (uint32_t i = 0; i < 256; ++i)
+      for (int k = 1; k < 8; ++k)
+        t[k][i] = (t[k - 1][i] >> 8) ^ t[0][t[k - 1][i] & 0xff];
+  }
+};
+
+const Tables& tables() {
+  static const Tables kTables;
+  return kTables;
+}
+
+}  // namespace
+
+#if defined(__SSE4_2__)
+// Hardware path: one crc32 instruction per 8 bytes (~an order of magnitude
+// faster than the table path; matches google_crc32c's accelerated build).
+static uint32_t Crc32cHw(uint32_t crc, const uint8_t* p, size_t len) {
+  crc = ~crc;
+  while (len && (reinterpret_cast<uintptr_t>(p) & 7)) {
+    crc = __builtin_ia32_crc32qi(crc, *p++);
+    --len;
+  }
+  uint64_t crc64 = crc;
+  while (len >= 8) {
+    uint64_t v;
+    __builtin_memcpy(&v, p, 8);
+    crc64 = __builtin_ia32_crc32di(crc64, v);
+    p += 8;
+    len -= 8;
+  }
+  crc = static_cast<uint32_t>(crc64);
+  while (len--) crc = __builtin_ia32_crc32qi(crc, *p++);
+  return ~crc;
+}
+#endif
+
+uint32_t Crc32c(uint32_t crc, const void* buf, size_t len) {
+#if defined(__SSE4_2__)
+  return Crc32cHw(crc, static_cast<const uint8_t*>(buf), len);
+#endif
+  const auto& tb = tables();
+  const uint8_t* p = static_cast<const uint8_t*>(buf);
+  crc = ~crc;
+  // align to 8 bytes
+  while (len && (reinterpret_cast<uintptr_t>(p) & 7)) {
+    crc = tb.t[0][(crc ^ *p++) & 0xff] ^ (crc >> 8);
+    --len;
+  }
+  while (len >= 8) {
+    uint64_t v;
+    __builtin_memcpy(&v, p, 8);
+    v ^= crc;  // little-endian assumption (x86/arm64)
+    crc = tb.t[7][v & 0xff] ^ tb.t[6][(v >> 8) & 0xff] ^
+          tb.t[5][(v >> 16) & 0xff] ^ tb.t[4][(v >> 24) & 0xff] ^
+          tb.t[3][(v >> 32) & 0xff] ^ tb.t[2][(v >> 40) & 0xff] ^
+          tb.t[1][(v >> 48) & 0xff] ^ tb.t[0][(v >> 56) & 0xff];
+    p += 8;
+    len -= 8;
+  }
+  while (len--) crc = tb.t[0][(crc ^ *p++) & 0xff] ^ (crc >> 8);
+  return ~crc;
+}
+
+}  // namespace dvtpu

@@ -1,0 +1,24 @@
+// crc32c (Castagnoli) — software slice-by-8 implementation.
+//
+// Needed by the record reader to verify TFRecord-framing checksums
+// (data/records.py is the Python twin; format docs there). SSE4.2 hardware
+// path when the Makefile enables it (x86_64), slice-by-8 table fallback
+// otherwise.
+#pragma once
+
+#include <cstddef>
+#include <cstdint>
+
+namespace dvtpu {
+
+// CRC-32C of buf[0..len); crc is the running value (0 for a fresh start).
+uint32_t Crc32c(uint32_t crc, const void* buf, size_t len);
+
+// TFRecord masking: rotate right 15 + magic delta.
+inline uint32_t MaskedCrc32c(const void* buf, size_t len) {
+  constexpr uint32_t kMaskDelta = 0xa282ead8ul;
+  uint32_t crc = Crc32c(0, buf, len);
+  return ((crc >> 15) | (crc << 17)) + kMaskDelta;
+}
+
+}  // namespace dvtpu

@@ -1,0 +1,101 @@
+"""Native (C++) record reader vs the pure-Python twin.
+
+Builds native/libdvtpu.so via make if missing; skips when no toolchain.
+"""
+import os
+import shutil
+import subprocess
+
+import numpy as np
+import pytest
+
+from deep_vision_tpu.data.records import write_records, read_records
+
+NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(__file__)), "native")
+
+
+@pytest.fixture(scope="module")
+def native():
+    lib = os.path.join(NATIVE_DIR, "libdvtpu.so")
+    if not os.path.exists(lib):
+        if shutil.which("make") is None or shutil.which("g++") is None:
+            pytest.skip("no native toolchain")
+        subprocess.run(["make", "-C", NATIVE_DIR], check=True,
+                       capture_output=True)
+    from deep_vision_tpu.data import native as native_mod
+
+    assert native_mod.load_library() is not None
+    return native_mod
+
+
+def _shards(tmp_path, n_shards=3, n_records=50, size=1000):
+    rng = np.random.RandomState(0)
+    paths = []
+    for s in range(n_shards):
+        p = str(tmp_path / f"shard{s}.tfrecord")
+        write_records(p, [rng.bytes(size) for _ in range(n_records)])
+        paths.append(p)
+    return paths
+
+
+def test_native_single_file_matches_python(native, tmp_path):
+    (path,) = _shards(tmp_path, n_shards=1)
+    assert list(native.read_records_native(path)) == list(read_records(path))
+
+
+def test_native_crc_matches_python(native, tmp_path):
+    import ctypes
+
+    from deep_vision_tpu.data.records import _masked_crc
+
+    lib = native.load_library()
+    for payload in (b"", b"x", b"hello world" * 100):
+        arr = (ctypes.c_uint8 * len(payload))(*payload)
+        assert lib.dv_masked_crc32c(arr, len(payload)) == _masked_crc(payload)
+
+
+def test_native_detects_corruption(native, tmp_path):
+    (path,) = _shards(tmp_path, n_shards=1)
+    with open(path, "r+b") as f:
+        f.seek(20)
+        f.write(b"\xff\xff")
+    with pytest.raises(IOError):
+        list(native.read_records_native(path))
+    # pool is sticky-corrupt too
+    with pytest.raises(IOError):
+        list(native.pool_records_native([path]))
+
+
+def test_native_truncation_is_eof_error(native, tmp_path):
+    # exception parity with the python reader: truncation -> EOFError
+    (path,) = _shards(tmp_path, n_shards=1, n_records=3, size=500)
+    size = os.path.getsize(path)
+    with open(path, "r+b") as f:
+        f.truncate(size - 100)
+    with pytest.raises(EOFError):
+        list(native.read_records_native(path))
+    with pytest.raises(EOFError):
+        list(read_records(path))  # python twin agrees
+    with pytest.raises(EOFError):
+        list(native.pool_records_native([path]))
+
+
+def test_native_pool_complete_no_dups(native, tmp_path):
+    paths = _shards(tmp_path, n_shards=4, n_records=100)
+    expected = sorted(sum((list(read_records(p)) for p in paths), []))
+    got = sorted(native.pool_records_native(paths, num_threads=4))
+    assert got == expected
+
+
+def test_native_missing_file(native, tmp_path):
+    with pytest.raises(FileNotFoundError):
+        list(native.read_records_native(str(tmp_path / "nope.tfrecord")))
+    with pytest.raises(IOError):
+        list(native.pool_records_native([str(tmp_path / "nope.tfrecord")]))
+
+
+def test_native_empty_and_large_records(native, tmp_path):
+    path = str(tmp_path / "mixed.tfrecord")
+    payloads = [b"", b"a", np.random.RandomState(1).bytes(5_000_000)]
+    write_records(path, payloads)
+    assert list(native.read_records_native(path)) == payloads
