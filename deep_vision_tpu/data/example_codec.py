@@ -22,6 +22,7 @@ bytes values -> BytesList, floats -> FloatList, ints -> Int64List.
 """
 from __future__ import annotations
 
+import numbers
 import struct
 from typing import Dict, List, Sequence, Union
 
@@ -84,22 +85,25 @@ def _encode_feature(values: FeatureValue) -> bytes:
             _write_varint(inner, len(v))
             inner += v
         _write_varint(buf, _tag(1, _WIRE_LEN))
-    elif isinstance(v0, float):
-        inner = bytearray()
-        packed = struct.pack(f"<{len(values)}f", *values)
-        _write_varint(inner, _tag(1, _WIRE_LEN))
-        _write_varint(inner, len(packed))
-        inner += packed
-        _write_varint(buf, _tag(2, _WIRE_LEN))
-    elif isinstance(v0, int):
+    elif all(isinstance(v, numbers.Integral) for v in values):
+        # every value must be integral (not just values[0]): a mixed list
+        # like [0, 0.5] belongs in FloatList. numbers ABCs (not bare
+        # int/float isinstance) so numpy scalars encode consistently.
         inner = bytearray()
         packed = bytearray()
         for v in values:
-            _write_varint(packed, v & 0xFFFFFFFFFFFFFFFF)  # two's complement
+            _write_varint(packed, int(v) & 0xFFFFFFFFFFFFFFFF)  # two's complement
         _write_varint(inner, _tag(1, _WIRE_LEN))
         _write_varint(inner, len(packed))
         inner += packed
         _write_varint(buf, _tag(3, _WIRE_LEN))
+    elif isinstance(v0, numbers.Real):
+        inner = bytearray()
+        packed = struct.pack(f"<{len(values)}f", *(float(v) for v in values))
+        _write_varint(inner, _tag(1, _WIRE_LEN))
+        _write_varint(inner, len(packed))
+        inner += packed
+        _write_varint(buf, _tag(2, _WIRE_LEN))
     else:
         raise TypeError(f"unsupported feature value type {type(v0)}")
     _write_varint(buf, len(inner))

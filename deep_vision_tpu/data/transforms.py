@@ -124,9 +124,10 @@ class RandomHorizontalFlip:
         sample["image"] = sample["image"][:, ::-1]
         if "boxes" in sample and len(sample["boxes"]):
             b = np.array(sample["boxes"], np.float32)
+            valid = b.any(axis=-1)  # all-zero rows are padding; leave them
             x1 = 1.0 - b[:, 2]
             x2 = 1.0 - b[:, 0]
-            b[:, 0], b[:, 2] = x1, x2
+            b[valid, 0], b[valid, 2] = x1[valid], x2[valid]
             sample["boxes"] = b
         if "keypoints" in sample and len(sample["keypoints"]):
             k = np.array(sample["keypoints"], np.float32)

@@ -34,6 +34,10 @@ def _block_attend(q, k, v, scale, mask):
     true max (not a 0-clamped one) keeps the online-softmax merge exact even
     when every real score is far below zero.
     """
+    # upcast K/V here (not in the ring carry: ppermute should move the
+    # narrow input dtype, half the ICI bytes per hop for bf16)
+    k = k.astype(q.dtype)
+    v = v.astype(q.dtype)
     s = jnp.einsum("bthd,bshd->bhts", q, k) * scale  # (B,H,Tq,Ts)
     s = jnp.where(mask, s, -jnp.inf)
     m = jnp.max(s, axis=-1)  # (B,H,Tq); -inf when fully masked
@@ -47,6 +51,13 @@ def _block_attend(q, k, v, scale, mask):
 def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool,
                           scale: Optional[float]):
     """Per-shard body (runs under shard_map). q/k/v: (B, T_loc, H, D)."""
+    # accumulate in f32: the online-softmax state (m, l, o) sums exp() terms
+    # over the whole ring, and bf16 accumulation loses real precision there
+    # (the flash kernel upcasts to f32 VMEM scratch for the same reason).
+    # K/V stay in the input dtype — they ride the ring and _block_attend
+    # upcasts per block, so ppermute moves the narrow dtype.
+    out_dtype = q.dtype
+    q = q.astype(jnp.float32)
     n = jax.lax.psum(1, axis_name)
     my = jax.lax.axis_index(axis_name)
     t_loc = q.shape[1]
@@ -87,7 +98,7 @@ def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool,
     l0 = jax.lax.pvary(l0, (axis_name,))
     o, m, l, _, _ = jax.lax.fori_loop(0, n, step, (o0, m0, l0, k, v))
     denom = jnp.maximum(l, 1e-20).transpose(0, 2, 1)[..., None]
-    return o / denom
+    return (o / denom).astype(out_dtype)
 
 
 def ring_attention(

@@ -372,6 +372,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     if cfg.task in ("dcgan", "cyclegan"):
         trainer = build_gan_trainer(cfg)
         for epoch in range(cfg.epochs):
+            # keep per-step metrics as device arrays; float() only at epoch
+            # end so the host never blocks async dispatch mid-epoch
+            collected: list = []
             for batch in train_fn():
                 if cfg.task == "dcgan":
                     metrics = trainer.train_step(batch["image"])
@@ -380,9 +383,15 @@ def main(argv: Optional[List[str]] = None) -> int:
                     metrics = trainer.train_step(
                         batch["image"][:half], batch["image"][half:half * 2]
                     )
-            print(f"epoch {epoch}: " + " ".join(
-                f"{k}={float(v):.4f}" for k, v in sorted(metrics.items())
-            ))
+                collected.append(metrics)
+            if collected:
+                keys = sorted(collected[0])
+                print(f"epoch {epoch}: " + " ".join(
+                    "{}={:.4f}".format(
+                        k, sum(float(m[k]) for m in collected) / len(collected)
+                    )
+                    for k in keys
+                ))
         return 0
 
     ckpt_dir = args.ckpt_dir or os.path.join("checkpoints", cfg.name)

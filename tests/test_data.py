@@ -52,6 +52,22 @@ def test_example_codec_negative_int_and_empty():
     assert out["b"] == []
 
 
+def test_example_codec_numpy_scalars():
+    # values sourced from numpy arrays must encode like their Python twins
+    out = decode_example(encode_example({
+        "f32": list(np.array([0.25, 0.5], np.float32)),
+        "f64": list(np.array([1.5], np.float64)),
+        "i64": list(np.array([-5, 3], np.int64)),
+        "i32": list(np.array([7], np.int32)),
+        "u8": list(np.array([255], np.uint8)),
+    }))
+    np.testing.assert_allclose(out["f32"], [0.25, 0.5], rtol=1e-6)
+    np.testing.assert_allclose(out["f64"], [1.5], rtol=1e-6)
+    assert out["i64"] == [-5, 3]
+    assert out["i32"] == [7]
+    assert out["u8"] == [255]
+
+
 @pytest.mark.skipif(not HAS_TF, reason="tensorflow unavailable")
 def test_example_codec_tf_cross_parity():
     # our encoder -> TF parser
@@ -188,6 +204,13 @@ def test_crops_and_flip_boxes():
     )
     np.testing.assert_allclose(out["boxes"], [[0.6, 0.2, 0.9, 0.6]], atol=1e-6)
     np.testing.assert_array_equal(out["image"], img[:, ::-1])
+
+    # all-zero padding rows must stay [0,0,0,0] (not become [1,0,1,0])
+    padded = np.array([[0.1, 0.2, 0.4, 0.6], [0, 0, 0, 0]], np.float32)
+    out = T.RandomHorizontalFlip(p=1.0)({"image": img, "boxes": padded}, rng)
+    np.testing.assert_allclose(
+        out["boxes"], [[0.6, 0.2, 0.9, 0.6], [0, 0, 0, 0]], atol=1e-6
+    )
 
 
 def test_random_crop_with_boxes_preserves_all_boxes():
