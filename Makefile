@@ -1,0 +1,52 @@
+# Launch conventions: the analog of the reference's per-model Makefiles
+# (ResNet/pytorch/Makefile train_*/resume_* nohup targets,
+# CycleGAN/tensorflow/Makefile tb/ps monitor targets), over the single
+# config-registry CLI instead of 12 per-model scripts.
+#
+#   make train MODEL=resnet50            # background train, log to file
+#   make resume MODEL=resnet50           # resume from latest checkpoint
+#   make train-fg MODEL=lenet5 ARGS=--fake-data
+#   make tb                              # tensorboard on ./runs
+#   make test / make bench / make dryrun
+
+TIME := $(shell date "+%Y-%m-%dT%H-%M-%S")
+MODEL ?= resnet50
+DATA ?= ./dataset
+ARGS ?=
+
+train:
+	mkdir -p checkpoints logs
+	nohup python -u train.py -m $(MODEL) --data-dir $(DATA) \
+	  --tensorboard-dir runs/$(MODEL)-$(TIME) $(ARGS) \
+	  > logs/$(MODEL)-$(TIME).log 2>&1 &
+	@echo "started; tail -f logs/$(MODEL)-$(TIME).log"
+
+resume:
+	mkdir -p checkpoints logs
+	nohup python -u train.py -m $(MODEL) --data-dir $(DATA) -c auto \
+	  --tensorboard-dir runs/$(MODEL)-$(TIME) $(ARGS) \
+	  > logs/$(MODEL)-$(TIME).log 2>&1 &
+	@echo "resumed; tail -f logs/$(MODEL)-$(TIME).log"
+
+train-fg:
+	python -u train.py -m $(MODEL) --data-dir $(DATA) $(ARGS)
+
+test:
+	python -m pytest tests/ -x -q
+
+bench:
+	python bench.py
+
+dryrun:
+	python __graft_entry__.py 8
+
+tb:
+	tensorboard --logdir=./runs
+
+ps:
+	ps -ef | grep python
+
+native:
+	$(MAKE) -C native
+
+.PHONY: train resume train-fg test bench dryrun tb ps native

@@ -1,3 +1,4 @@
 from deep_vision_tpu.core.train_state import TrainState, create_train_state
 from deep_vision_tpu.core.checkpoint import CheckpointManager
 from deep_vision_tpu.core.metrics import MetricLogger, topk_accuracy
+from deep_vision_tpu.core.summary import count_params, model_summary

@@ -1,0 +1,91 @@
+"""Model summary: parameter table + totals for any registered model.
+
+The analog of `torchsummary.summary(net, (3,224,224))` at
+ResNet/pytorch/train.py:350 and `model.summary()` at
+YOLO/tensorflow/train.py:297, written against flax variables directly so it
+needs no extra dependency and works for every module in the zoo (including
+multi-output models whose apply signature torchsummary could not handle).
+"""
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+
+def count_params(tree: Any) -> int:
+    """Total element count over a params (or any array) pytree."""
+    return sum(int(np.prod(x.shape)) for x in jax.tree_util.tree_leaves(tree))
+
+
+def param_bytes(tree: Any) -> int:
+    return sum(
+        int(np.prod(x.shape)) * x.dtype.itemsize
+        for x in jax.tree_util.tree_leaves(tree)
+    )
+
+
+def _rows(tree: Any, prefix: Tuple[str, ...] = ()) -> Sequence[tuple]:
+    """Flatten a nested variables dict to (path, shape, count) rows."""
+    rows = []
+    if isinstance(tree, dict):
+        for key in tree:
+            rows.extend(_rows(tree[key], prefix + (str(key),)))
+    else:
+        rows.append(("/".join(prefix), tuple(tree.shape), int(np.prod(tree.shape))))
+    return rows
+
+
+def model_summary(
+    model,
+    sample_input,
+    train: bool = False,
+    rng: Optional[jax.Array] = None,
+    init_kwargs: Optional[dict] = None,
+    max_rows: Optional[int] = None,
+) -> str:
+    """Build the summary table string (init runs abstractly: no FLOPs, no
+    device memory — usable for ResNet-152-sized models on any host)."""
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    args = sample_input if isinstance(sample_input, tuple) else (sample_input,)
+    kwargs = dict(init_kwargs or {})
+    kwargs.setdefault("train", train)
+
+    def init():
+        try:
+            return model.init({"params": rng, "dropout": rng}, *args, **kwargs)
+        except TypeError as e:
+            # retry ONLY for modules without a `train` kwarg (e.g. GAN
+            # generators); any other TypeError is a real caller error
+            if "train" not in str(e) or "train" not in kwargs:
+                raise
+            kwargs.pop("train", None)
+            return model.init({"params": rng, "dropout": rng}, *args, **kwargs)
+
+    variables = jax.eval_shape(init)
+    params = variables.get("params", {})
+    batch_stats = variables.get("batch_stats", {})
+
+    rows = _rows(params)
+    name_w = max([len(r[0]) for r in rows] + [len("parameter")])
+    shape_w = max([len(str(r[1])) for r in rows] + [len("shape")])
+    lines = [
+        f"{'parameter':<{name_w}}  {'shape':<{shape_w}}  count",
+        "-" * (name_w + shape_w + 12),
+    ]
+    shown = rows if max_rows is None else rows[:max_rows]
+    for path, shape, count in shown:
+        lines.append(f"{path:<{name_w}}  {str(shape):<{shape_w}}  {count:,}")
+    if max_rows is not None and len(rows) > max_rows:
+        lines.append(f"... {len(rows) - max_rows} more")
+    n_params = count_params(params)
+    n_stats = count_params(batch_stats)
+    lines += [
+        "-" * (name_w + shape_w + 12),
+        f"trainable params: {n_params:,} "
+        f"({param_bytes(params) / 1e6:.1f} MB)",
+        f"batch-norm stats: {n_stats:,}",
+        f"total: {n_params + n_stats:,}",
+    ]
+    return "\n".join(lines)

@@ -32,6 +32,8 @@ except Exception:  # pragma: no cover
 # torchvision's 0-1 stats; the TF path uses 0-255 means data_load.py:35-38)
 IMAGENET_MEAN = np.array([0.485, 0.456, 0.406], np.float32)
 IMAGENET_STD = np.array([0.229, 0.224, 0.225], np.float32)
+# TF "ResNet preprocessing" 0-255 RGB means (ResNet/tensorflow/data_load.py:35-38)
+TF_IMAGENET_MEAN = np.array([123.68, 116.78, 103.94], np.float32)
 
 
 def _resize(image: np.ndarray, h: int, w: int) -> np.ndarray:
@@ -230,14 +232,17 @@ class ColorJitter:
 class ToFloat:
     """uint8 [0,255] -> float32 [0,1]; grayscale stays single-channel
     unless `expand_gray_to_rgb` (ToTensor's 3-channel expand,
-    ResNet/pytorch/data_load.py:176-194 — layout conversion dropped: NHWC)."""
+    ResNet/pytorch/data_load.py:176-194 — layout conversion dropped: NHWC).
+    `scale=False` keeps the 0-255 range (the TF mean-subtraction chain
+    normalizes on that scale, ResNet/tensorflow/data_load.py:158-193)."""
 
-    def __init__(self, expand_gray_to_rgb: bool = False):
+    def __init__(self, expand_gray_to_rgb: bool = False, scale: bool = True):
         self.expand = expand_gray_to_rgb
+        self.scale = scale
 
     def __call__(self, sample: dict, rng) -> dict:
         img = sample["image"]
-        if img.dtype == np.uint8:
+        if img.dtype == np.uint8 and self.scale:
             img = img.astype(np.float32) / 255.0
         else:
             img = img.astype(np.float32)
@@ -258,6 +263,31 @@ class Normalize:
 
     def __call__(self, sample: dict, rng) -> dict:
         sample["image"] = (sample["image"] - self.mean) / self.std
+        return sample
+
+
+class MeanSubtract:
+    """The TF "ResNet preprocessing" normalization variant: subtract per-
+    channel means from a 0-255 image, no scaling (_mean_image_subtraction at
+    ResNet/tensorflow/data_load.py:66-92; channel means 123.68/116.78/103.94
+    at :35-38). Use instead of ToFloat+Normalize to reproduce the reference's
+    TF training chain exactly."""
+
+    def __init__(self, mean=None):
+        self.mean = np.asarray(
+            TF_IMAGENET_MEAN if mean is None else mean, np.float32
+        )
+
+    def __call__(self, sample: dict, rng) -> dict:
+        img = sample["image"].astype(np.float32)
+        if img.ndim == 2:
+            img = img[:, :, None]
+        if img.shape[-1] != self.mean.shape[0]:
+            raise ValueError(
+                f"image has {img.shape[-1]} channels, "
+                f"mean has {self.mean.shape[0]}"
+            )
+        sample["image"] = img - self.mean
         return sample
 
 

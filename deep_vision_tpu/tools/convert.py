@@ -48,11 +48,21 @@ def main(argv=None) -> int:
     cyc.add_argument("--out-dir", required=True)
     cyc.add_argument("--prefix", default="trainA")
 
+    celeba = sub.add_parser(
+        "celeba", help="CelebA attribute -> trainA/trainB domain split"
+    )
+    celeba.add_argument("--attr-file", required=True,
+                        help="path to list_attr_celeba.txt")
+    celeba.add_argument("--images-dir", required=True)
+    celeba.add_argument("--out-dir", required=True)
+    celeba.add_argument("--attribute", default="Male",
+                        help="any of the 40 CelebA attribute names")
+
     common = dict(num_workers=None)
     for sp in (voc, coco, mpii, imagenet, cyc):
         sp.add_argument("--workers", type=int, default=None)
     args = p.parse_args(argv)
-    common["num_workers"] = args.workers
+    common["num_workers"] = getattr(args, "workers", None)
 
     if args.dataset == "voc":
         annos = C.voc_annotations(args.voc_root, args.split)
@@ -74,6 +84,11 @@ def main(argv=None) -> int:
         annos = C.cyclegan_examples(args.images_dir)
         C.build_shards(annos, C.image_only_example, args.out_dir, args.prefix,
                        num_shards=1, **common)
+    elif args.dataset == "celeba":
+        n_a, n_b = C.celeba_split(
+            args.attr_file, args.images_dir, args.out_dir, args.attribute
+        )
+        print(f"celeba: {n_a} -> trainA, {n_b} -> trainB")
     return 0
 
 

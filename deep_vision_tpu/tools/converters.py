@@ -20,9 +20,10 @@ from __future__ import annotations
 
 import json
 import os
+import shutil
 import xml.etree.ElementTree as ET
 from multiprocessing import Pool
-from typing import Callable, Dict, Iterable, List, Optional, Sequence
+from typing import Callable, Dict, Iterable, List, Optional, Sequence, Tuple
 
 from deep_vision_tpu.data.example_codec import encode_example
 from deep_vision_tpu.data.records import RecordWriter
@@ -317,3 +318,56 @@ def image_only_example(anno: dict) -> Optional[dict]:
         "image/encoded": [content],
         "image/filename": [anno["filename"].encode()],
     }
+
+
+def celeba_split(
+    attr_file: str,
+    images_dir: str,
+    out_dir: str,
+    attribute: str = "Male",
+    copy: bool = True,
+) -> Tuple[int, int]:
+    """Split CelebA into trainA/trainB domain folders by a binary attribute.
+
+    The CycleGAN data story's first step (CycleGAN/tensorflow/celeba.py:1-24,
+    which hardcodes byte offsets into list_attr_celeba.txt for the gender
+    column); here the attribute is looked up by name from the header so any
+    of the 40 CelebA attributes works. +1 -> trainA, -1 -> trainB.
+
+    Returns (n_trainA, n_trainB). Missing image files are skipped.
+    """
+    with open(attr_file) as fp:
+        fp.readline()  # line 1: image count
+        names = fp.readline().split()  # line 2: attribute names
+        if attribute not in names:
+            raise ValueError(f"attribute {attribute!r} not in {names}")
+        col = names.index(attribute)
+        rows = [line.split() for line in fp if line.strip()]
+
+    dir_a = os.path.join(out_dir, "trainA")
+    dir_b = os.path.join(out_dir, "trainB")
+    os.makedirs(dir_a, exist_ok=True)
+    os.makedirs(dir_b, exist_ok=True)
+    counts = [0, 0]
+    n_skipped = 0
+    for row in rows:
+        filename, flags = row[0], row[1:]
+        value = int(flags[col])
+        if value not in (-1, 1):
+            raise ValueError(f"bad attribute value {value} for {filename}")
+        src = os.path.join(images_dir, filename)
+        if not os.path.exists(src):
+            n_skipped += 1
+            continue
+        dst_dir = dir_a if value == 1 else dir_b
+        if copy:
+            shutil.copyfile(src, os.path.join(dst_dir, filename))
+        counts[0 if value == 1 else 1] += 1
+    if rows and not (counts[0] or counts[1]):
+        raise FileNotFoundError(
+            f"none of the {len(rows)} listed images exist under {images_dir!r}"
+            " — wrong --images-dir?"
+        )
+    if n_skipped:
+        print(f"celeba_split: skipped {n_skipped} rows with missing images")
+    return counts[0], counts[1]

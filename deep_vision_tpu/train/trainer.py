@@ -70,6 +70,7 @@ class Trainer:
         profile_steps: tuple = (10, 20),
     ):
         self.mesh = mesh if mesh is not None else create_mesh()
+        self.model = model  # single source of truth for summaries/export
         self.loss_fn = loss_fn
         self.eval_loss_fn = eval_loss_fn or loss_fn
         self.input_key = input_key

@@ -110,8 +110,15 @@ def _fake_centernet(cfg: ExperimentConfig, n_batches: int):
 # -- real datasets -----------------------------------------------------------
 
 def build_dataloaders(cfg: ExperimentConfig, data_dir: str, fake: bool,
-                      fake_batches: int, num_workers: int):
-    """Returns (train_fn, eval_fn) thunks yielding batch dicts per epoch."""
+                      fake_batches: int, num_workers: int,
+                      preprocessing: str = "torch"):
+    """Returns (train_fn, eval_fn) thunks yielding batch dicts per epoch.
+
+    `preprocessing` selects the ImageNet normalization chain: "torch" is the
+    torchvision-stats chain (ResNet/pytorch/train.py:315-331); "tf" is the
+    TF "ResNet preprocessing" 0-255 mean-subtraction variant
+    (ResNet/tensorflow/data_load.py:158-193).
+    """
     if fake or cfg.dataset.get("kind") == "fake":
         maker = {
             "classification": _fake_classification,
@@ -150,16 +157,31 @@ def build_dataloaders(cfg: ExperimentConfig, data_dir: str, fake: bool,
         rec_glob = os.path.join(data_dir, "tfrecord_train", "*")
         import glob as _g
 
-        train_tf = Compose([
-            T.Rescale(cfg.train_resize), T.RandomHorizontalFlip(),
-            T.RandomCrop(cfg.eval_crop),
-            T.ColorJitter(0.4, 0.4, 0.4),
-            T.ToFloat(expand_gray_to_rgb=True), T.Normalize(),
-        ])  # transforms.Compose at ResNet/pytorch/train.py:315-331
-        eval_tf = Compose([
-            T.Rescale(cfg.train_resize), T.CenterCrop(cfg.eval_crop),
-            T.ToFloat(expand_gray_to_rgb=True), T.Normalize(),
-        ])
+        if preprocessing == "tf":
+            # TF chain: aspect resize -> crop -> flip -> 0-255 mean-sub, no
+            # rescaling (preprocess_image, ResNet/tensorflow/data_load.py:158-193)
+            train_tf = Compose([
+                T.Rescale(cfg.train_resize), T.RandomHorizontalFlip(),
+                T.RandomCrop(cfg.eval_crop),
+                T.ToFloat(expand_gray_to_rgb=True, scale=False),
+                T.MeanSubtract(),
+            ])
+            eval_tf = Compose([
+                T.Rescale(cfg.train_resize), T.CenterCrop(cfg.eval_crop),
+                T.ToFloat(expand_gray_to_rgb=True, scale=False),
+                T.MeanSubtract(),
+            ])
+        else:
+            train_tf = Compose([
+                T.Rescale(cfg.train_resize), T.RandomHorizontalFlip(),
+                T.RandomCrop(cfg.eval_crop),
+                T.ColorJitter(0.4, 0.4, 0.4),
+                T.ToFloat(expand_gray_to_rgb=True), T.Normalize(),
+            ])  # transforms.Compose at ResNet/pytorch/train.py:315-331
+            eval_tf = Compose([
+                T.Rescale(cfg.train_resize), T.CenterCrop(cfg.eval_crop),
+                T.ToFloat(expand_gray_to_rgb=True), T.Normalize(),
+            ])
         if _g.glob(rec_glob):
             train_ds = RecordDataset(rec_glob, "imagenet", shuffle_shards=True)
             eval_ds = RecordDataset(
@@ -357,6 +379,13 @@ def main(argv: Optional[List[str]] = None) -> int:
                         help="capture a jax.profiler trace of steps 10-20")
     parser.add_argument("--eval-first", action="store_true",
                         help="epoch-0 sanity validate (ResNet/pytorch/train.py:390)")
+    parser.add_argument("--preprocessing", default="torch",
+                        choices=["torch", "tf"],
+                        help="ImageNet chain: torchvision stats or the TF "
+                             "0-255 mean-subtraction variant")
+    parser.add_argument("--summary", action="store_true",
+                        help="print the per-parameter model summary table "
+                             "(torchsummary analog) before training")
     args = parser.parse_args(argv)
 
     cfg = get_config(args.model)
@@ -366,11 +395,25 @@ def main(argv: Optional[List[str]] = None) -> int:
         cfg.batch_size = args.batch_size
 
     train_fn, eval_fn = build_dataloaders(
-        cfg, args.data_dir, args.fake_data, args.fake_batches, args.num_workers
+        cfg, args.data_dir, args.fake_data, args.fake_batches, args.num_workers,
+        preprocessing=args.preprocessing,
     )
 
     if cfg.task in ("dcgan", "cyclegan"):
+        import jax as _jax
+
+        from deep_vision_tpu.core.summary import count_params
+
         trainer = build_gan_trainer(cfg)
+        states = (
+            {"G": trainer.g_state, "D": trainer.d_state}
+            if cfg.task == "dcgan"
+            else {"G_ab": trainer.gab, "G_ba": trainer.gba,
+                  "D_a": trainer.da, "D_b": trainer.db}
+        )
+        print("model " + cfg.model + ": " + " ".join(
+            f"{k}={count_params(s.params):,}" for k, s in states.items()
+        ) + " trainable params")
         for epoch in range(cfg.epochs):
             # keep per-step metrics as device arrays; float() only at epoch
             # end so the host never blocks async dispatch mid-epoch
@@ -385,6 +428,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                     )
                 collected.append(metrics)
             if collected:
+                collected = _jax.device_get(collected)  # one host round-trip
                 keys = sorted(collected[0])
                 print(f"epoch {epoch}: " + " ".join(
                     "{}={:.4f}".format(
@@ -398,6 +442,19 @@ def main(argv: Optional[List[str]] = None) -> int:
     trainer = build_trainer(cfg, train_fn, ckpt_dir,
                             tb_dir=args.tensorboard_dir,
                             profile_dir=args.profile_dir)
+    # param accounting before training, like summary(net, (3,224,224)) at
+    # ResNet/pytorch/train.py:350 / model.summary() at YOLO/tensorflow/train.py:297
+    from deep_vision_tpu.core.summary import count_params
+
+    if args.summary:
+        from deep_vision_tpu.core.summary import model_summary
+        import jax.numpy as _jnp
+
+        # summarize the exact module build_trainer constructed, not a rebuild
+        print(model_summary(
+            trainer.model, _jnp.ones((2, *cfg.input_shape), _jnp.float32)
+        ))
+    print(f"model {cfg.model}: {count_params(trainer.state.params):,} trainable params")
     start_epoch = 0
     if args.checkpoint:
         if args.checkpoint != "auto":

@@ -135,3 +135,34 @@ def test_chunkify():
     assert C.chunkify(list(range(10)), 3) == [[0, 1, 2, 3], [4, 5, 6, 7], [8, 9]]
     assert C.chunkify([], 4) == []
     assert C.chunkify([1], 5) == [[1]]
+
+
+def test_celeba_split(tmp_path):
+    # synthetic list_attr_celeba.txt: 2 header lines, then filename + flags
+    img_dir = tmp_path / "img_align_celeba"
+    img_dir.mkdir()
+    names = ["000001.jpg", "000002.jpg", "000003.jpg", "000004.jpg"]
+    for n in names[:3]:  # 000004 intentionally missing on disk
+        (img_dir / n).write_bytes(b"jpegdata-" + n.encode())
+    attr = tmp_path / "list_attr_celeba.txt"
+    attr.write_text(
+        "4\n"
+        "Attractive Male Young\n"
+        "000001.jpg  1  1 -1\n"
+        "000002.jpg -1 -1  1\n"
+        "000003.jpg  1  1  1\n"
+        "000004.jpg -1  1 -1\n"
+    )
+    out = tmp_path / "celeba"
+    n_a, n_b = C.celeba_split(str(attr), str(img_dir), str(out), "Male")
+    assert (n_a, n_b) == (2, 1)
+    assert sorted(os.listdir(out / "trainA")) == ["000001.jpg", "000003.jpg"]
+    assert sorted(os.listdir(out / "trainB")) == ["000002.jpg"]
+    assert (out / "trainA" / "000001.jpg").read_bytes().endswith(b"000001.jpg")
+
+    # split by a different attribute column
+    out2 = tmp_path / "celeba2"
+    n_a, n_b = C.celeba_split(str(attr), str(img_dir), str(out2), "Young")
+    assert (n_a, n_b) == (2, 1)
+    with pytest.raises(ValueError):
+        C.celeba_split(str(attr), str(img_dir), str(out2), "NoSuchAttr")

@@ -318,3 +318,24 @@ def test_dataloader_error_propagates():
     dl = DataLoader(_SquaresDataset(), batch_size=4, transform=boom)
     with pytest.raises(RuntimeError, match="decode failed"):
         list(dl)
+
+
+def test_mean_subtract_tf_variant():
+    rng = np.random.default_rng(0)
+    img = (np.ones((4, 4, 3)) * [130, 120, 110]).astype(np.uint8)
+    # ToFloat(scale=False) keeps 0-255; MeanSubtract removes TF channel means
+    out = T.ToFloat(expand_gray_to_rgb=True, scale=False)({"image": img}, rng)
+    out = T.MeanSubtract()(out, rng)
+    np.testing.assert_allclose(
+        out["image"][0, 0], [130 - 123.68, 120 - 116.78, 110 - 103.94],
+        atol=1e-4,
+    )
+    # grayscale input: expand first, then subtract 3-channel means
+    gray = np.full((4, 4), 100, np.uint8)
+    out = T.ToFloat(expand_gray_to_rgb=True, scale=False)({"image": gray}, rng)
+    out = T.MeanSubtract()(out, rng)
+    assert out["image"].shape == (4, 4, 3)
+    # channel mismatch is an error, not silent broadcast
+    import pytest as _pytest
+    with _pytest.raises(ValueError):
+        T.MeanSubtract()({"image": np.zeros((4, 4, 1), np.uint8)}, rng)

@@ -89,3 +89,37 @@ def test_trainer_profiler_hook(tmp_path, mesh8):
     for root, _, files in os.walk(tmp_path / "trace"):
         found += files
     assert found, "profiler produced no trace files"
+
+
+def test_model_summary_counts():
+    import jax
+    import jax.numpy as jnp
+
+    from deep_vision_tpu.core.summary import count_params, model_summary
+    from deep_vision_tpu.models import get_model
+
+    model = get_model("lenet5", num_classes=10)
+    text = model_summary(model, jnp.ones((1, 32, 32, 1)))
+    variables = model.init(
+        {"params": jax.random.PRNGKey(0), "dropout": jax.random.PRNGKey(0)},
+        jnp.ones((1, 32, 32, 1)), train=False,
+    )
+    n = count_params(variables["params"])
+    assert f"trainable params: {n:,}" in text
+    # table lists every kernel with its shape
+    assert "(5, 5, 1, 6)" in text  # LeNet-5 C1 conv kernel
+
+
+def test_model_summary_resnet_is_abstract_and_fast():
+    import jax.numpy as jnp
+
+    from deep_vision_tpu.core.summary import model_summary
+    from deep_vision_tpu.models import get_model
+
+    # eval_shape: no real compute, so a 224x224 ResNet-50 summary is instant
+    text = model_summary(
+        get_model("resnet50", num_classes=1000), jnp.ones((2, 224, 224, 3)),
+        max_rows=5,
+    )
+    assert "trainable params: 25,5" in text  # ~25.5M
+    assert "... " in text  # truncation marker
