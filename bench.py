@@ -29,7 +29,9 @@ TARGET_PER_CHIP = 0.9 * A100_IMG_PER_SEC
 BATCH_PER_CHIP = 256
 IMAGE_SIZE = 224
 WARMUP_STEPS = 5
-TIMED_STEPS = 30
+TIMED_STEPS = 20
+WINDOWS = 3  # report the MEDIAN window: robust to the tunnel's +-4% jitter
+             # without inflating the metric the way a best-of-N min would
 
 
 def main() -> None:
@@ -49,15 +51,22 @@ def main() -> None:
         file=sys.stderr,
     )
 
-    model = get_model("resnet50", num_classes=1000, dtype=jnp.bfloat16)
+    # space-to-depth stem (models/resnet.py SpaceToDepthStem): the host
+    # pipeline ships (H/2, W/2, 12) images; the stem conv is math-identical
+    # to 7x7/s2 but MXU-efficient. Input staged in bf16, as the real
+    # pipeline does (uint8 decode -> normalize -> bf16 cast on host).
+    model = get_model("resnet50", num_classes=1000, dtype=jnp.bfloat16,
+                      stem="s2d")
     tx = build_optimizer("sgd", learning_rate=0.1, momentum=0.9, weight_decay=1e-4)
-    sample = jnp.ones((8, IMAGE_SIZE, IMAGE_SIZE, 3), jnp.float32)
+    sample = jnp.ones((8, IMAGE_SIZE // 2, IMAGE_SIZE // 2, 12), jnp.float32)
     state = create_train_state(model, tx, sample)
     state = jax.device_put(state, replicated(mesh))
 
     rng = np.random.RandomState(0)
     batch = {
-        "image": rng.rand(batch_size, IMAGE_SIZE, IMAGE_SIZE, 3).astype(np.float32),
+        "image": rng.rand(
+            batch_size, IMAGE_SIZE // 2, IMAGE_SIZE // 2, 12
+        ).astype(np.float32).astype(jnp.bfloat16),
         "label": rng.randint(0, 1000, size=(batch_size,)).astype(np.int32),
     }
     batch = {
@@ -96,13 +105,20 @@ def main() -> None:
     float(loss)
     print(f"bench: warmup {time.perf_counter() - t0:.1f}s", file=sys.stderr)
 
-    t0 = time.perf_counter()
-    for _ in range(TIMED_STEPS):
-        state, loss = step(state, batch)
-    float(loss)
-    dt = time.perf_counter() - t0
+    window_dts = []
+    for w in range(WINDOWS):
+        t0 = time.perf_counter()
+        for _ in range(TIMED_STEPS):
+            state, loss = step(state, batch)
+        float(loss)
+        dt = time.perf_counter() - t0
+        print(
+            f"bench: window {w}: {dt / TIMED_STEPS * 1e3:.1f} ms/step",
+            file=sys.stderr,
+        )
+        window_dts.append(dt)
 
-    img_per_sec = TIMED_STEPS * batch_size / dt
+    img_per_sec = TIMED_STEPS * batch_size / float(np.median(window_dts))
     per_chip = img_per_sec / n_chips
     print(
         json.dumps(

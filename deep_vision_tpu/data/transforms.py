@@ -310,3 +310,28 @@ class PadBoxes:
         sample["boxes"] = out_b
         sample["classes"] = out_c
         return sample
+
+
+def space_to_depth(image: np.ndarray, block: int = 2) -> np.ndarray:
+    """(H, W, C) -> (H/b, W/b, b*b*C), channel order (dy, dx, c).
+
+    The host half of the MLPerf-ResNet stem trick (models/resnet.py
+    SpaceToDepthStem): laying the image out this way on the host turns the
+    MXU-hostile 7x7/s2 3-channel stem conv into an efficient 4x4 conv.
+    """
+    h, w, c = image.shape
+    assert h % block == 0 and w % block == 0, (h, w, block)
+    out = image.reshape(h // block, block, w // block, block, c)
+    return out.transpose(0, 2, 1, 3, 4).reshape(h // block, w // block,
+                                                block * block * c)
+
+
+class SpaceToDepth:
+    """Pipeline transform: rewrite sample['image'] with `space_to_depth`."""
+
+    def __init__(self, block: int = 2):
+        self.block = block
+
+    def __call__(self, sample: dict, rng) -> dict:
+        sample["image"] = space_to_depth(np.asarray(sample["image"]), self.block)
+        return sample

@@ -1,0 +1,184 @@
+"""End-to-end prediction paths: model output -> user-facing detections/keypoints.
+
+The TPU-native analog of the reference's eval-mode wiring: the box-decode
+Lambda appendix (YOLO/tensorflow/yolov3.py:224-235) + Postprocessor
+(YOLO/tensorflow/postprocess.py:12-96) become one jitted function per task —
+decode and NMS run on device with static shapes, and only the final
+(max_detections,) padded results travel to the host.
+
+Predictors:
+  make_yolo_detector(model)        images -> boxes/scores/classes/valid
+  make_centernet_detector(model)   heatmap peaks -> boxes/scores/classes/valid
+  make_pose_estimator(model)       heatmaps -> (x, y, score) per joint
+"""
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from deep_vision_tpu.ops.anchors import YOLO_ANCHOR_MASKS, YOLO_ANCHORS
+from deep_vision_tpu.ops.boxes import decode_yolo_boxes
+from deep_vision_tpu.ops.nms import non_maximum_suppression
+
+
+def yolo_decode_outputs(outputs, anchors=YOLO_ANCHORS, anchor_masks=YOLO_ANCHOR_MASKS):
+    """Raw 3-scale head outputs -> flat (B, N, 4) xyxy boxes + (B, N, C) scores.
+
+    The Postprocessor concat at postprocess.py:12-36: per-scale decode, then
+    flatten grid x anchor dims. Scores are objectness * class probability
+    (multi-label, postprocess.py:58-63).
+    """
+    anchors = jnp.asarray(anchors)
+    all_boxes, all_scores = [], []
+    for pred, mask in zip(outputs, anchor_masks):
+        boxes, obj, cls = decode_yolo_boxes(pred, anchors[jnp.asarray(mask)])
+        b = boxes.shape[0]
+        all_boxes.append(boxes.reshape(b, -1, 4))
+        all_scores.append((obj * cls).reshape(b, -1, cls.shape[-1]))
+    return jnp.concatenate(all_boxes, 1), jnp.concatenate(all_scores, 1)
+
+
+def yolo_detect(
+    variables,
+    images,
+    *,
+    apply_fn: Callable,
+    anchors=YOLO_ANCHORS,
+    anchor_masks=YOLO_ANCHOR_MASKS,
+    max_detections: int = 100,
+    iou_threshold: float = 0.5,
+    score_threshold: float = 0.5,
+):
+    """images (B, H, W, 3) in [0,1] -> NMS'd detections (all fixed-shape).
+
+    Returns dict: boxes (B, D, 4) xyxy normalized, scores (B, D),
+    classes (B, D) int (-1 = padding), num (B,).
+    """
+    outputs = apply_fn(variables, images, train=False)
+    boxes, scores = yolo_decode_outputs(outputs, anchors, anchor_masks)
+    # best class per candidate box; NMS is class-aware via the offset trick
+    best_class = jnp.argmax(scores, axis=-1)
+    best_score = jnp.max(scores, axis=-1)
+    out_b, out_s, out_c, valid = non_maximum_suppression(
+        boxes,
+        best_score,
+        best_class,
+        max_detections=max_detections,
+        iou_threshold=iou_threshold,
+        score_threshold=score_threshold,
+    )
+    return {"boxes": out_b, "scores": out_s, "classes": out_c, "num": valid}
+
+
+def make_yolo_detector(
+    model,
+    *,
+    anchors=YOLO_ANCHORS,
+    anchor_masks=YOLO_ANCHOR_MASKS,
+    max_detections: int = 100,
+    iou_threshold: float = 0.5,
+    score_threshold: float = 0.5,
+):
+    """Returns a jitted (variables, images) -> detections dict."""
+    fn = functools.partial(
+        yolo_detect,
+        apply_fn=model.apply,
+        anchors=anchors,
+        anchor_masks=anchor_masks,
+        max_detections=max_detections,
+        iou_threshold=iou_threshold,
+        score_threshold=score_threshold,
+    )
+    return jax.jit(fn)
+
+
+def centernet_decode(
+    head: dict,
+    *,
+    max_detections: int = 100,
+    score_threshold: float = 0.1,
+):
+    """CenterNet head dict -> detections, the 'peaks are boxes' decode.
+
+    Peak extraction is the 3x3 max-pool trick from the Objects-as-Points
+    paper (the reference never finished its decode; cited intent is
+    ObjectsAsPoints/tensorflow/model.py:81-91 heads + train.py's stub):
+    a cell is a peak iff it equals its 3x3 neighborhood max. Top-K peaks
+    become boxes via the wh and offset branches.
+    """
+    heatmap = jax.nn.sigmoid(head["heatmap"])  # (B, h, w, C)
+    b, h, w, c = heatmap.shape
+    pooled = jax.lax.reduce_window(
+        heatmap, -jnp.inf, jax.lax.max, (1, 3, 3, 1), (1, 1, 1, 1), "SAME"
+    )
+    peaks = jnp.where(pooled == heatmap, heatmap, 0.0)
+    flat = peaks.reshape(b, -1)  # index = (y * w + x) * c + class
+    k = min(max_detections, flat.shape[-1])
+    scores, idx = jax.lax.top_k(flat, k)
+    if k < max_detections:  # keep the (B, max_detections) contract
+        pad = max_detections - k
+        scores = jnp.pad(scores, ((0, 0), (0, pad)))
+        idx = jnp.pad(idx, ((0, 0), (0, pad)))
+    cls = idx % c
+    spatial = idx // c
+    ys = (spatial // w).astype(jnp.float32)
+    xs = (spatial % w).astype(jnp.float32)
+
+    def gather_spatial(branch):  # (B, h, w, 2) -> (B, k, 2) at peak cells
+        flat_b = branch.reshape(b, -1, branch.shape[-1])
+        return jnp.take_along_axis(flat_b, spatial[..., None], axis=1)
+
+    off = gather_spatial(head["offset"])
+    wh = gather_spatial(head["wh"])
+    cx = (xs + off[..., 0]) / w
+    cy = (ys + off[..., 1]) / h
+    bw = wh[..., 0] / w
+    bh = wh[..., 1] / h
+    boxes = jnp.stack([cx - bw / 2, cy - bh / 2, cx + bw / 2, cy + bh / 2], -1)
+    keep = scores >= score_threshold
+    return {
+        "boxes": jnp.where(keep[..., None], boxes, 0.0),
+        "scores": jnp.where(keep, scores, 0.0),
+        "classes": jnp.where(keep, cls, -1),
+        "num": keep.sum(-1).astype(jnp.int32),
+    }
+
+
+def make_centernet_detector(model, *, max_detections: int = 100,
+                            score_threshold: float = 0.1):
+    def detect(variables, images):
+        outputs = model.apply(variables, images, train=False)
+        return centernet_decode(
+            outputs[-1],  # last stack's head
+            max_detections=max_detections,
+            score_threshold=score_threshold,
+        )
+
+    return jax.jit(detect)
+
+
+def heatmaps_to_keypoints(heatmaps):
+    """(B, h, w, J) heatmaps -> (B, J, 3) normalized (x, y, score).
+
+    The demo-notebook argmax decode (Hourglass demo_hourglass_pose.ipynb's
+    role), on-device and batched.
+    """
+    b, h, w, j = heatmaps.shape
+    flat = heatmaps.transpose(0, 3, 1, 2).reshape(b, j, -1)
+    idx = jnp.argmax(flat, axis=-1)
+    score = jnp.max(flat, axis=-1)
+    ys = (idx // w).astype(jnp.float32) / h
+    xs = (idx % w).astype(jnp.float32) / w
+    return jnp.stack([xs, ys, score], axis=-1)
+
+
+def make_pose_estimator(model):
+    def estimate(variables, images):
+        outputs = model.apply(variables, images, train=False)
+        heatmaps = outputs[-1] if isinstance(outputs, (list, tuple)) else outputs
+        return heatmaps_to_keypoints(heatmaps)
+
+    return jax.jit(estimate)

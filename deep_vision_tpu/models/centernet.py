@@ -21,6 +21,7 @@ import jax.numpy as jnp
 
 from deep_vision_tpu.models import register_model
 from deep_vision_tpu.models.hourglass import HgBottleneck
+from deep_vision_tpu.nn.layers import FusedBatchNorm
 
 # per-depth channel table, model.py:17-32 flavor
 _CURR_DIMS = (256, 256, 384, 384, 384, 512)
@@ -81,7 +82,7 @@ class ObjectsAsPoints(nn.Module):
     def __call__(self, x, train: bool = True):
         # stem: /4 resolution (model.py:130-140)
         x = nn.Conv(128, (7, 7), strides=(2, 2), use_bias=False)(x)
-        x = nn.relu(nn.BatchNorm(use_running_average=not train, momentum=0.9)(x))
+        x = nn.relu(FusedBatchNorm(use_running_average=not train, momentum=0.9)(x))
         x = HgBottleneck(self.features)(x, train)
         x = nn.max_pool(x, (2, 2), strides=(2, 2))
         x = HgBottleneck(self.features)(x, train)

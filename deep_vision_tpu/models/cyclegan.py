@@ -12,6 +12,7 @@ import flax.linen as nn
 import jax.numpy as jnp
 
 from deep_vision_tpu.models import register_model
+from deep_vision_tpu.nn.layers import FusedBatchNorm
 
 _INIT = nn.initializers.normal(0.02)
 
@@ -33,7 +34,7 @@ class _Norm(nn.Module):
             scale = self.param("scale", nn.initializers.ones, (x.shape[-1],))
             bias = self.param("bias", nn.initializers.zeros, (x.shape[-1],))
             return x * scale + bias
-        return nn.BatchNorm(use_running_average=not train, momentum=0.9)(x)
+        return FusedBatchNorm(use_running_average=not train, momentum=0.9)(x)
 
 
 class ResNetBlock(nn.Module):

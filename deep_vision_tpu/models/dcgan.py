@@ -10,6 +10,7 @@ import flax.linen as nn
 import jax.numpy as jnp
 
 from deep_vision_tpu.models import register_model
+from deep_vision_tpu.nn.layers import FusedBatchNorm
 
 _INIT = nn.initializers.normal(0.02)
 
@@ -20,16 +21,16 @@ class Generator(nn.Module):
     @nn.compact
     def __call__(self, z, train: bool = True):
         x = nn.Dense(7 * 7 * 256, use_bias=False, kernel_init=_INIT)(z)
-        x = nn.BatchNorm(use_running_average=not train, momentum=0.9)(x)
+        x = FusedBatchNorm(use_running_average=not train, momentum=0.9)(x)
         x = nn.leaky_relu(x, 0.2)
         x = x.reshape((-1, 7, 7, 256))
         x = nn.ConvTranspose(128, (5, 5), strides=(1, 1), padding="SAME",
                              use_bias=False, kernel_init=_INIT)(x)
-        x = nn.BatchNorm(use_running_average=not train, momentum=0.9)(x)
+        x = FusedBatchNorm(use_running_average=not train, momentum=0.9)(x)
         x = nn.leaky_relu(x, 0.2)
         x = nn.ConvTranspose(64, (5, 5), strides=(2, 2), padding="SAME",
                              use_bias=False, kernel_init=_INIT)(x)
-        x = nn.BatchNorm(use_running_average=not train, momentum=0.9)(x)
+        x = FusedBatchNorm(use_running_average=not train, momentum=0.9)(x)
         x = nn.leaky_relu(x, 0.2)
         x = nn.ConvTranspose(1, (5, 5), strides=(2, 2), padding="SAME",
                              use_bias=False, kernel_init=_INIT)(x)

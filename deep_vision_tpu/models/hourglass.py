@@ -14,6 +14,7 @@ import flax.linen as nn
 import jax.numpy as jnp
 
 from deep_vision_tpu.models import register_model
+from deep_vision_tpu.nn.layers import FusedBatchNorm
 
 
 class HgBottleneck(nn.Module):
@@ -24,7 +25,7 @@ class HgBottleneck(nn.Module):
     @nn.compact
     def __call__(self, x, train: bool = True):
         def bn_relu(y):
-            y = nn.BatchNorm(use_running_average=not train, momentum=0.9)(y)
+            y = FusedBatchNorm(use_running_average=not train, momentum=0.9)(y)
             return nn.relu(y)
 
         residual = x
@@ -82,7 +83,7 @@ class StackedHourglass(nn.Module):
     def __call__(self, x, train: bool = True):
         # stem: 256x256 -> 64x64 (hourglass104.py:120-128)
         x = nn.Conv(64, (7, 7), strides=(2, 2), use_bias=False)(x)
-        x = nn.relu(nn.BatchNorm(use_running_average=not train, momentum=0.9)(x))
+        x = nn.relu(FusedBatchNorm(use_running_average=not train, momentum=0.9)(x))
         x = HgBottleneck(128)(x, train)
         x = nn.max_pool(x, (2, 2), strides=(2, 2))
         x = HgBottleneck(128)(x, train)
@@ -94,7 +95,7 @@ class StackedHourglass(nn.Module):
             inter = HgBottleneck(self.features)(inter, train)
             inter = nn.Conv(self.features, (1, 1), use_bias=False)(inter)
             inter = nn.relu(
-                nn.BatchNorm(use_running_average=not train, momentum=0.9)(inter)
+                FusedBatchNorm(use_running_average=not train, momentum=0.9)(inter)
             )
             hm = nn.Conv(self.num_heatmap, (1, 1))(inter)
             heatmaps.append(hm)

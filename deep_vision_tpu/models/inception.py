@@ -20,7 +20,7 @@ import flax.linen as nn
 import jax.numpy as jnp
 
 from deep_vision_tpu.models import register_model
-from deep_vision_tpu.nn.layers import global_avg_pool
+from deep_vision_tpu.nn.layers import FusedBatchNorm, global_avg_pool
 
 _XAVIER = nn.initializers.xavier_normal()
 
@@ -37,7 +37,7 @@ class BasicConv(nn.Module):
     def __call__(self, x, train: bool = True):
         x = nn.Conv(self.features, self.kernel, strides=self.strides,
                     padding=self.padding, use_bias=False, kernel_init=_XAVIER)(x)
-        x = nn.BatchNorm(use_running_average=not train, momentum=0.9)(x)
+        x = FusedBatchNorm(use_running_average=not train, momentum=0.9)(x)
         return nn.relu(x)
 
 

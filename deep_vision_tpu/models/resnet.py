@@ -14,10 +14,11 @@ from __future__ import annotations
 from typing import Optional, Sequence, Tuple
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 
 from deep_vision_tpu.models import register_model
-from deep_vision_tpu.nn.layers import ConvBN, global_avg_pool
+from deep_vision_tpu.nn.layers import ConvBN, FusedBatchNorm, global_avg_pool
 
 
 class BasicBlock(nn.Module):
@@ -50,11 +51,10 @@ class BottleneckBlock(nn.Module):
         # zero-init the last BN scale so each block starts as identity
         # (standard TPU ResNet recipe; improves large-batch training)
         y = nn.Conv(self.features * 4, (1, 1), use_bias=False, dtype=self.dtype)(y)
-        y = nn.BatchNorm(
+        y = FusedBatchNorm(
             use_running_average=not train,
             momentum=0.9,
             scale_init=nn.initializers.zeros_init(),
-            dtype=self.dtype,
         )(y)
         if residual.shape != y.shape:
             residual = ConvBN(
@@ -72,7 +72,7 @@ class PreActBottleneckBlock(nn.Module):
 
     @nn.compact
     def __call__(self, x, train: bool = True):
-        pre = nn.BatchNorm(use_running_average=not train, momentum=0.9, dtype=self.dtype)(x)
+        pre = FusedBatchNorm(use_running_average=not train, momentum=0.9, dtype=self.dtype)(x)
         pre = nn.relu(pre)
         needs_proj = x.shape[-1] != self.features * 4 or self.strides != (1, 1)
         residual = (
@@ -83,10 +83,55 @@ class PreActBottleneckBlock(nn.Module):
         )
         y = nn.Conv(self.features, (1, 1), use_bias=False, dtype=self.dtype)(pre)
         y = ConvBN(self.features, (3, 3), strides=self.strides, dtype=self.dtype)(y, train)
-        y = nn.BatchNorm(use_running_average=not train, momentum=0.9, dtype=self.dtype)(y)
+        y = FusedBatchNorm(use_running_average=not train, momentum=0.9, dtype=self.dtype)(y)
         y = nn.relu(y)
         y = nn.Conv(self.features * 4, (1, 1), use_bias=False, dtype=self.dtype)(y)
         return y + residual
+
+
+class SpaceToDepthStem(nn.Module):
+    """The 7x7/s2 stem conv on space-to-depth input: MXU-efficient, math-equal.
+
+    A 7x7 stride-2 conv on a 3-channel image is the least efficient conv on a
+    TPU: the 3-channel input wastes the 128-wide lane tiling and the profiler
+    shows it HBM-bound well below peak bandwidth. The MLPerf-ResNet trick:
+    the host pipeline lays the image out as (H/2, W/2, 12) (space_to_depth,
+    see data/transforms.py SpaceToDepth), and the stem becomes a 4x4 stride-1
+    conv over 12 channels — *mathematically identical* to the 7x7/s2 conv
+    because the 7x7 kernel zero-pads to 8x8 and reshuffles into (4, 4, 12).
+    The parameter keeps the canonical (7, 7, 3, 64) shape: the kernel values
+    are interchangeable with a conv7 stem's, though the variable-tree paths
+    differ (SpaceToDepthStem_0/kernel vs ConvBN_0/Conv_0/kernel), so moving a
+    checkpoint between stems requires remapping those two paths.
+    """
+
+    features: int = 64
+    dtype: Optional[jnp.dtype] = None
+
+    @nn.compact
+    def __call__(self, x):
+        c_in = x.shape[-1] // 4  # input is (H/2, W/2, 4*C)
+        w = self.param(
+            "kernel", nn.initializers.he_normal(), (7, 7, c_in, self.features),
+            jnp.float32,
+        )
+        # pad 7x7 -> 8x8 at the top-left: kernel tap k maps to original
+        # offset k-1, with k=0 the zero row (see derivation: original row
+        # index = 2(i - 2) + k  vs  2i - 4 + k for the 7x7/s2 at pad 3)
+        k8 = jnp.pad(w, ((1, 0), (1, 0), (0, 0), (0, 0)))
+        w2 = (
+            k8.reshape(4, 2, 4, 2, c_in, self.features)
+            .transpose(0, 2, 1, 3, 4, 5)
+            .reshape(4, 4, 4 * c_in, self.features)
+        )
+        dt = self.dtype or x.dtype
+        return jax.lax.conv_general_dilated(
+            x.astype(dt),
+            w2.astype(dt),
+            window_strides=(1, 1),
+            padding=((2, 1), (2, 1)),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
 
 
 class ResNet(nn.Module):
@@ -95,11 +140,18 @@ class ResNet(nn.Module):
     num_classes: int = 1000
     width: int = 64
     preact: bool = False
+    stem: str = "conv7"  # "conv7" (B,H,W,3) | "s2d" (B,H/2,W/2,12) input
     dtype: Optional[jnp.dtype] = None
 
     @nn.compact
     def __call__(self, x, train: bool = True):
-        if self.preact:
+        if self.stem == "s2d":
+            x = SpaceToDepthStem(64, dtype=self.dtype)(x)
+            if not self.preact:
+                x = nn.relu(
+                    FusedBatchNorm(use_running_average=not train, momentum=0.9)(x)
+                )
+        elif self.preact:
             x = nn.Conv(64, (7, 7), strides=(2, 2), padding=[(3, 3), (3, 3)],
                         use_bias=False, dtype=self.dtype)(x)
         else:
@@ -112,31 +164,31 @@ class ResNet(nn.Module):
                 strides = (2, 2) if i > 0 and j == 0 else (1, 1)
                 x = self.block(features, strides=strides, dtype=self.dtype)(x, train)
         if self.preact:
-            x = nn.relu(nn.BatchNorm(use_running_average=not train, momentum=0.9,
+            x = nn.relu(FusedBatchNorm(use_running_average=not train, momentum=0.9,
                                      dtype=self.dtype)(x))
         x = global_avg_pool(x)
         return nn.Dense(self.num_classes, dtype=jnp.float32)(x)
 
 
 @register_model("resnet34")
-def resnet34(num_classes: int = 1000, dtype=None, **_):
+def resnet34(num_classes: int = 1000, dtype=None, stem: str = "conv7", **_):
     return ResNet(stage_sizes=(3, 4, 6, 3), block=BasicBlock,
-                  num_classes=num_classes, dtype=dtype)
+                  num_classes=num_classes, stem=stem, dtype=dtype)
 
 
 @register_model("resnet50")
-def resnet50(num_classes: int = 1000, dtype=None, **_):
+def resnet50(num_classes: int = 1000, dtype=None, stem: str = "conv7", **_):
     return ResNet(stage_sizes=(3, 4, 6, 3), block=BottleneckBlock,
-                  num_classes=num_classes, dtype=dtype)
+                  num_classes=num_classes, stem=stem, dtype=dtype)
 
 
 @register_model("resnet152")
-def resnet152(num_classes: int = 1000, dtype=None, **_):
+def resnet152(num_classes: int = 1000, dtype=None, stem: str = "conv7", **_):
     return ResNet(stage_sizes=(3, 8, 36, 3), block=BottleneckBlock,
-                  num_classes=num_classes, dtype=dtype)
+                  num_classes=num_classes, stem=stem, dtype=dtype)
 
 
 @register_model("resnet50v2")
-def resnet50v2(num_classes: int = 1000, dtype=None, **_):
+def resnet50v2(num_classes: int = 1000, dtype=None, stem: str = "conv7", **_):
     return ResNet(stage_sizes=(3, 4, 6, 3), block=PreActBottleneckBlock,
-                  num_classes=num_classes, preact=True, dtype=dtype)
+                  num_classes=num_classes, preact=True, stem=stem, dtype=dtype)

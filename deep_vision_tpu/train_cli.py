@@ -18,11 +18,20 @@ import numpy as np
 from deep_vision_tpu.configs import CONFIG_REGISTRY, ExperimentConfig, get_config
 
 
+def model_input_shape(cfg: ExperimentConfig):
+    """The shape the MODEL consumes: cfg.input_shape after any host-side
+    layout transform (stem='s2d' ships (H/2, W/2, 4C), models/resnet.py)."""
+    h, w, c = cfg.input_shape
+    if cfg.model_kwargs.get("stem") == "s2d":
+        return (h // 2, w // 2, 4 * c)
+    return cfg.input_shape
+
+
 # -- fake datasets -----------------------------------------------------------
 
 def _fake_classification(cfg: ExperimentConfig, n_batches: int):
     rng = np.random.RandomState(0)
-    h, w, c = cfg.input_shape
+    h, w, c = model_input_shape(cfg)
     return [
         {
             "image": rng.rand(cfg.batch_size, h, w, c).astype(np.float32),
@@ -182,6 +191,11 @@ def build_dataloaders(cfg: ExperimentConfig, data_dir: str, fake: bool,
                 T.Rescale(cfg.train_resize), T.CenterCrop(cfg.eval_crop),
                 T.ToFloat(expand_gray_to_rgb=True), T.Normalize(),
             ])
+        if cfg.model_kwargs.get("stem") == "s2d":
+            # host half of the MLPerf stem trick (models/resnet.py
+            # SpaceToDepthStem): lay images out (H/2, W/2, 12) on the host
+            train_tf = Compose([train_tf, T.SpaceToDepth()])
+            eval_tf = Compose([eval_tf, T.SpaceToDepth()])
         if _g.glob(rec_glob):
             train_ds = RecordDataset(rec_glob, "imagenet", shuffle_shards=True)
             eval_ds = RecordDataset(
@@ -317,7 +331,7 @@ def build_trainer(cfg: ExperimentConfig, train_fn, ckpt_dir: Optional[str],
 
     plateau = ReduceLROnPlateau(**cfg.plateau) if cfg.plateau else None
     ckpt = CheckpointManager(ckpt_dir) if ckpt_dir else None
-    sample = jnp.ones((2, *cfg.input_shape), jnp.float32)
+    sample = jnp.ones((2, *model_input_shape(cfg)), jnp.float32)
     logger = eval_logger = None
     if tb_dir:
         from deep_vision_tpu.core.metrics import MetricLogger
@@ -393,6 +407,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         cfg.epochs = args.epochs
     if args.batch_size is not None:
         cfg.batch_size = args.batch_size
+    if args.preprocessing == "tf" and (
+        args.fake_data or cfg.dataset.get("kind") != "imagenet"
+    ):
+        print("warning: --preprocessing tf only applies to the ImageNet "
+              "records/folder pipeline; this run uses its default chain")
 
     train_fn, eval_fn = build_dataloaders(
         cfg, args.data_dir, args.fake_data, args.fake_batches, args.num_workers,
@@ -414,6 +433,21 @@ def main(argv: Optional[List[str]] = None) -> int:
         print("model " + cfg.model + ": " + " ".join(
             f"{k}={count_params(s.params):,}" for k, s in states.items()
         ) + " trainable params")
+        if args.summary:
+            from deep_vision_tpu.core.summary import model_summary
+            from deep_vision_tpu.models import get_model as _gm
+            import jax.numpy as _jnp
+
+            img = _jnp.ones((2, *cfg.input_shape), _jnp.float32)
+            if cfg.task == "dcgan":
+                parts = {"G": (_gm("dcgan_generator"), _jnp.ones((2, 100))),
+                         "D": (_gm("dcgan_discriminator"), img)}
+            else:
+                parts = {"G": (_gm("cyclegan_generator"), img),
+                         "D": (_gm("cyclegan_discriminator"), img)}
+            for k, (mod, sample) in parts.items():
+                print(f"-- {k} --")
+                print(model_summary(mod, sample))
         for epoch in range(cfg.epochs):
             # keep per-step metrics as device arrays; float() only at epoch
             # end so the host never blocks async dispatch mid-epoch
@@ -452,7 +486,7 @@ def main(argv: Optional[List[str]] = None) -> int:
 
         # summarize the exact module build_trainer constructed, not a rebuild
         print(model_summary(
-            trainer.model, _jnp.ones((2, *cfg.input_shape), _jnp.float32)
+            trainer.model, _jnp.ones((2, *model_input_shape(cfg)), _jnp.float32)
         ))
     print(f"model {cfg.model}: {count_params(trainer.state.params):,} trainable params")
     start_epoch = 0

@@ -85,3 +85,113 @@ def test_shufflenet_channel_shuffle_roundtrip():
     z = channel_shuffle(y, 4)
     assert jnp.allclose(z, x)
     assert not jnp.allclose(y, x)
+
+
+class TestSpaceToDepthStem:
+    """The s2d stem must be mathematically identical to the conv7 stem."""
+
+    def test_equivalence_to_conv7(self):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        import flax.linen as nn
+        from deep_vision_tpu.data.transforms import space_to_depth
+        from deep_vision_tpu.models.resnet import SpaceToDepthStem
+
+        rng = np.random.RandomState(0)
+        x = rng.rand(2, 32, 32, 3).astype(np.float32)
+        stem = SpaceToDepthStem(16)
+        x2 = np.stack([space_to_depth(im) for im in x])
+        v = stem.init(jax.random.PRNGKey(0), jnp.asarray(x2))
+        w = v["params"]["kernel"]  # canonical (7,7,3,16)
+        y_s2d = stem.apply(v, jnp.asarray(x2))
+        y_ref = jax.lax.conv_general_dilated(
+            jnp.asarray(x), w, window_strides=(2, 2),
+            padding=((3, 3), (3, 3)),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        assert y_s2d.shape == y_ref.shape
+        np.testing.assert_allclose(np.asarray(y_s2d), np.asarray(y_ref),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_resnet_s2d_forward(self):
+        import jax
+        import jax.numpy as jnp
+        from deep_vision_tpu.models import get_model
+
+        model = get_model("resnet50", num_classes=10, stem="s2d")
+        x = jnp.zeros((2, 32, 32, 12), jnp.float32)  # 64x64 image, s2d'd
+        v = model.init({"params": jax.random.PRNGKey(0)}, x, train=False)
+        out = model.apply(v, x, train=False)
+        assert out.shape == (2, 10)
+
+
+class TestFusedBatchNormParity:
+    """nn/layers.py BatchNorm must match flax nn.BatchNorm numerically."""
+
+    def _pair(self, train):
+        import flax.linen as nn
+        from deep_vision_tpu.nn.layers import BatchNorm as FusedBN
+
+        ours = FusedBN(use_running_average=not train, momentum=0.9)
+        ref = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                           use_fast_variance=True)
+        return ours, ref
+
+    def test_train_mode_and_ema_match(self):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        x = jnp.asarray(np.random.RandomState(0).randn(8, 4, 4, 6) * 3 + 7,
+                        jnp.float32)
+        ours, ref = self._pair(train=True)
+        vo = ours.init(jax.random.PRNGKey(0), x)
+        vr = ref.init(jax.random.PRNGKey(0), x)
+        yo, mo = ours.apply(vo, x, mutable=["batch_stats"])
+        yr, mr = ref.apply(vr, x, mutable=["batch_stats"])
+        np.testing.assert_allclose(np.asarray(yo), np.asarray(yr),
+                                   rtol=2e-5, atol=2e-5)
+        np.testing.assert_allclose(
+            np.asarray(mo["batch_stats"]["mean"]),
+            np.asarray(mr["batch_stats"]["mean"]), rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(mo["batch_stats"]["var"]),
+            np.asarray(mr["batch_stats"]["var"]), rtol=1e-4, atol=1e-5)
+
+    def test_eval_mode_matches(self):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        x = jnp.asarray(np.random.RandomState(1).randn(8, 4, 4, 6), jnp.float32)
+        ours, ref = self._pair(train=False)
+        stats = {"mean": jnp.asarray(np.random.RandomState(2).randn(6), jnp.float32),
+                 "var": jnp.abs(jnp.asarray(np.random.RandomState(3).randn(6),
+                                            jnp.float32)) + 0.5}
+        vo = ours.init(jax.random.PRNGKey(0), x)
+        vr = ref.init(jax.random.PRNGKey(0), x)
+        vo = {"params": vo["params"], "batch_stats": stats}
+        vr = {"params": vr["params"], "batch_stats": stats}
+        yo = ours.apply(vo, x)
+        yr = ref.apply(vr, x)
+        np.testing.assert_allclose(np.asarray(yo), np.asarray(yr),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_bf16_large_mean_precision(self):
+        """No catastrophic cancellation: bf16 input with |mean| >> std."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        xf = np.random.RandomState(0).randn(64, 2, 2, 4).astype(np.float32) * 3 + 105
+        x = jnp.asarray(xf, jnp.bfloat16)
+        ours, _ = self._pair(train=True)
+        v = ours.init(jax.random.PRNGKey(0), x)
+        y, _ = ours.apply(v, x, mutable=["batch_stats"])
+        # reference: exact f32 normalization of the bf16-quantized input
+        x32 = np.asarray(x, np.float32)
+        mean = x32.mean((0, 1, 2))
+        var = (x32 ** 2).mean((0, 1, 2)) - mean ** 2
+        y_ref = (x32 - mean) / np.sqrt(var + 1e-5)
+        err = np.abs(np.asarray(y, np.float32) - y_ref).max()
+        assert err < 0.02, err  # bf16 output quantization only, not 0.29
