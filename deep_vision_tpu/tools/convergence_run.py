@@ -1,0 +1,108 @@
+"""Short real-hardware convergence run; records the loss curve as an artifact.
+
+The reference commits multi-MB training logs as convergence evidence
+(ResNet/pytorch/logs/resnet50-yanjiali-010919.log; "compare with other's
+losses", YOLO/tensorflow/README.md:18). This is the executable equivalent
+sized for CI-on-a-chip: N optimizer steps of the flagship ResNet-50 recipe
+(bf16, s2d stem, SGD+momentum exactly as configs/resnet50) on a fixed
+memorizable fixture, asserting the loss collapses, and writing the full curve
++ environment to artifacts/ for humans to diff between rounds.
+
+    python -m deep_vision_tpu.tools.convergence_run [--steps 200] [--batch 64]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import time
+
+
+def run(steps: int = 200, batch: int = 64, classes: int = 64,
+        out_path: str = "artifacts/resnet50_tpu_convergence.json") -> dict:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from deep_vision_tpu.core.train_state import create_train_state
+    from deep_vision_tpu.data.transforms import space_to_depth
+    from deep_vision_tpu.losses.classification import classification_loss_fn
+    from deep_vision_tpu.models import get_model
+    from deep_vision_tpu.train.optimizers import build_optimizer
+
+    model = get_model("resnet50", num_classes=classes, dtype=jnp.bfloat16,
+                      stem="s2d")
+    tx = build_optimizer("sgd", 0.05, momentum=0.9, weight_decay=1e-4)
+    state = create_train_state(
+        model, tx, jnp.ones((8, 56, 56, 12), jnp.float32), jax.random.PRNGKey(0)
+    )
+
+    # fixed fixture: `batch` images / `classes` labels, memorizable in O(100)
+    # steps — real-data ImageNet is not present in this environment, so the
+    # evidence is "the full recipe optimizes on hardware", not accuracy parity
+    rng = np.random.RandomState(0)
+    imgs = rng.rand(batch, 112, 112, 3).astype(np.float32)
+    batch_d = {
+        "image": jnp.asarray(
+            np.stack([space_to_depth(i) for i in imgs]), jnp.bfloat16
+        ),
+        "label": jnp.asarray(np.arange(batch) % classes, jnp.int32),
+    }
+
+    def train_step(state, batch):
+        def loss_fn(params):
+            variables = {"params": params, "batch_stats": state.batch_stats}
+            out, nms = state.apply_fn(
+                variables, batch["image"], train=True,
+                rngs={"dropout": jax.random.fold_in(state.rng, state.step)},
+                mutable=["batch_stats"])
+            loss, _ = classification_loss_fn(out, batch)
+            return loss, nms["batch_stats"]
+
+        (loss, bs), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            state.params)
+        return state.apply_gradients(grads).replace(batch_stats=bs), loss
+
+    step = jax.jit(train_step, donate_argnums=0)
+    losses = []
+    t0 = time.time()
+    for i in range(steps):
+        state, loss = step(state, batch_d)
+        if i % 10 == 0 or i == steps - 1:
+            losses.append((i, float(loss)))
+    wall = time.time() - t0
+
+    dev = jax.devices()[0]
+    result = {
+        "model": "resnet50 (bf16, s2d stem, SGD 0.05/0.9/1e-4)",
+        "device": f"{dev.platform}:{dev.device_kind}",
+        "steps": steps,
+        "batch": batch,
+        "classes": classes,
+        "wall_seconds": round(wall, 1),
+        "loss_curve": [[i, round(l, 4)] for i, l in losses],
+        "first_loss": round(losses[0][1], 4),
+        "final_loss": round(losses[-1][1], 4),
+    }
+    os.makedirs(os.path.dirname(out_path), exist_ok=True)
+    with open(out_path, "w") as f:
+        json.dump(result, f, indent=1)
+    return result
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--steps", type=int, default=200)
+    p.add_argument("--batch", type=int, default=64)
+    p.add_argument("--out", default="artifacts/resnet50_tpu_convergence.json")
+    args = p.parse_args(argv)
+    r = run(args.steps, args.batch, out_path=args.out)
+    print(f"device={r['device']} first={r['first_loss']} "
+          f"final={r['final_loss']} wall={r['wall_seconds']}s -> {args.out}")
+    ok = r["final_loss"] < 0.5 * r["first_loss"]
+    print("CONVERGED" if ok else "DID NOT CONVERGE")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

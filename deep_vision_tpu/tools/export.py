@@ -1,0 +1,115 @@
+"""Model export: serialize any registered model to portable StableHLO.
+
+The TPU-native analog of the reference's TFLite conversion
+(CycleGAN/tensorflow/convert.py:1-15, SavedModel -> TFLiteConverter):
+`jax.export` lowers the jitted eval-mode apply to StableHLO with the trained
+variables baked in as constants, and serializes it with shape/dtype calling
+conventions attached. The artifact reloads with `load_exported` and runs on
+any JAX backend (CPU/TPU) without the model's Python class — the same
+"frozen inference artifact" role TFLite plays in the reference.
+
+CLI:
+    python -m deep_vision_tpu.tools.export -m resnet50 -o resnet50.stablehlo \
+        [-c checkpoints/resnet50] [--batch 8]
+
+GAN configs export the generator (the deployable half, matching
+CycleGAN/tensorflow/inference.py:11-70 which restores only generator_a2b).
+"""
+from __future__ import annotations
+
+import argparse
+from typing import Optional
+
+import numpy as np
+
+
+def export_model(model, variables, sample_input, *, train: bool = False):
+    """Returns a `jax.export.Exported` of eval-mode `model.apply`."""
+    import jax
+    from jax import export as jexport
+
+    def infer(x):
+        return model.apply(variables, x, train=train)
+
+    return jexport.export(jax.jit(infer))(
+        jax.ShapeDtypeStruct(np.shape(sample_input), sample_input.dtype)
+    )
+
+
+def save_exported(exported, path: str) -> None:
+    with open(path, "wb") as f:
+        f.write(exported.serialize())
+
+
+def load_exported(path: str):
+    """Load a serialized artifact; returns an object with `.call(x)`."""
+    from jax import export as jexport
+
+    with open(path, "rb") as f:
+        return jexport.deserialize(f.read())
+
+
+def export_config(name: str, out_path: str, ckpt_dir: Optional[str] = None,
+                  batch: int = 8) -> str:
+    """Export a registry config's model (GANs: the generator)."""
+    import jax
+    import jax.numpy as jnp
+
+    from deep_vision_tpu.configs import get_config
+    from deep_vision_tpu.models import get_model
+    from deep_vision_tpu.train_cli import model_input_shape
+
+    cfg = get_config(name)
+    rngs = {"params": jax.random.PRNGKey(0), "dropout": jax.random.PRNGKey(1)}
+    if cfg.task == "dcgan":
+        model = get_model("dcgan_generator")
+        sample = jnp.zeros((batch, 100), jnp.float32)
+    elif cfg.task == "cyclegan":
+        model = get_model("cyclegan_generator")
+        sample = jnp.zeros((batch, *cfg.input_shape), jnp.float32)
+    else:
+        kwargs = dict(cfg.model_kwargs)
+        if cfg.task != "pose":
+            kwargs["num_classes"] = cfg.num_classes
+        model = get_model(cfg.model, **kwargs)
+        sample = jnp.zeros((batch, *model_input_shape(cfg)), jnp.float32)
+    variables = model.init(rngs, sample, train=False)
+
+    if ckpt_dir:
+        # restore trained params over the freshly-initialized template
+        from deep_vision_tpu.core.checkpoint import CheckpointManager
+        from deep_vision_tpu.core.train_state import create_train_state
+        from deep_vision_tpu.train.optimizers import build_optimizer
+
+        state = create_train_state(
+            model, build_optimizer("sgd", 0.1), sample
+        )
+        ckpt = CheckpointManager(ckpt_dir)
+        state, _ = ckpt.restore(state)
+        variables = {"params": state.params}
+        if state.batch_stats:
+            variables["batch_stats"] = state.batch_stats
+
+    exported = export_model(model, variables, sample)
+    save_exported(exported, out_path)
+    return out_path
+
+
+def main(argv=None) -> int:
+    from deep_vision_tpu.configs import CONFIG_REGISTRY
+
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("-m", "--model", required=True, choices=sorted(CONFIG_REGISTRY))
+    p.add_argument("-o", "--output", required=True)
+    p.add_argument("-c", "--checkpoint", default=None, help="checkpoint dir")
+    p.add_argument("--batch", type=int, default=8)
+    args = p.parse_args(argv)
+    path = export_config(args.model, args.output, args.checkpoint, args.batch)
+    import os
+
+    print(f"exported {args.model} -> {path} ({os.path.getsize(path):,} bytes)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

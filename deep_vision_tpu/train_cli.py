@@ -73,7 +73,7 @@ def _fake_pose(cfg: ExperimentConfig, n_batches: int, hm_size=64):
     h, w, c = cfg.input_shape
     out = []
     for _ in range(n_batches):
-        hms = []
+        hms, kps, viss = [], [], []
         for _b in range(cfg.batch_size):
             s = {
                 "keypoints": rng.rand(cfg.num_classes, 2).astype(np.float32),
@@ -81,10 +81,14 @@ def _fake_pose(cfg: ExperimentConfig, n_batches: int, hm_size=64):
             }
             hms.append(make_pose_heatmaps(s, size=hm_size,
                                           num_joints=cfg.num_classes)["heatmap"])
+            kps.append(s["keypoints"])
+            viss.append(s["visibility"])
         out.append(
             {
                 "image": rng.rand(cfg.batch_size, h, w, c).astype(np.float32),
                 "heatmap": np.stack(hms),
+                "keypoints": np.stack(kps),
+                "visibility": np.stack(viss),
             }
         )
     return out
@@ -107,6 +111,10 @@ def _fake_centernet(cfg: ExperimentConfig, n_batches: int):
         out.append(
             {
                 "image": batch["image"],
+                # raw boxes ride along like the real pipeline's (PadBoxes
+                # stays in the sample dict) — --eval-only mAP needs them
+                "boxes": batch["boxes"],
+                "classes": batch["classes"],
                 "heatmap": np.stack([t["heatmap"] for t in tgts]),
                 "wh": np.stack([t["wh"] for t in tgts]),
                 "offset": np.stack([t["offset"] for t in tgts]),
@@ -371,6 +379,79 @@ def build_gan_trainer(cfg: ExperimentConfig):
     )
 
 
+def run_eval_only(cfg: ExperimentConfig, trainer, eval_fn) -> dict:
+    """Quality evaluation from a checkpoint: the reference's demo-notebook
+    role (YOLO demo_mscoco.ipynb, Hourglass demo_hourglass_pose.ipynb) as a
+    CLI mode, with the metrics the reference never shipped (mAP 'working in
+    progress' at YOLO/tensorflow/README.md:28-31; no PCK anywhere)."""
+    import jax
+
+    variables = {"params": trainer.state.params}
+    if trainer.state.batch_stats:
+        variables["batch_stats"] = trainer.state.batch_stats
+
+    if cfg.task == "classification":
+        summary = trainer.evaluate(eval_fn())
+        print("eval: " + " ".join(f"{k}={v:.4f}" for k, v in summary.items()))
+        return summary
+
+    if cfg.task in ("detection", "centernet"):
+        from deep_vision_tpu.core.detection_metrics import DetectionEvaluator
+        from deep_vision_tpu.inference import (
+            make_centernet_detector,
+            make_yolo_detector,
+        )
+
+        if cfg.task == "detection":
+            detect = make_yolo_detector(trainer.model, score_threshold=0.1)
+        else:
+            detect = make_centernet_detector(trainer.model)
+        ev = DetectionEvaluator(cfg.num_classes)
+        for batch in eval_fn():
+            out = jax.device_get(detect(variables, batch["image"]))
+            for i in range(len(batch["image"])):
+                ev.add(out["boxes"][i], out["scores"][i], out["classes"][i],
+                       batch["boxes"][i], batch["classes"][i])
+        res = ev.compute(iou_threshold=0.5)
+        coco = ev.compute_coco()
+        print(f"eval: mAP@.5={res['mAP']:.4f} "
+              f"mAP@[.5:.95]={coco['mAP@[.5:.95]']:.4f} "
+              f"images={res['num_images']}")
+        return {"mAP@.5": res["mAP"], **coco}
+
+    if cfg.task == "pose":
+        from deep_vision_tpu.core.detection_metrics import pck
+        from deep_vision_tpu.inference import make_pose_estimator
+
+        estimate = make_pose_estimator(trainer.model)
+        preds, gts, viss, norms = [], [], [], []
+        head_flags = set()
+        for batch in eval_fn():
+            kpts = np.asarray(jax.device_get(estimate(variables, batch["image"])))
+            preds.append(kpts[..., :2])
+            gts.append(np.asarray(batch["keypoints"]))
+            viss.append(np.asarray(
+                batch.get("visibility", np.ones(kpts.shape[:2]))) > 0)
+            # PCKh when the records carry a head size; else image-normalized
+            # PCK@0.05 (coordinates are in [0,1], so norm=1 is the image side)
+            head_flags.add("head_size" in batch)
+            norms.append(np.asarray(
+                batch.get("head_size", np.ones(len(kpts)))))
+        if len(head_flags) > 1:
+            raise ValueError(
+                "eval batches are inconsistent: some carry 'head_size', some "
+                "don't — PCKh and image-normalized PCK cannot be mixed"
+            )
+        alpha = 0.5 if head_flags == {True} else 0.05
+        out = pck(np.concatenate(preds), np.concatenate(gts),
+                  np.concatenate(viss), np.concatenate(norms), alpha=alpha)
+        key = [k for k in out if k.startswith("PCK")][0]
+        print(f"eval: {key}={out[key]:.4f} visible={out['num_visible']}")
+        return out
+
+    raise ValueError(f"--eval-only unsupported for task {cfg.task!r}")
+
+
 # -- main --------------------------------------------------------------------
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -393,6 +474,10 @@ def main(argv: Optional[List[str]] = None) -> int:
                         help="capture a jax.profiler trace of steps 10-20")
     parser.add_argument("--eval-first", action="store_true",
                         help="epoch-0 sanity validate (ResNet/pytorch/train.py:390)")
+    parser.add_argument("--eval-only", action="store_true",
+                        help="no training: evaluate the checkpoint on the val "
+                             "split (classification loss/top-k, detection mAP, "
+                             "pose PCK)")
     parser.add_argument("--preprocessing", default="torch",
                         choices=["torch", "tf"],
                         help="ImageNet chain: torchvision stats or the TF "
@@ -495,6 +580,9 @@ def main(argv: Optional[List[str]] = None) -> int:
             trainer.ckpt = type(trainer.ckpt)(args.checkpoint)
         start_epoch = trainer.resume()
         print(f"resumed from step {int(trainer.state.step)} -> epoch {start_epoch}")
+    if args.eval_only:
+        run_eval_only(cfg, trainer, eval_fn)
+        return 0
     trainer.fit(
         train_fn, eval_fn, epochs=cfg.epochs, start_epoch=start_epoch,
         eval_first=args.eval_first,

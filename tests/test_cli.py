@@ -36,8 +36,8 @@ def test_get_config_returns_copy():
 @pytest.mark.parametrize("task,keys", [
     ("classification", {"image", "label"}),
     ("detection", {"image", "boxes", "classes"}),
-    ("pose", {"image", "heatmap"}),
-    ("centernet", {"image", "heatmap", "wh", "offset", "mask"}),
+    ("pose", {"image", "heatmap", "keypoints", "visibility"}),
+    ("centernet", {"image", "boxes", "classes", "heatmap", "wh", "offset", "mask"}),
 ])
 def test_fake_dataloaders_shapes(task, keys):
     name = {"classification": "lenet5", "detection": "yolov3_voc",
@@ -76,3 +76,46 @@ def test_schedule_epoch_to_step_conversion():
     assert float(sched(0)) == pytest.approx(0.01)
     assert float(sched(999)) == pytest.approx(0.01)
     assert float(sched(1000)) == pytest.approx(0.005)
+
+
+def test_cli_eval_only_classification(tmp_path, mesh8, capsys):
+    from deep_vision_tpu.train_cli import main
+
+    rc = main(["-m", "lenet5", "--fake-data", "--epochs", "1",
+               "--batch-size", "16", "--ckpt-dir", str(tmp_path / "ck")])
+    assert rc == 0
+    rc = main(["-m", "lenet5", "--fake-data", "--batch-size", "16",
+               "--ckpt-dir", str(tmp_path / "ck"), "-c", "auto",
+               "--eval-only"])
+    assert rc == 0
+    assert "eval:" in capsys.readouterr().out
+
+
+def test_cli_eval_only_detection(mesh8, capsys):
+    """mAP path end-to-end via the CLI on fake data (untrained model: the
+    metric just has to compute, not be good)."""
+    from deep_vision_tpu.train_cli import main
+
+    rc = main(["-m", "yolov3_voc", "--fake-data", "--fake-batches", "1",
+               "--batch-size", "2", "--eval-only"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "mAP@.5=" in out
+
+
+def test_cli_eval_only_pose(mesh8, capsys):
+    from deep_vision_tpu.train_cli import main
+
+    rc = main(["-m", "hourglass_mpii", "--fake-data", "--fake-batches", "1",
+               "--batch-size", "2", "--eval-only"])
+    assert rc == 0
+    assert "PCK" in capsys.readouterr().out
+
+
+def test_cli_eval_only_centernet(mesh8, capsys):
+    from deep_vision_tpu.train_cli import main
+
+    rc = main(["-m", "centernet_coco", "--fake-data", "--fake-batches", "1",
+               "--batch-size", "2", "--eval-only"])
+    assert rc == 0
+    assert "mAP@.5=" in capsys.readouterr().out
