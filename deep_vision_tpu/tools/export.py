@@ -85,6 +85,11 @@ def export_config(name: str, out_path: str, ckpt_dir: Optional[str] = None,
             model, build_optimizer("sgd", 0.1), sample
         )
         ckpt = CheckpointManager(ckpt_dir)
+        if ckpt.latest_step() is None:
+            raise FileNotFoundError(
+                f"no checkpoint found in {ckpt_dir!r}: refusing to export "
+                "freshly-initialized weights under a -c flag"
+            )
         state, _ = ckpt.restore(state)
         variables = {"params": state.params}
         if state.batch_stats:

@@ -504,6 +504,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     )
 
     if cfg.task in ("dcgan", "cyclegan"):
+        if args.eval_only:
+            parser.error(
+                f"--eval-only is not supported for GAN task {cfg.task!r} "
+                "(no scalar quality metric; use the sample grids instead)"
+            )
         import jax as _jax
 
         from deep_vision_tpu.core.summary import count_params

@@ -119,3 +119,10 @@ def test_cli_eval_only_centernet(mesh8, capsys):
                "--batch-size", "2", "--eval-only"])
     assert rc == 0
     assert "mAP@.5=" in capsys.readouterr().out
+
+
+def test_cli_eval_only_rejected_for_gans(capsys):
+    from deep_vision_tpu.train_cli import main
+
+    with pytest.raises(SystemExit):
+        main(["-m", "dcgan_mnist", "--fake-data", "--eval-only"])
