@@ -1,8 +1,9 @@
 """Benchmark: ResNet-50 training throughput (images/sec) on the local chip(s).
 
-Runs the framework's real jitted train step (forward + loss + backward + SGD
-update + BN stat update) on the flagship model with synthetic ImageNet-shaped
-data in bfloat16 compute (fp32 params), and prints ONE JSON line:
+Default mode runs the framework's real jitted train step (forward + loss +
+backward + SGD update + BN stat update) on the flagship model with synthetic
+ImageNet-shaped data in bfloat16 compute (fp32 params), and prints ONE JSON
+line:
 
     {"metric": ..., "value": N, "unit": "images/sec", "vs_baseline": N}
 
@@ -12,10 +13,21 @@ is ">= 0.9x A100x8 images/sec" for ResNet-50 (BASELINE.json). We normalize
 per chip: an A100 sustains ~2900 images/sec on ResNet-50/224 mixed-precision
 training (MLPerf-class recipe), so the per-chip target is 0.9 * 2900 = 2610
 and vs_baseline = value_per_chip / 2610.
+
+`--data host` / `--data fused` instead benchmark the REAL input pipeline
+(SURVEY §7 hard part #1): sharded records -> JPEG decode -> augment -> host
+batches (`host`), plus space-to-depth + device_put onto the chip (`fused`),
+over a self-generated JPEG record fixture. The number is reported per host
+CPU core (this VM has one; the 224-vCPU host of a real v5e-8 slice scales
+the pipeline linearly with cores via DataLoader(num_procs=...)), with
+vs_baseline = per_core / (8 * 2610 / 224) — the per-core rate at which a
+full v5e-8 host (224 vCPUs) keeps all 8 chips fed.
 """
 from __future__ import annotations
 
+import argparse
 import json
+import os
 import sys
 import time
 
@@ -32,6 +44,86 @@ WARMUP_STEPS = 5
 TIMED_STEPS = 20
 WINDOWS = 3  # report the MEDIAN window: robust to the tunnel's +-4% jitter
              # without inflating the metric the way a best-of-N min would
+
+
+FIXTURE_DIR = "/tmp/deep_vision_tpu_bench_records"
+# per-core feed target: 8 chips x 2610 img/s spread over a v5e-8 host's 224
+# vCPUs (GCP ct5lp-hightpu-8t machine shape)
+DATA_TARGET_PER_CORE = 8 * 2610.0 / 224.0
+
+
+def _ensure_fixture(n_shards: int = 4, per_shard: int = 256) -> str:
+    """Self-generated JPEG record shards (~45KB/img, ImageNet-like sizes)."""
+    import cv2
+
+    from deep_vision_tpu.data.example_codec import encode_example
+    from deep_vision_tpu.data.records import RecordWriter
+
+    if os.path.isdir(FIXTURE_DIR) and len(os.listdir(FIXTURE_DIR)) == n_shards:
+        return FIXTURE_DIR
+    os.makedirs(FIXTURE_DIR, exist_ok=True)
+    rng = np.random.RandomState(0)
+    for s in range(n_shards):
+        path = os.path.join(FIXTURE_DIR, f"train-{s:05d}")
+        with RecordWriter(path) as w:
+            for _ in range(per_shard):
+                img = (rng.rand(375, 500, 3) * 60 + 90).astype(np.uint8)
+                img += np.arange(500, dtype=np.uint8)[None, :, None] // 4
+                ok, enc = cv2.imencode(
+                    ".jpg", img, [cv2.IMWRITE_JPEG_QUALITY, 90]
+                )
+                assert ok
+                w.write(encode_example({
+                    "image/encoded": [enc.tobytes()],
+                    "image/class/label": [int(rng.randint(1, 1001))],
+                }))
+    return FIXTURE_DIR
+
+
+def data_main(mode: str, num_procs: int) -> None:
+    """Input-pipeline benchmark: the full ImageNet train chain."""
+    from deep_vision_tpu.data import Compose, DataLoader, RecordDataset
+    from deep_vision_tpu.data import transforms as T
+
+    _ensure_fixture()
+    ds = RecordDataset(FIXTURE_DIR + "/*", "imagenet", shuffle_shards=True)
+    chain = Compose([
+        T.Rescale(256), T.RandomHorizontalFlip(), T.RandomCrop(IMAGE_SIZE),
+        T.ColorJitter(0.4, 0.4, 0.4),
+        T.ToFloatNormalize(expand_gray_to_rgb=True),
+        T.SpaceToDepth(),  # flagship config's host half of the s2d stem
+    ])
+    dl = DataLoader(ds, BATCH_PER_CHIP, chain, shuffle=True,
+                    shuffle_buffer=1024, num_workers=8, num_procs=num_procs,
+                    drop_remainder=True)
+    if mode == "fused":
+        from deep_vision_tpu.parallel.mesh import create_mesh, data_sharding
+
+        mesh = create_mesh()
+        put = lambda b: jax.device_put(
+            jnp.asarray(b["image"], jnp.bfloat16),
+            data_sharding(mesh, 4),
+        )
+    n_cores = os.cpu_count() or 1
+    n = 0
+    t0 = time.perf_counter()
+    for batch in dl:
+        if mode == "fused":
+            jax.block_until_ready(put(batch))
+        n += len(batch["image"])
+    dt = time.perf_counter() - t0
+    per_core = n / dt / n_cores
+    print(
+        f"bench-data: {mode} {n} imgs in {dt:.1f}s on {n_cores} core(s), "
+        f"num_procs={num_procs}",
+        file=sys.stderr,
+    )
+    print(json.dumps({
+        "metric": f"imagenet_pipeline_{mode}_images_per_sec_per_core",
+        "value": round(per_core, 1),
+        "unit": "images/sec/core",
+        "vs_baseline": round(per_core / DATA_TARGET_PER_CORE, 3),
+    }))
 
 
 def main() -> None:
@@ -133,4 +225,14 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    main()
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--data", choices=["host", "fused"], default=None,
+                        help="benchmark the input pipeline instead of the "
+                             "train step")
+    parser.add_argument("--num-procs", type=int, default=0,
+                        help="decode worker processes (0 = thread pool)")
+    args = parser.parse_args()
+    if args.data:
+        data_main(args.data, args.num_procs)
+    else:
+        main()

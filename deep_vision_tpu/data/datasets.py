@@ -206,6 +206,23 @@ class RecordDataset:
         self.seed = seed
         self._epoch = 0
 
+    def set_epoch(self, epoch: int) -> None:
+        """Pin the shard-reshuffle epoch (DataLoader `num_procs` mode, where
+        the parent process never iterates and so never advances it)."""
+        self._epoch = epoch
+
+    def split(self, index: int, count: int) -> "RecordDataset":
+        """The index-th of `count` disjoint shard slices (for DataLoader
+        `num_procs` worker processes; mirrors the per-host `shard_index`/
+        `num_shards` split)."""
+        out = RecordDataset.__new__(RecordDataset)
+        out.files = self.files[index::count]
+        out.schema = self.schema
+        out.shuffle_shards = self.shuffle_shards
+        out.seed = self.seed + 1000003 * index
+        out._epoch = self._epoch
+        return out
+
     def __iter__(self) -> Iterator[dict]:
         files = list(self.files)
         if self.shuffle_shards:

@@ -4,12 +4,17 @@ The TPU-feed replacement for both reference input stacks: torch DataLoader
 with worker processes (ResNet/pytorch/train.py:218-257) and
 tf.data map(AUTOTUNE)/shuffle/batch/prefetch chains
 (YOLO/tensorflow/train.py:260-273). Decode+augment run on a thread pool
-(cv2/PIL release the GIL for the heavy work), a sample-level shuffle buffer
-reproduces `shuffle(512)`/`shuffle(10000)` semantics, and batches are
-collated into fixed-shape numpy dicts ready for `shard_batch` onto the mesh.
+(cv2/PIL release the GIL for the heavy work) or, with `num_procs > 0`, on
+worker *processes* that each own a disjoint slice of the dataset — the
+GIL-free analog of torch's `num_workers` processes, required to scale JPEG
+decode across the ~100-vCPU hosts that feed a v5e-8 slice. A sample-level
+shuffle buffer reproduces `shuffle(512)`/`shuffle(10000)` semantics, and
+batches are collated into fixed-shape numpy dicts ready for `shard_batch`
+onto the mesh.
 """
 from __future__ import annotations
 
+import multiprocessing as mp
 import queue
 import threading
 from concurrent.futures import ThreadPoolExecutor
@@ -36,6 +41,54 @@ def collate(samples: List[dict]) -> dict:
     return {k: np.stack([np.asarray(s[k]) for s in samples]) for k in keys}
 
 
+def _buffer_shuffle(samples: Iterable[dict], buffer: int,
+                    rng: np.random.Generator) -> Iterator[dict]:
+    """Reservoir-style shuffle (tf.data shuffle(buffer) semantics)."""
+    buf: List[dict] = []
+    for s in samples:
+        if len(buf) < buffer:
+            buf.append(s)
+            continue
+        j = int(rng.integers(0, len(buf)))
+        out, buf[j] = buf[j], s
+        yield out
+    rng.shuffle(buf)  # type: ignore[arg-type]
+    yield from buf
+
+
+def _proc_worker(dataset, transform, epoch_seed, wid, out_q, stop_evt):
+    """Worker-process body: stream, transform, and ship samples.
+
+    Runs in a forked child; `dataset` is this worker's disjoint slice.
+    Samples cross the process boundary via the queue's pickling — keep
+    images uint8 until the last transform to halve that traffic.
+    """
+    def put(item) -> bool:
+        """Bounded put that keeps observing stop_evt (an abandoned consumer
+        leaves the queue full; a plain put would block past the stop)."""
+        while not stop_evt.is_set():
+            try:
+                out_q.put(item, timeout=0.2)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    try:
+        rng = np.random.default_rng((epoch_seed, wid))
+        for k, sample in enumerate(dataset):
+            if stop_evt.is_set():
+                break
+            if transform is not None:
+                sample = transform(sample, rng)
+            if not put(sample):
+                break
+    except BaseException as e:  # noqa: BLE001 - surfaced in the parent
+        put(("__error__", repr(e)))
+    finally:
+        put(("__done__", wid))
+
+
 class DataLoader:
     """dataset (+ transforms) -> iterator of batch dicts.
 
@@ -43,6 +96,12 @@ class DataLoader:
     Map-style datasets get a full index shuffle per epoch (torch DataLoader
     shuffle=True semantics); iterable datasets get a reservoir-style shuffle
     buffer (tf.data shuffle(buffer) semantics, YOLO/tensorflow/train.py:267).
+
+    `num_procs > 0` decodes in worker PROCESSES instead of threads: the
+    dataset must expose `.split(i, n)` returning the i-th of n disjoint
+    slices (RecordDataset does, by shard), and dataset+transform must be
+    picklable. Sample order then interleaves arbitrarily across workers —
+    use `shuffle` (which is the training configuration anyway).
     """
 
     def __init__(
@@ -57,6 +116,7 @@ class DataLoader:
         seed: int = 0,
         collate_fn: Callable = collate,
         prefetch: int = 2,
+        num_procs: int = 0,
     ):
         self.dataset = dataset
         self.batch_size = batch_size
@@ -68,6 +128,12 @@ class DataLoader:
         self.seed = seed
         self.collate_fn = collate_fn
         self.prefetch = prefetch
+        self.num_procs = num_procs
+        if num_procs > 0 and not hasattr(dataset, "split"):
+            raise TypeError(
+                f"num_procs={num_procs} needs a dataset with .split(i, n); "
+                f"{type(dataset).__name__} has none"
+            )
         self._epoch = 0
         self._map_style = hasattr(dataset, "__getitem__") and hasattr(
             dataset, "__len__"
@@ -93,16 +159,7 @@ class DataLoader:
             if not self.shuffle:
                 yield from it
                 return
-            buf: List[dict] = []
-            for s in it:
-                if len(buf) < self.shuffle_buffer:
-                    buf.append(s)
-                    continue
-                j = int(epoch_rng.integers(0, len(buf)))
-                out, buf[j] = buf[j], s
-                yield out
-            epoch_rng.shuffle(buf)  # type: ignore[arg-type]
-            yield from buf
+            yield from _buffer_shuffle(it, self.shuffle_buffer, epoch_rng)
 
     def _transformed(self, epoch_seed: int) -> Iterator[dict]:
         epoch_rng = np.random.default_rng(epoch_seed)
@@ -132,11 +189,96 @@ class DataLoader:
                 yield window.get().result()
                 in_flight -= 1
 
+    def _proc_samples(self, epoch_seed: int, epoch: int) -> Iterator[dict]:
+        """Transformed samples from `num_procs` spawned workers, merged.
+
+        Spawn, not fork: the parent has usually initialized JAX (threads +
+        a live TPU client) by the time the first epoch starts, and forking a
+        multithreaded process is a deadlock lottery. Spawned children import
+        fresh; the env override below pins any jax import they trigger to
+        the CPU backend so 8+ workers never try to attach to the chip.
+        """
+        import os
+
+        ctx = mp.get_context("spawn")
+        out_q: "mp.Queue" = ctx.Queue(maxsize=self.num_procs * 64)
+        stop = ctx.Event()
+        procs = []
+        saved = {k: os.environ.get(k) for k in ("JAX_PLATFORMS",)}
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        try:
+            for i in range(self.num_procs):
+                shard = self.dataset.split(i, self.num_procs)
+                # the parent never iterates self.dataset in proc mode, so its
+                # epoch counter would freeze the per-epoch shard reshuffle —
+                # propagate the loader's epoch into each slice explicitly
+                if hasattr(shard, "set_epoch"):
+                    shard.set_epoch(epoch)
+                p = ctx.Process(
+                    target=_proc_worker,
+                    args=(shard, self.transform, epoch_seed, i, out_q, stop),
+                    daemon=True,
+                )
+                p.start()
+                procs.append(p)
+        finally:
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+        done: set = set()
+        try:
+            while len(done) < len(procs):
+                try:
+                    item = out_q.get(timeout=10)
+                except queue.Empty:
+                    # watchdog: a SIGKILL'd/segfaulted worker writes no done
+                    # marker; without this the loader would hang forever
+                    failed = [
+                        i for i, p in enumerate(procs)
+                        if i not in done and not p.is_alive()
+                    ]
+                    if failed and out_q.empty():
+                        raise RuntimeError(
+                            f"data worker(s) {failed} died without a done "
+                            "marker (OOM-killed or crashed in native code)"
+                        )
+                    continue
+                if isinstance(item, tuple) and len(item) == 2:
+                    if item[0] == "__done__":
+                        done.add(item[1])
+                        continue
+                    if item[0] == "__error__":
+                        raise RuntimeError(f"data worker failed: {item[1]}")
+                yield item
+        finally:
+            stop.set()
+            # drain so children blocked in put() can observe the stop
+            try:
+                while True:
+                    out_q.get_nowait()
+            except queue.Empty:
+                pass
+            for p in procs:
+                p.join(timeout=5)
+                if p.is_alive():
+                    p.terminate()
+
     def _batches(self) -> Iterator[dict]:
         epoch_seed = self.seed + self._epoch
         self._epoch += 1
+        if self.num_procs > 0:
+            samples: Iterable[dict] = self._proc_samples(epoch_seed, self._epoch - 1)
+            if self.shuffle:
+                samples = _buffer_shuffle(
+                    samples, self.shuffle_buffer,
+                    np.random.default_rng(epoch_seed),
+                )
+        else:
+            samples = self._transformed(epoch_seed)
         buf: List[dict] = []
-        for s in self._transformed(epoch_seed):
+        for s in samples:
             buf.append(s)
             if len(buf) == self.batch_size:
                 yield self.collate_fn(buf)

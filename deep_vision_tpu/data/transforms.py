@@ -190,6 +190,8 @@ class ColorJitter:
     def _factor(rng, amount):
         return float(rng.uniform(max(0.0, 1.0 - amount), 1.0 + amount))
 
+    _LUMA = np.array([0.299, 0.587, 0.114], np.float32)
+
     def __call__(self, sample: dict, rng: np.random.Generator) -> dict:
         was_uint8 = sample["image"].dtype == np.uint8
         img = sample["image"].astype(np.float32)
@@ -197,20 +199,31 @@ class ColorJitter:
             scale = 255.0
         else:
             scale = 1.0
-        if self.brightness:
-            img = img * self._factor(rng, self.brightness)
-        if self.contrast:
-            f = self._factor(rng, self.contrast)
-            # grayscale via ITU-R 601 luma, matching PIL ImageEnhance.Contrast
-            mean = (
-                img[..., :3] @ np.array([0.299, 0.587, 0.114], np.float32)
-            ).mean()
-            img = (img - mean) * f + mean
-        if self.saturation and img.shape[-1] == 3:
-            f = self._factor(rng, self.saturation)
-            gray = img @ np.array([0.299, 0.587, 0.114], np.float32)
-            img = (img - gray[..., None]) * f + gray[..., None]
-        if self.hue and img.shape[-1] == 3:
+        rgb = img.shape[-1] == 3
+        # brightness (img *= fb), contrast ((img - m) fc + m with m the mean
+        # luma), and saturation ((img - gray) fs + gray) are each affine in
+        # (img, gray, 1) and luma is linear, so their composition folds into
+        # ONE pass out = A*img + B*gray0 + C — the host pipeline is CPU-bound
+        # (SURVEY §7 hard part #1) and the naive chain costs 3x the memory
+        # traffic. Factor draws stay in the b, c, s order for seed parity
+        # with the sequential implementation.
+        fb = self._factor(rng, self.brightness) if self.brightness else 1.0
+        fc = self._factor(rng, self.contrast) if self.contrast else 1.0
+        fs = (
+            self._factor(rng, self.saturation)
+            if self.saturation and rgb
+            else 1.0
+        )
+        if fc != 1.0 or fs != 1.0:
+            gray0 = img[..., :3] @ self._LUMA if rgb else img[..., 0]
+            m = fb * float(gray0.mean()) if fc != 1.0 else 0.0
+            a = fb * fc * fs
+            b_coef = (1.0 - fs) * fb * fc
+            c = (1.0 - fc) * m
+            img = a * img + (b_coef * gray0 + c)[..., None]
+        elif fb != 1.0:
+            img = fb * img
+        if self.hue and rgb:
             # hue rotation in YIQ space (cheap, differentiable-free host op)
             theta = float(rng.uniform(-self.hue, self.hue)) * 2 * np.pi
             u, w_ = np.cos(theta), np.sin(theta)
@@ -263,6 +276,34 @@ class Normalize:
 
     def __call__(self, sample: dict, rng) -> dict:
         sample["image"] = (sample["image"] - self.mean) / self.std
+        return sample
+
+
+class ToFloatNormalize:
+    """Fused ToFloat + Normalize: uint8 [0,255] -> (x/255 - mean) / std in
+    ONE pass (x * 1/(255 std) - mean/std). The sequential pair costs two
+    full-image float passes; the host pipeline is CPU-bound (SURVEY §7 hard
+    part #1), so the fusion matters at ImageNet rates. Semantics match
+    `ToFloat(expand_gray_to_rgb=e)` followed by `Normalize(mean, std)`.
+    """
+
+    def __init__(self, mean=IMAGENET_MEAN, std=IMAGENET_STD,
+                 expand_gray_to_rgb: bool = False):
+        std = np.asarray(std, np.float32)
+        mean = np.asarray(mean, np.float32)
+        self._scale_u8 = (1.0 / (255.0 * std)).astype(np.float32)
+        self._scale_f = (1.0 / std).astype(np.float32)
+        self._shift = (mean / std).astype(np.float32)
+        self.expand = expand_gray_to_rgb
+
+    def __call__(self, sample: dict, rng) -> dict:
+        img = sample["image"]
+        if img.ndim == 2:
+            img = img[:, :, None]
+        if self.expand and img.shape[-1] == 1:
+            img = np.repeat(img, 3, axis=-1)
+        scale = self._scale_u8 if img.dtype == np.uint8 else self._scale_f
+        sample["image"] = img * scale - self._shift
         return sample
 
 

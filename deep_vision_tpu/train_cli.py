@@ -128,7 +128,7 @@ def _fake_centernet(cfg: ExperimentConfig, n_batches: int):
 
 def build_dataloaders(cfg: ExperimentConfig, data_dir: str, fake: bool,
                       fake_batches: int, num_workers: int,
-                      preprocessing: str = "torch"):
+                      preprocessing: str = "torch", num_procs: int = 0):
     """Returns (train_fn, eval_fn) thunks yielding batch dicts per epoch.
 
     `preprocessing` selects the ImageNet normalization chain: "torch" is the
@@ -193,11 +193,11 @@ def build_dataloaders(cfg: ExperimentConfig, data_dir: str, fake: bool,
                 T.Rescale(cfg.train_resize), T.RandomHorizontalFlip(),
                 T.RandomCrop(cfg.eval_crop),
                 T.ColorJitter(0.4, 0.4, 0.4),
-                T.ToFloat(expand_gray_to_rgb=True), T.Normalize(),
+                T.ToFloatNormalize(expand_gray_to_rgb=True),
             ])  # transforms.Compose at ResNet/pytorch/train.py:315-331
             eval_tf = Compose([
                 T.Rescale(cfg.train_resize), T.CenterCrop(cfg.eval_crop),
-                T.ToFloat(expand_gray_to_rgb=True), T.Normalize(),
+                T.ToFloatNormalize(expand_gray_to_rgb=True),
             ])
         if cfg.model_kwargs.get("stem") == "s2d":
             # host half of the MLPerf stem trick (models/resnet.py
@@ -210,7 +210,8 @@ def build_dataloaders(cfg: ExperimentConfig, data_dir: str, fake: bool,
                 os.path.join(data_dir, "tfrecord_val", "*"), "imagenet"
             )
             train = DataLoader(train_ds, cfg.batch_size, train_tf, shuffle=True,
-                               shuffle_buffer=10000, num_workers=num_workers)
+                               shuffle_buffer=10000, num_workers=num_workers,
+                               num_procs=num_procs)
         else:
             train_ds = ImageFolderDataset(os.path.join(data_dir, "train_flatten"))
             eval_ds = ImageFolderDataset(os.path.join(data_dir, "val_flatten"))
@@ -252,7 +253,7 @@ def build_dataloaders(cfg: ExperimentConfig, data_dir: str, fake: bool,
         )
         train = DataLoader(train_ds, cfg.batch_size, Compose(train_chain),
                            shuffle=True, num_workers=num_workers,
-                           drop_remainder=True)
+                           num_procs=num_procs, drop_remainder=True)
         evl = DataLoader(eval_ds, cfg.batch_size, Compose(eval_chain),
                          num_workers=num_workers, drop_remainder=True)
         return (lambda: train), (lambda: evl)
@@ -466,7 +467,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--ckpt-dir", default=None)
     parser.add_argument("--epochs", type=int, default=None)
     parser.add_argument("--batch-size", type=int, default=None)
-    parser.add_argument("--num-workers", type=int, default=8)
+    parser.add_argument("--num-workers", type=int, default=8,
+                        help="decode thread pool size")
+    parser.add_argument("--num-procs", type=int, default=0,
+                        help="decode worker PROCESSES (0 = threads only); "
+                             "use ~cores/2 on big hosts to scale JPEG decode "
+                             "past the GIL")
     parser.add_argument("--fake-data", action="store_true")
     parser.add_argument("--fake-batches", type=int, default=4)
     parser.add_argument("--tensorboard-dir", default=None)
@@ -500,7 +506,7 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     train_fn, eval_fn = build_dataloaders(
         cfg, args.data_dir, args.fake_data, args.fake_batches, args.num_workers,
-        preprocessing=args.preprocessing,
+        preprocessing=args.preprocessing, num_procs=args.num_procs,
     )
 
     if cfg.task in ("dcgan", "cyclegan"):

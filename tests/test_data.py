@@ -339,3 +339,110 @@ def test_mean_subtract_tf_variant():
     import pytest as _pytest
     with _pytest.raises(ValueError):
         T.MeanSubtract()({"image": np.zeros((4, 4, 1), np.uint8)}, rng)
+
+
+class TestFusedTransforms:
+    def test_colorjitter_matches_sequential(self):
+        """The single-pass affine fold must equal the sequential b/c/s ops."""
+        import numpy as np
+        from deep_vision_tpu.data import transforms as T
+
+        rng_img = np.random.RandomState(0)
+        img = (rng_img.rand(32, 32, 3) * 255).astype(np.uint8)
+        jit = T.ColorJitter(0.4, 0.4, 0.4)
+        rng = np.random.default_rng(7)
+        out = jit({"image": img.copy()}, rng)["image"]
+
+        # sequential reference with the SAME factor draws
+        rng2 = np.random.default_rng(7)
+        fb = jit._factor(rng2, 0.4)
+        fc = jit._factor(rng2, 0.4)
+        fs = jit._factor(rng2, 0.4)
+        x = img.astype(np.float32) * fb
+        luma = np.array([0.299, 0.587, 0.114], np.float32)
+        m = (x @ luma).mean()
+        x = (x - m) * fc + m
+        g = x @ luma
+        x = (x - g[..., None]) * fs + g[..., None]
+        want = np.clip(x, 0, 255).astype(np.uint8)
+        np.testing.assert_allclose(out.astype(np.int16), want.astype(np.int16),
+                                   atol=1)
+
+    def test_tofloat_normalize_fused_matches_pair(self):
+        import numpy as np
+        from deep_vision_tpu.data import transforms as T
+
+        img = (np.random.RandomState(1).rand(16, 16, 3) * 255).astype(np.uint8)
+        rng = np.random.default_rng(0)
+        fused = T.ToFloatNormalize()({"image": img.copy()}, rng)["image"]
+        pair = T.Normalize()(
+            T.ToFloat()({"image": img.copy()}, rng), rng
+        )["image"]
+        np.testing.assert_allclose(fused, pair, rtol=1e-5, atol=1e-5)
+
+    def test_tofloat_normalize_gray_expand(self):
+        import numpy as np
+        from deep_vision_tpu.data import transforms as T
+
+        img = (np.random.RandomState(2).rand(8, 8) * 255).astype(np.uint8)
+        out = T.ToFloatNormalize(expand_gray_to_rgb=True)(
+            {"image": img}, None
+        )["image"]
+        assert out.shape == (8, 8, 3)
+
+
+class TestProcessLoader:
+    def _records(self, tmp_path, n_shards=4, per_shard=8):
+        import numpy as np
+        from deep_vision_tpu.data.example_codec import encode_example
+        from deep_vision_tpu.data.records import RecordWriter
+
+        rng = np.random.RandomState(0)
+        for s in range(n_shards):
+            with RecordWriter(str(tmp_path / f"train-{s}")) as w:
+                for i in range(per_shard):
+                    w.write(encode_example({
+                        "image/encoded": [b""],
+                        "image/class/label": [int(s * per_shard + i + 1)],
+                    }))
+        return str(tmp_path / "train-*")
+
+    def test_record_dataset_split_disjoint_and_complete(self, tmp_path):
+        from deep_vision_tpu.data import RecordDataset
+
+        pattern = self._records(tmp_path)
+        full = RecordDataset(pattern, schema=lambda f: {
+            "label": f["image/class/label"][0]})
+        parts = [full.split(i, 3) for i in range(3)]
+        all_files = sorted(f for p in parts for f in p.files)
+        assert all_files == sorted(full.files)
+        seen = [s["label"] for p in parts for s in p]
+        assert sorted(seen) == sorted(s["label"] for s in full)
+
+    def test_num_procs_loader_yields_everything(self, tmp_path):
+        from deep_vision_tpu.data import DataLoader, RecordDataset
+
+        pattern = self._records(tmp_path)
+        ds = RecordDataset(pattern, schema=_label_schema)
+        dl = DataLoader(ds, batch_size=4, transform=_add_one,
+                        shuffle=True, shuffle_buffer=8, num_procs=2,
+                        drop_remainder=False)
+        labels = []
+        for batch in dl:
+            labels.extend(batch["label"].tolist())
+        assert sorted(labels) == list(range(2, 34))  # 32 samples, +1 each
+
+    def test_num_procs_requires_splittable(self):
+        from deep_vision_tpu.data import DataLoader
+
+        with pytest.raises(TypeError):
+            DataLoader([{"x": 1}], batch_size=1, num_procs=2)
+
+
+def _label_schema(feats):
+    return {"label": feats["image/class/label"][0]}
+
+
+def _add_one(sample, rng):
+    sample["label"] = sample["label"] + 1
+    return sample
