@@ -1,0 +1,29 @@
+# Cloud training image — the TPU-native analog of the reference's CUDA
+# recipe (Hourglass/tensorflow/Dockerfile:1-19): same shape (deps -> env ->
+# code -> train entrypoint), but built for a Cloud TPU VM, where the TPU
+# runtime comes from the jax[tpu] wheel instead of an nvidia base image.
+#
+#   docker build -t deep-vision-tpu .
+#   docker run --privileged --net=host deep-vision-tpu -m lenet5 --fake-data
+#   docker run --privileged --net=host \
+#       -e UPLOAD_TO=gs://my-bucket/runs deep-vision-tpu -m resnet50 \
+#       --data-dir /data --upload-to gs://my-bucket/runs
+#
+# --privileged/--net=host: required for the container to reach the TPU
+# driver and its gRPC runtime on a Cloud TPU VM.
+FROM python:3.12-slim
+
+ENV LC_ALL=C.UTF-8 \
+    LANG=C.UTF-8 \
+    PYTHONUNBUFFERED=TRUE \
+    PYTHONDONTWRITEBYTECODE=TRUE
+
+RUN pip install --no-cache-dir \
+    "jax[tpu]" -f https://storage.googleapis.com/jax-releases/libtpu_releases.html \
+    flax optax orbax-checkpoint numpy opencv-python-headless
+
+WORKDIR /app
+COPY pyproject.toml train.py ./
+COPY deep_vision_tpu ./deep_vision_tpu
+
+ENTRYPOINT ["python3", "train.py"]

@@ -65,7 +65,10 @@ def _ensure_fixture(n_shards: int = 4, per_shard: int = 256) -> str:
     rng = np.random.RandomState(0)
     for s in range(n_shards):
         path = os.path.join(FIXTURE_DIR, f"train-{s:05d}")
-        with RecordWriter(path) as w:
+        # write-then-rename: a Ctrl-C'd prior run must not leave a truncated
+        # shard that the count-based reuse check above would accept
+        tmp = path + ".tmp"
+        with RecordWriter(tmp) as w:
             for _ in range(per_shard):
                 img = (rng.rand(375, 500, 3) * 60 + 90).astype(np.uint8)
                 img += np.arange(500, dtype=np.uint8)[None, :, None] // 4
@@ -77,6 +80,7 @@ def _ensure_fixture(n_shards: int = 4, per_shard: int = 256) -> str:
                     "image/encoded": [enc.tobytes()],
                     "image/class/label": [int(rng.randint(1, 1001))],
                 }))
+        os.replace(tmp, path)
     return FIXTURE_DIR
 
 

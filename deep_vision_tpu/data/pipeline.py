@@ -59,7 +59,7 @@ def _buffer_shuffle(samples: Iterable[dict], buffer: int,
 def _proc_worker(dataset, transform, epoch_seed, wid, out_q, stop_evt):
     """Worker-process body: stream, transform, and ship samples.
 
-    Runs in a forked child; `dataset` is this worker's disjoint slice.
+    Runs in a spawned child; `dataset` is this worker's disjoint slice.
     Samples cross the process boundary via the queue's pickling — keep
     images uint8 until the last transform to halve that traffic.
     """
@@ -221,6 +221,15 @@ class DataLoader:
                 )
                 p.start()
                 procs.append(p)
+        except BaseException:
+            # a failed start (EAGAIN at high num_procs) must not leak the
+            # already-live workers for the process's lifetime
+            stop.set()
+            for p in procs:
+                p.join(timeout=5)
+                if p.is_alive():
+                    p.terminate()
+            raise
         finally:
             for k, v in saved.items():
                 if v is None:

@@ -1,0 +1,75 @@
+"""Post-training artifact upload: checkpoints -> object store.
+
+The cloud-run hook from the reference's only deployment path
+(Hourglass/tensorflow/main.py:50-65: google.cloud.storage blob upload after
+training, destination echoed to /tmp/output.txt), generalized: `gs://` via
+the google-cloud-storage client when importable else the gsutil CLI,
+`s3://` via the aws CLI, and plain/`file://` paths via filesystem copy (the
+testable local backend). Directories (orbax checkpoint step dirs) are
+uploaded recursively.
+"""
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+from typing import List
+
+
+def _walk(src: str) -> List[str]:
+    if os.path.isfile(src):
+        return [src]
+    out = []
+    for root, _, files in os.walk(src):
+        out.extend(os.path.join(root, f) for f in files)
+    return sorted(out)
+
+
+def _gs_upload(src: str, dest: str) -> None:
+    try:
+        from google.cloud import storage  # type: ignore
+    except ImportError:
+        subprocess.run(["gsutil", "-m", "cp", "-r", src, dest], check=True)
+        return
+    bucket_name, _, prefix = dest[len("gs://"):].partition("/")
+    bucket = storage.Client().bucket(bucket_name)
+    base = os.path.dirname(src.rstrip("/"))
+    for path in _walk(src):
+        blob_name = os.path.join(prefix, os.path.relpath(path, base))
+        bucket.blob(blob_name).upload_from_filename(path)
+
+
+def upload_artifact(src: str, dest: str,
+                    manifest_path: str = "/tmp/output.txt") -> str:
+    """Upload `src` (file or directory) under `dest`; returns the final URI.
+
+    Writes the URI to `manifest_path` the way the reference's trainer does
+    (Hourglass/tensorflow/main.py:63-65), so cluster jobs can hand the model
+    location to the next pipeline stage.
+    """
+    name = os.path.basename(src.rstrip("/"))
+    if dest.startswith("gs://"):
+        _gs_upload(src, dest)
+        uri = f"{dest.rstrip('/')}/{name}"
+    elif dest.startswith("s3://"):
+        subprocess.run(
+            ["aws", "s3", "cp", "--recursive" if os.path.isdir(src) else
+             "--no-progress", src, f"{dest.rstrip('/')}/{name}"],
+            check=True,
+        )
+        uri = f"{dest.rstrip('/')}/{name}"
+    else:
+        target_root = dest[len("file://"):] if dest.startswith("file://") else dest
+        target = os.path.join(target_root, name)
+        os.makedirs(target_root, exist_ok=True)
+        if os.path.isdir(src):
+            shutil.copytree(src, target, dirs_exist_ok=True)
+        else:
+            shutil.copy2(src, target)
+        uri = target
+    try:
+        with open(manifest_path, "w") as f:
+            f.write(uri + "\n")
+    except OSError:
+        pass  # manifest is best-effort (read-only /tmp in some sandboxes)
+    return uri

@@ -215,8 +215,10 @@ def build_dataloaders(cfg: ExperimentConfig, data_dir: str, fake: bool,
         else:
             train_ds = ImageFolderDataset(os.path.join(data_dir, "train_flatten"))
             eval_ds = ImageFolderDataset(os.path.join(data_dir, "val_flatten"))
+            # forwarding num_procs surfaces the folder dataset's lack of
+            # .split as a clear TypeError instead of silently ignoring it
             train = DataLoader(train_ds, cfg.batch_size, train_tf, shuffle=True,
-                               num_workers=num_workers)
+                               num_workers=num_workers, num_procs=num_procs)
         evl = DataLoader(eval_ds, cfg.batch_size, eval_tf, num_workers=num_workers)
         return (lambda: train), (lambda: evl)
 
@@ -491,6 +493,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--summary", action="store_true",
                         help="print the per-parameter model summary table "
                              "(torchsummary analog) before training")
+    parser.add_argument("--upload-to", default=None,
+                        help="after training, upload the checkpoint dir to "
+                             "this destination (gs://, s3://, or a local/"
+                             "file:// path) — the cloud-run hook from "
+                             "Hourglass/tensorflow/main.py:50-65")
     args = parser.parse_args(argv)
 
     cfg = get_config(args.model)
@@ -598,6 +605,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         train_fn, eval_fn, epochs=cfg.epochs, start_epoch=start_epoch,
         eval_first=args.eval_first,
     )
+    if args.upload_to:
+        from deep_vision_tpu.tools.cloud import upload_artifact
+
+        uri = upload_artifact(ckpt_dir, args.upload_to)
+        print(f"uploaded checkpoints to {uri}")
     return 0
 
 

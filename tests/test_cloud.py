@@ -1,0 +1,37 @@
+"""Artifact-upload hook (the Hourglass GCS cloud-run analog), local backend."""
+import os
+
+from deep_vision_tpu.tools.cloud import upload_artifact
+
+
+def test_upload_file_local(tmp_path):
+    src = tmp_path / "model.bin"
+    src.write_bytes(b"weights")
+    dest = tmp_path / "bucket"
+    manifest = tmp_path / "output.txt"
+    uri = upload_artifact(str(src), str(dest), manifest_path=str(manifest))
+    assert open(uri, "rb").read() == b"weights"
+    assert manifest.read_text().strip() == uri
+
+
+def test_upload_directory_recursive(tmp_path):
+    ck = tmp_path / "ck" / "00000010"
+    ck.mkdir(parents=True)
+    (ck / "state.msgpack").write_bytes(b"x" * 10)
+    dest = tmp_path / "store"
+    uri = upload_artifact(str(tmp_path / "ck"), f"file://{dest}",
+                          manifest_path=str(tmp_path / "m.txt"))
+    assert os.path.exists(os.path.join(uri, "00000010", "state.msgpack"))
+
+
+def test_cli_upload_after_training(tmp_path, capsys):
+    from deep_vision_tpu.train_cli import main
+
+    dest = tmp_path / "artifacts"
+    rc = main(["-m", "lenet5", "--fake-data", "--epochs", "1",
+               "--batch-size", "16", "--fake-batches", "2",
+               "--ckpt-dir", str(tmp_path / "ck"),
+               "--upload-to", str(dest)])
+    assert rc == 0
+    assert "uploaded checkpoints to" in capsys.readouterr().out
+    assert os.path.isdir(dest / "ck")
