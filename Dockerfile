@@ -20,7 +20,8 @@ ENV LC_ALL=C.UTF-8 \
 
 RUN pip install --no-cache-dir \
     "jax[tpu]" -f https://storage.googleapis.com/jax-releases/libtpu_releases.html \
-    flax optax orbax-checkpoint numpy opencv-python-headless
+    flax optax orbax-checkpoint numpy opencv-python-headless \
+    google-crc32c google-cloud-storage
 
 WORKDIR /app
 COPY pyproject.toml train.py ./

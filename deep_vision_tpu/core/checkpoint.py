@@ -95,6 +95,23 @@ class CheckpointManager:
                 host_state = json.load(f)
         return state, host_state
 
+    def restore_variables(self, step: Optional[int] = None) -> dict:
+        """Template-free restore of just the model variables.
+
+        Inference/export flows (tools/infer.py, tools/export.py) must not
+        need to reconstruct the exact optimizer + schedule state tree the
+        trainer saved — orbax can restore with the on-disk structure, and
+        only `params`/`batch_stats` are kept. Returns a flax variables dict.
+        """
+        step = step if step is not None else self._mgr.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint in {self.directory!r}")
+        restored = self._mgr.restore(step)
+        out = {"params": restored["params"]}
+        if restored.get("batch_stats"):
+            out["batch_stats"] = restored["batch_stats"]
+        return out
+
     def latest_step(self) -> Optional[int]:
         return self._mgr.latest_step()
 

@@ -259,7 +259,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _flash_backward(q, k, v, out, lse, g, *, causal: bool, scale: float,
-                    block_q: int, block_k: int, interpret: bool):
+                    block_q: int, block_k: int, interpret: bool,
+                    delta_shift=None):
     b, t, h, d = q.shape
     tk = k.shape[1]
     block_q = min(block_q, t)
@@ -270,12 +271,15 @@ def _flash_backward(q, k, v, out, lse, g, *, causal: bool, scale: float,
     dor = g.transpose(0, 2, 1, 3).reshape(b * h, t, d)
     outr = out.transpose(0, 2, 1, 3).reshape(b * h, t, d)
     # delta = rowsum(dO * O): one fused elementwise+reduce pass in XLA,
-    # broadcast across the 128-lane residual layout (see _flash_forward)
-    delta = jnp.broadcast_to(
-        jnp.sum(dor.astype(jnp.float32) * outr.astype(jnp.float32),
-                axis=-1, keepdims=True),
-        (b * h, t, 128),
+    # broadcast across the 128-lane residual layout (see _flash_forward).
+    # `delta_shift` (an lse cotangent, _flash_lse_bwd) subtracts in here.
+    delta_row = jnp.sum(
+        dor.astype(jnp.float32) * outr.astype(jnp.float32),
+        axis=-1, keepdims=True,
     )
+    if delta_shift is not None:
+        delta_row = delta_row - delta_shift[..., None]
+    delta = jnp.broadcast_to(delta_row, (b * h, t, 128))
 
     q_spec = pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0))
     k_spec = pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0))
@@ -353,6 +357,63 @@ def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_lse(q, k, v, causal, scale, block_q, block_k, interpret):
+    return _flash_forward(q, k, v, causal=causal, scale=scale,
+                          block_q=block_q, block_k=block_k,
+                          interpret=interpret, need_lse=True)
+
+
+def _flash_lse_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+    out, lse = _flash_forward(q, k, v, causal=causal, scale=scale,
+                              block_q=block_q, block_k=block_k,
+                              interpret=interpret, need_lse=True)
+    return (out, lse), (q, k, v, out, lse)
+
+
+def _flash_lse_bwd(causal, scale, block_q, block_k, interpret, res, cts):
+    """Backward when BOTH outputs carry cotangents (the ring-attention merge
+    differentiates through lse).
+
+    d lse / d s_j = p_j, so the lse cotangent enters the score gradient as
+    ds += p * g_lse — algebraically a shift of the delta term:
+    ds = p (dp - (delta - g_lse)) scale. The kernels take delta as an input,
+    so the shift needs no kernel change.
+    """
+    q, k, v, out, lse = res
+    g_out, g_lse = cts
+    b, t, h, d = q.shape
+    # cotangent of the 128-lane broadcast = sum over lanes
+    g_lse_row = jnp.sum(g_lse.astype(jnp.float32), axis=-1)  # (BH, T)
+    return _flash_backward(
+        q, k, v, out, lse, g_out, causal=causal, scale=scale,
+        block_q=block_q, block_k=block_k, interpret=interpret,
+        delta_shift=g_lse_row,
+    )
+
+
+_flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
+
+
+def flash_attention_with_lse(
+    q, k, v, *, causal: bool = False, scale: Optional[float] = None,
+    block_q: int = 512, block_k: int = 1024,
+    interpret: Optional[bool] = None,
+):
+    """flash_attention that also returns the per-row logsumexp.
+
+    lse comes back as (B*H, T, 128) f32 with the value broadcast across the
+    lane dim (take `[:, :, 0]`). Differentiable in both outputs — the
+    building block for blockwise merges (parallel/ring_attention.py).
+    """
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    return _flash_lse(q, k, v, causal, float(scale), int(block_q),
+                      int(block_k), bool(interpret))
 
 
 def flash_attention(

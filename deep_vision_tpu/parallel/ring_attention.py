@@ -48,6 +48,88 @@ def _block_attend(q, k, v, scale, mask):
     return o, m, l
 
 
+NEG = -1e30  # "no visible keys" marker: finite, so exp/logaddexp never NaN
+
+
+def _flash_block(q, k_blk, v_blk, scale, causal: bool):
+    """One ring step through the fused Pallas kernel.
+
+    Returns (normalized out (B,T,H,D) f32, lse (B,H,T) f32). Normalized-form
+    merging (out, lse) is algebraically identical to the (numerator, m, l)
+    online softmax: lse' = logaddexp(lse_a, lse_b), out' = sum of outs
+    reweighted by exp(lse - lse').
+    """
+    from deep_vision_tpu.ops.pallas.flash_attention import (
+        flash_attention_with_lse,
+    )
+
+    b, t, h, d = q.shape
+    out, lse = flash_attention_with_lse(
+        q, k_blk.astype(q.dtype), v_blk.astype(q.dtype),
+        causal=causal, scale=scale,
+        block_q=min(512, t), block_k=min(1024, k_blk.shape[1]),
+    )
+    lse = lse[:, :, 0].reshape(b, h, t)
+    return out.astype(jnp.float32), lse
+
+
+def _ring_attention_local_flash(q, k, v, *, axis_name: str, causal: bool,
+                                scale: Optional[float]):
+    """Flash-kernel per-shard body: O(T_loc) memory per ring step.
+
+    The dense body materializes a (T_loc, T_loc) score block per step; with
+    long local shards that is exactly the quadratic buffer ring attention
+    exists to avoid. Here each step runs the fused flash kernel
+    (ops/pallas/flash_attention.py) and merges normalized (out, lse) pairs.
+    """
+    out_dtype = q.dtype
+    q = q.astype(jnp.float32)
+    n = jax.lax.psum(1, axis_name)
+    my = jax.lax.axis_index(axis_name)
+    t_loc = q.shape[1]
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    perm = [(j, (j + 1) % n) for j in range(n)]
+    b, _, h, d = q.shape
+
+    def attend(src, k_blk, v_blk):
+        if not causal:
+            return _flash_block(q, k_blk, v_blk, scale, causal=False)
+        zeros = (
+            jnp.zeros((b, t_loc, h, d), jnp.float32),
+            jnp.full((b, h, t_loc), NEG, jnp.float32),
+        )
+        # src == my: the aligned diagonal block (causal within);
+        # src < my: entirely in the past (full); src > my: invisible
+        return jax.lax.cond(
+            src == my,
+            lambda: _flash_block(q, k_blk, v_blk, scale, causal=True),
+            lambda: jax.lax.cond(
+                src < my,
+                lambda: _flash_block(q, k_blk, v_blk, scale, causal=False),
+                lambda: zeros,
+            ),
+        )
+
+    def step(i, carry):
+        out, lse, k_blk, v_blk = carry
+        src = (my - i) % n
+        out_i, lse_i = attend(src, k_blk, v_blk)
+        lse_new = jnp.logaddexp(lse, lse_i)
+        a = jnp.exp(lse - lse_new).transpose(0, 2, 1)[..., None]
+        b_w = jnp.exp(lse_i - lse_new).transpose(0, 2, 1)[..., None]
+        out = out * a + out_i * b_w
+        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+        return out, lse_new, k_blk, v_blk
+
+    out0 = jnp.zeros((b, t_loc, h, d), jnp.float32)
+    lse0 = jnp.full((b, h, t_loc), NEG, jnp.float32)
+    out0 = jax.lax.pvary(out0, (axis_name,))
+    lse0 = jax.lax.pvary(lse0, (axis_name,))
+    out, _, _, _ = jax.lax.fori_loop(0, n, step, (out0, lse0, k, v))
+    return out.astype(out_dtype)
+
+
 def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool,
                           scale: Optional[float]):
     """Per-shard body (runs under shard_map). q/k/v: (B, T_loc, H, D)."""
@@ -104,18 +186,30 @@ def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool,
 def ring_attention(
     q, k, v, mesh: Mesh, *, causal: bool = False,
     axis_name: str = DATA_AXIS, scale: Optional[float] = None,
+    use_flash: Optional[bool] = None,
 ):
     """Exact attention over a sequence sharded across `axis_name`.
 
     q, k, v: (B, T, H, D) global shapes, T divisible by the axis size.
     Returns (B, T, H, D) with the same sharding.
+
+    `use_flash` routes each ring step through the fused Pallas kernel
+    (O(T_loc) memory instead of a dense (T_loc, T_loc) score block); default
+    None auto-enables it on TPU for long local shards.
     """
+    if use_flash is None:
+        t_loc = q.shape[1] // mesh.shape[axis_name]
+        use_flash = jax.default_backend() == "tpu" and t_loc >= 1024
     spec = P(None, axis_name, None, None)
+    body = _ring_attention_local_flash if use_flash else _ring_attention_local
     fn = functools.partial(
-        _ring_attention_local, axis_name=axis_name, causal=causal, scale=scale
+        body, axis_name=axis_name, causal=causal, scale=scale
     )
     mapped = jax.shard_map(
-        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
+        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        # pallas_call outputs carry no varying-mesh-axes annotation, so the
+        # flash body opts out of the vma check (the dense body keeps it)
+        check_vma=not use_flash,
     )
     return mapped(q, k, v)
 

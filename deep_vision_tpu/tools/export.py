@@ -76,24 +76,12 @@ def export_config(name: str, out_path: str, ckpt_dir: Optional[str] = None,
     variables = model.init(rngs, sample, train=False)
 
     if ckpt_dir:
-        # restore trained params over the freshly-initialized template
+        # template-free restore: export must not reconstruct the trainer's
+        # optimizer/schedule state tree (raises FileNotFoundError when the
+        # dir has no checkpoint — never silently export fresh-init weights)
         from deep_vision_tpu.core.checkpoint import CheckpointManager
-        from deep_vision_tpu.core.train_state import create_train_state
-        from deep_vision_tpu.train.optimizers import build_optimizer
 
-        state = create_train_state(
-            model, build_optimizer("sgd", 0.1), sample
-        )
-        ckpt = CheckpointManager(ckpt_dir)
-        if ckpt.latest_step() is None:
-            raise FileNotFoundError(
-                f"no checkpoint found in {ckpt_dir!r}: refusing to export "
-                "freshly-initialized weights under a -c flag"
-            )
-        state, _ = ckpt.restore(state)
-        variables = {"params": state.params}
-        if state.batch_stats:
-            variables["batch_stats"] = state.batch_stats
+        variables = CheckpointManager(ckpt_dir).restore_variables()
 
     exported = export_model(model, variables, sample)
     save_exported(exported, out_path)

@@ -1,0 +1,220 @@
+"""Image-in, result-out inference CLI for every task family.
+
+The script form of the reference's demo surfaces — per-model notebooks
+(ResNet50.ipynb, demo_mscoco.ipynb, demo_hourglass_pose.ipynb — SURVEY.md §4)
+and the CycleGAN inference script (CycleGAN/tensorflow/inference.py:11-70:
+restore checkpoint, run the generator over a folder, save outputs):
+
+    python -m deep_vision_tpu.tools.infer -m resnet50 -c ck/ img1.jpg img2.jpg
+    python -m deep_vision_tpu.tools.infer -m yolov3_voc -c ck/ street.jpg
+    python -m deep_vision_tpu.tools.infer -m hourglass_mpii -c ck/ person.jpg
+    python -m deep_vision_tpu.tools.infer -m cyclegan -c ck/ photo.jpg -o out/
+
+Classification prints top-5; detection prints NMS'd boxes (and writes a
+..._boxes.txt sidecar); pose prints per-joint (x, y, score); GAN configs run
+the generator and save translated JPEGs next to the inputs (or under -o).
+"""
+from __future__ import annotations
+
+import argparse
+import os
+from typing import List, Optional
+
+import numpy as np
+
+
+def _load_image(path: str, size: int, normalize: str, rescale: int = 0):
+    """Decode + the EXACT eval chain training used (train_cli eval_tf):
+    mismatched normalization silently wrecks predictions, so the chains here
+    mirror build_dataloaders' eval branches per `normalize` mode."""
+    from deep_vision_tpu.data.datasets import decode_image
+    from deep_vision_tpu.data import transforms as T
+
+    with open(path, "rb") as f:
+        img = decode_image(f.read())
+    sample = {"image": img}
+    rng = np.random.default_rng(0)
+    if normalize == "imagenet":  # torch chain (train_cli eval_tf)
+        chain = [T.Rescale(rescale or size + 32), T.CenterCrop(size),
+                 T.ToFloatNormalize(expand_gray_to_rgb=True)]
+    elif normalize == "imagenet_tf":  # the 0-255 mean-subtraction chain
+        chain = [T.Rescale(rescale or size + 32), T.CenterCrop(size),
+                 T.ToFloat(expand_gray_to_rgb=True, scale=False),
+                 T.MeanSubtract()]
+    elif normalize == "unit":  # [0,1]
+        chain = [T.Resize(size), T.ToFloat(expand_gray_to_rgb=True)]
+    else:  # [-1,1] (GANs)
+        chain = [T.Resize(size), T.ToFloat(expand_gray_to_rgb=True),
+                 T.Normalize(mean=[0.5] * 3, std=[0.5] * 3)]
+    for t in chain:
+        sample = t(sample, rng)
+    return sample["image"]
+
+
+def _restore_variables(model, sample, ckpt_dir: Optional[str]):
+    import jax
+    import jax.numpy as jnp
+
+    variables = model.init(
+        {"params": jax.random.PRNGKey(0), "dropout": jax.random.PRNGKey(1)},
+        jnp.asarray(sample), train=False,
+    )
+    if not ckpt_dir:
+        print("warning: no -c checkpoint; running with fresh-init weights")
+        return variables
+    from deep_vision_tpu.core.checkpoint import CheckpointManager
+
+    return CheckpointManager(ckpt_dir).restore_variables()
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    from deep_vision_tpu.configs import CONFIG_REGISTRY, get_config
+
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("-m", "--model", required=True, choices=sorted(CONFIG_REGISTRY))
+    p.add_argument("-c", "--checkpoint", default=None)
+    p.add_argument("-o", "--output-dir", default=None,
+                   help="GAN outputs / detection sidecars go here "
+                        "(default: alongside inputs)")
+    p.add_argument("--score-threshold", type=float, default=0.3)
+    p.add_argument("--preprocessing", default="torch", choices=["torch", "tf"],
+                   help="must match how the checkpoint was trained "
+                        "(train.py --preprocessing)")
+    p.add_argument("images", nargs="+")
+    args = p.parse_args(argv)
+
+    import jax.numpy as jnp
+
+    from deep_vision_tpu.models import get_model
+
+    cfg = get_config(args.model)
+    size = cfg.input_shape[0]
+
+    def outpath(src: str, suffix: str) -> str:
+        base = os.path.basename(src)
+        root, _ = os.path.splitext(base)
+        d = args.output_dir or os.path.dirname(src) or "."
+        os.makedirs(d, exist_ok=True)
+        return os.path.join(d, root + suffix)
+
+    if cfg.task == "classification":
+        if cfg.dataset.get("kind") == "imagenet":
+            mode = "imagenet_tf" if args.preprocessing == "tf" else "imagenet"
+            batch = np.stack([
+                _load_image(f, cfg.eval_crop, mode, rescale=cfg.train_resize)
+                for f in args.images
+            ])
+        else:
+            # small-input configs (mnist-style): resize to the config's
+            # input_shape; collapse to grayscale when it wants one channel
+            batch = np.stack([
+                _load_image(f, size, "unit") for f in args.images
+            ])
+            if cfg.input_shape[2] == 1:
+                luma = np.array([0.299, 0.587, 0.114], np.float32)
+                batch = (batch @ luma)[..., None]
+                batch = (batch - 0.1307) / 0.3081  # the mnist chain's stats
+        if cfg.model_kwargs.get("stem") == "s2d":
+            from deep_vision_tpu.data.transforms import space_to_depth
+
+            batch = np.stack([space_to_depth(im) for im in batch])
+        kwargs = dict(cfg.model_kwargs)
+        model = get_model(cfg.model, num_classes=cfg.num_classes, **kwargs)
+        variables = _restore_variables(model, batch[:1], args.checkpoint)
+        logits = np.asarray(
+            model.apply(variables, jnp.asarray(batch), train=False),
+            np.float32,
+        )
+        probs = np.exp(logits - logits.max(-1, keepdims=True))
+        probs /= probs.sum(-1, keepdims=True)
+        for f, pr in zip(args.images, probs):
+            top = np.argsort(pr)[::-1][:5]
+            picks = " ".join(f"class {i}: {pr[i]:.3f}" for i in top)
+            print(f"{f}: {picks}")
+        return 0
+
+    if cfg.task in ("detection", "centernet"):
+        from deep_vision_tpu.inference import (
+            make_centernet_detector,
+            make_yolo_detector,
+        )
+
+        batch = np.stack([
+            _load_image(f, size, "unit") for f in args.images
+        ])
+        model = get_model(cfg.model, num_classes=cfg.num_classes,
+                          **cfg.model_kwargs)
+        variables = _restore_variables(model, batch[:1], args.checkpoint)
+        detect = (
+            make_yolo_detector(model, score_threshold=args.score_threshold)
+            if cfg.task == "detection"
+            else make_centernet_detector(
+                model, score_threshold=args.score_threshold
+            )
+        )
+        out = {k: np.asarray(v) for k, v in
+               detect(variables, jnp.asarray(batch)).items()}
+        for i, f in enumerate(args.images):
+            n = int(out["num"][i])
+            print(f"{f}: {n} detections")
+            lines = []
+            for j in range(n):
+                b = out["boxes"][i, j]
+                line = (f"  class {int(out['classes'][i, j])} "
+                        f"score {float(out['scores'][i, j]):.3f} "
+                        f"box [{b[0]:.3f} {b[1]:.3f} {b[2]:.3f} {b[3]:.3f}]")
+                print(line)
+                lines.append(line.strip())
+            with open(outpath(f, "_boxes.txt"), "w") as fh:
+                fh.write("\n".join(lines) + "\n")
+        return 0
+
+    if cfg.task == "pose":
+        from deep_vision_tpu.inference import make_pose_estimator
+
+        batch = np.stack([
+            _load_image(f, size, "unit") for f in args.images
+        ])
+        model = get_model(cfg.model, **cfg.model_kwargs)
+        variables = _restore_variables(model, batch[:1], args.checkpoint)
+        estimate = make_pose_estimator(model)
+        kpts = np.asarray(estimate(variables, jnp.asarray(batch)))
+        for f, kp in zip(args.images, kpts):
+            print(f"{f}:")
+            for j, (x, y, s) in enumerate(kp):
+                print(f"  joint {j}: x={x:.3f} y={y:.3f} score={s:.3f}")
+        return 0
+
+    if cfg.task in ("dcgan", "cyclegan"):
+        import cv2
+
+        if cfg.task == "dcgan":
+            model = get_model("dcgan_generator")
+            z = np.random.RandomState(0).randn(len(args.images), 100)
+            variables = _restore_variables(model, z[:1].astype(np.float32),
+                                           args.checkpoint)
+            imgs = np.asarray(model.apply(
+                variables, jnp.asarray(z, jnp.float32), train=False
+            ), np.float32)
+        else:
+            batch = np.stack([
+                _load_image(f, size, "gan") for f in args.images
+            ])
+            model = get_model("cyclegan_generator")
+            variables = _restore_variables(model, batch[:1], args.checkpoint)
+            imgs = np.asarray(
+                model.apply(variables, jnp.asarray(batch), train=False),
+                np.float32,
+            )
+        for f, im in zip(args.images, imgs):
+            u8 = np.clip((im + 1.0) * 127.5, 0, 255).astype(np.uint8)
+            dst = outpath(f, "_generated.jpg")
+            cv2.imwrite(dst, u8[..., ::-1])  # RGB -> BGR for cv2
+            print(f"{f} -> {dst}")
+        return 0
+
+    raise ValueError(f"unsupported task {cfg.task!r}")
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -211,7 +211,24 @@ class Trainer:
                     int(self.state.step), metrics, batch_size=n, epoch=epoch,
                     lr=self.current_lr,
                 )
-            self.logger.end_epoch(epoch)
+            summary = self.logger.end_epoch(epoch)
+            # failure detection the reference has none of (SURVEY §5): a
+            # diverged run must stop loudly, not burn the remaining epochs.
+            # Checked at epoch granularity so the hot loop stays sync-free.
+            loss_avg = summary.get("loss")
+            if loss_avg is not None and not np.isfinite(loss_avg):
+                # leave postmortem artifacts intact: flush the in-flight
+                # async checkpoint and close any open profiler trace first
+                if self.ckpt is not None:
+                    self.ckpt.wait()
+                if self._profiling:
+                    jax.profiler.stop_trace()
+                    self._profiling = False
+                raise FloatingPointError(
+                    f"training diverged: epoch {epoch} mean loss is "
+                    f"{loss_avg} (re-run with train.py --debug-nans to "
+                    "locate the first non-finite op)"
+                )
 
             val_summary = {}
             if eval_data_fn is not None:

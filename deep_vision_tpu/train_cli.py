@@ -493,6 +493,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--summary", action="store_true",
                         help="print the per-parameter model summary table "
                              "(torchsummary analog) before training")
+    parser.add_argument("--debug-nans", action="store_true",
+                        help="jax_debug_nans: re-run the op that produced "
+                             "the first NaN un-jitted and raise there (the "
+                             "sanitizer analog; SURVEY §5 'race detection/"
+                             "sanitizers: NONE' upstream)")
     parser.add_argument("--upload-to", default=None,
                         help="after training, upload the checkpoint dir to "
                              "this destination (gs://, s3://, or a local/"
@@ -500,6 +505,10 @@ def main(argv: Optional[List[str]] = None) -> int:
                              "Hourglass/tensorflow/main.py:50-65")
     args = parser.parse_args(argv)
 
+    if args.debug_nans:
+        import jax as _jax_cfg
+
+        _jax_cfg.config.update("jax_debug_nans", True)
     cfg = get_config(args.model)
     if args.epochs is not None:
         cfg.epochs = args.epochs

@@ -143,3 +143,34 @@ def test_flash_bwd_bf16():
         np.testing.assert_allclose(np.asarray(a, np.float32),
                                    np.asarray(b, np.float32),
                                    rtol=0.1, atol=0.1)
+
+
+def test_flash_with_lse_grads_include_lse_cotangent():
+    """A loss that uses BOTH outputs must differentiate exactly (the ring
+    merge depends on lse; its cotangent shifts the delta term)."""
+    from deep_vision_tpu.ops.pallas.flash_attention import (
+        flash_attention_with_lse,
+    )
+
+    rng = np.random.RandomState(7)
+    q, k, v = (jnp.asarray(rng.randn(1, 32, 2, 8), jnp.float32)
+               for _ in range(3))
+    scale = 8 ** -0.5
+
+    def f_flash(q, k, v):
+        out, lse = flash_attention_with_lse(q, k, v, block_q=16, block_k=16)
+        return jnp.sum(out ** 2) + jnp.sum(lse[:, :, 0] ** 2)
+
+    def f_dense(q, k, v):
+        s = jnp.einsum("bthd,bshd->bhts", q, k) * scale
+        lse = jax.scipy.special.logsumexp(s, axis=-1)  # (B,H,T)
+        out = jnp.einsum("bhts,bshd->bthd", jax.nn.softmax(s, -1), v)
+        return jnp.sum(out ** 2) + jnp.sum(
+            lse.transpose(0, 2, 1).reshape(1, 32, 2).reshape(-1) ** 2
+        )
+
+    g1 = jax.grad(f_flash, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(f_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(g1, g2, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-4, atol=5e-4, err_msg=name)

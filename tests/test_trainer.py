@@ -117,3 +117,19 @@ def test_fit_with_plateau_and_eval(mesh8, tmp_path):
     )
     assert int(trainer.state.step) == 8
     assert len(trainer.eval_logger.history["top1"]) == 3  # eval_first + 2 epochs
+
+
+def test_fit_raises_on_diverged_loss(mesh8):
+    """Failure detection: a NaN epoch must stop the run loudly (SURVEY §5)."""
+    import jax.numpy as jnp
+
+    model = get_model("lenet5", num_classes=4)
+    tx = build_optimizer("sgd", 1e-3)
+    trainer = Trainer(
+        model, tx, classification_loss_fn,
+        sample_input=jnp.zeros((8, 32, 32, 1)), mesh=mesh8,
+    )
+    images, labels = synthetic_mnist(64)
+    images[0] = np.nan  # a poisoned batch: the loss goes non-finite
+    with pytest.raises(FloatingPointError, match="diverged"):
+        trainer.fit(lambda: batches(images, labels, 32), epochs=3)
