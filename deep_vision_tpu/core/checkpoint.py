@@ -22,6 +22,19 @@ import jax
 import orbax.checkpoint as ocp
 
 
+def state_arrays(state) -> dict:
+    """The serializable slice of a TrainState: arrays only, no apply_fn/tx
+    closures. THE single definition — CheckpointManager.save/restore and the
+    GAN trainers all build their trees from it."""
+    return {
+        "step": state.step,
+        "params": state.params,
+        "batch_stats": state.batch_stats,
+        "opt_state": state.opt_state,
+        "rng": state.rng,
+    }
+
+
 class CheckpointManager:
     def __init__(
         self,
@@ -59,14 +72,9 @@ class CheckpointManager:
             if not better:
                 return False
             self._best_value = v
-        saveable = {
-            "step": state.step,
-            "params": state.params,
-            "batch_stats": state.batch_stats,
-            "opt_state": state.opt_state,
-            "rng": state.rng,
-        }
-        saved = self._mgr.save(step, args=ocp.args.StandardSave(saveable))
+        saved = self._mgr.save(
+            step, args=ocp.args.StandardSave(state_arrays(state))
+        )
         if saved and host_state is not None:
             with open(self._sidecar_path(step), "w") as f:
                 json.dump(host_state, f)
@@ -77,13 +85,7 @@ class CheckpointManager:
         step = step if step is not None else self._mgr.latest_step()
         if step is None:
             return state, None
-        template = {
-            "step": state.step,
-            "params": state.params,
-            "batch_stats": state.batch_stats,
-            "opt_state": state.opt_state,
-            "rng": state.rng,
-        }
+        template = state_arrays(state)
         restored = self._mgr.restore(
             step, args=ocp.args.StandardRestore(template)
         )
@@ -94,6 +96,33 @@ class CheckpointManager:
             with open(sidecar) as f:
                 host_state = json.load(f)
         return state, host_state
+
+    def save_tree(self, step: int, tree, host_state: Optional[dict] = None):
+        """Save an arbitrary array pytree (multi-model trainers: the GAN
+        trainers save {'g': ..., 'd': ...} of per-state array dicts — the
+        tf.train.Checkpoint(generator.., discriminator..) analog at
+        CycleGAN/tensorflow/train.py:133-148)."""
+        saved = self._mgr.save(step, args=ocp.args.StandardSave(tree))
+        if saved and host_state is not None:
+            with open(self._sidecar_path(step), "w") as f:
+                json.dump(host_state, f)
+        return saved
+
+    def restore_tree(self, template, step: Optional[int] = None):
+        """Restore a pytree saved by `save_tree` into `template`'s structure;
+        returns (tree, host_state) or (None, None) when nothing is saved."""
+        step = step if step is not None else self._mgr.latest_step()
+        if step is None:
+            return None, None
+        restored = self._mgr.restore(
+            step, args=ocp.args.StandardRestore(template)
+        )
+        host_state = None
+        sidecar = self._sidecar_path(step)
+        if os.path.exists(sidecar):
+            with open(sidecar) as f:
+                host_state = json.load(f)
+        return restored, host_state
 
     def restore_variables(self, step: Optional[int] = None) -> dict:
         """Template-free restore of just the model variables.

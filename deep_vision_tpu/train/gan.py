@@ -61,6 +61,13 @@ class ImagePool:
         return np.stack(out)
 
 
+from deep_vision_tpu.core.checkpoint import state_arrays as _state_arrays
+
+
+def _load_state_arrays(state: TrainState, arrays: dict) -> TrainState:
+    return state.replace(**arrays)
+
+
 def _apply(state: TrainState, x, rng, train=True):
     variables = {"params": state.params}
     mutable = False
@@ -137,6 +144,29 @@ class DcganTrainer:
         out, _ = _apply(self.g_state, noise, jax.random.PRNGKey(0), train=False)
         return out
 
+    # checkpoint/resume: the tf.train.Checkpoint G/D/optimizers capture +
+    # restore-or-initialize pattern (DCGAN/tensorflow/main.py:34-40)
+    def save(self, ckpt, epoch: int) -> None:
+        ckpt.save_tree(
+            epoch,
+            {"g": _state_arrays(self.g_state), "d": _state_arrays(self.d_state)},
+            host_state={"epoch": epoch},
+        )
+
+    def restore(self, ckpt) -> int:
+        """Restore-or-initialize; returns the next epoch to run (0 if fresh)."""
+        template = {
+            "g": _state_arrays(self.g_state), "d": _state_arrays(self.d_state)
+        }
+        restored, host = ckpt.restore_tree(template)
+        if restored is None:
+            return 0
+        self.g_state = _load_state_arrays(self.g_state, restored["g"])
+        self.d_state = _load_state_arrays(self.d_state, restored["d"])
+        # sidecar may be missing (deleted, or a crash between the tree save
+        # and the JSON write): the step index IS the epoch we saved under
+        return int((host or {}).get("epoch", ckpt.latest_step())) + 1
+
 
 class CycleGanTrainer:
     """A<->B translation: G_ab, G_ba, D_a, D_b + two image pools."""
@@ -157,6 +187,30 @@ class CycleGanTrainer:
         self.pool_b = ImagePool(pool_size, seed=2)
         self._g_step = jax.jit(self._g_step_impl, donate_argnums=(0, 1))
         self._d_step = jax.jit(self._d_step_impl, donate_argnums=(0, 1))
+
+    # checkpoint/resume: G_ab/G_ba/D_a/D_b + epoch, saved every N epochs
+    # (CycleGAN/tensorflow/train.py:133-148, 329-333)
+    def save(self, ckpt, epoch: int) -> None:
+        ckpt.save_tree(
+            epoch,
+            {"gab": _state_arrays(self.gab), "gba": _state_arrays(self.gba),
+             "da": _state_arrays(self.da), "db": _state_arrays(self.db)},
+            host_state={"epoch": epoch},
+        )
+
+    def restore(self, ckpt) -> int:
+        template = {
+            "gab": _state_arrays(self.gab), "gba": _state_arrays(self.gba),
+            "da": _state_arrays(self.da), "db": _state_arrays(self.db),
+        }
+        restored, host = ckpt.restore_tree(template)
+        if restored is None:
+            return 0
+        self.gab = _load_state_arrays(self.gab, restored["gab"])
+        self.gba = _load_state_arrays(self.gba, restored["gba"])
+        self.da = _load_state_arrays(self.da, restored["da"])
+        self.db = _load_state_arrays(self.db, restored["db"])
+        return int((host or {}).get("epoch", ckpt.latest_step())) + 1
 
     # generator step: one grad over BOTH generators (train.py:150-205)
     def _g_step_impl(self, gab: TrainState, gba: TrainState, da, db, real_a, real_b):

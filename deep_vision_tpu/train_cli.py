@@ -455,6 +455,15 @@ def run_eval_only(cfg: ExperimentConfig, trainer, eval_fn) -> dict:
     raise ValueError(f"--eval-only unsupported for task {cfg.task!r}")
 
 
+def _maybe_upload(args, ckpt_dir: str) -> None:
+    if not args.upload_to:
+        return
+    from deep_vision_tpu.tools.cloud import upload_artifact
+
+    uri = upload_artifact(ckpt_dir, args.upload_to)
+    print(f"uploaded checkpoints to {uri}")
+
+
 # -- main --------------------------------------------------------------------
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -560,7 +569,26 @@ def main(argv: Optional[List[str]] = None) -> int:
             for k, (mod, sample) in parts.items():
                 print(f"-- {k} --")
                 print(model_summary(mod, sample))
-        for epoch in range(cfg.epochs):
+        # checkpoint/resume: the reference GAN trainers capture G/D/optimizers
+        # + epoch and restore-or-initialize (CycleGAN/tensorflow/train.py:
+        # 133-148; DCGAN/tensorflow/main.py:34-40); CycleGAN saves every 2
+        # epochs (:329-333), DCGAN every epoch with max_to_keep=3 (:40,80-83)
+        from deep_vision_tpu.core import CheckpointManager
+
+        start_epoch = 0
+        gan_save_every = 2 if cfg.task == "cyclegan" else 1
+        ckpt_dir = args.ckpt_dir or os.path.join("checkpoints", cfg.name)
+        if args.checkpoint and args.checkpoint != "auto":
+            ckpt_dir = args.checkpoint
+        gan_ckpt = CheckpointManager(
+            ckpt_dir,
+            max_to_keep=3 if cfg.task == "dcgan" else None,
+        )
+        if args.checkpoint:
+            start_epoch = trainer.restore(gan_ckpt)
+            if start_epoch:
+                print(f"resumed GAN training at epoch {start_epoch}")
+        for epoch in range(start_epoch, cfg.epochs):
             # keep per-step metrics as device arrays; float() only at epoch
             # end so the host never blocks async dispatch mid-epoch
             collected: list = []
@@ -582,6 +610,10 @@ def main(argv: Optional[List[str]] = None) -> int:
                     )
                     for k in keys
                 ))
+            if (epoch + 1) % gan_save_every == 0:
+                trainer.save(gan_ckpt, epoch)
+        gan_ckpt.wait()
+        _maybe_upload(args, ckpt_dir)
         return 0
 
     ckpt_dir = args.ckpt_dir or os.path.join("checkpoints", cfg.name)
@@ -614,11 +646,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         train_fn, eval_fn, epochs=cfg.epochs, start_epoch=start_epoch,
         eval_first=args.eval_first,
     )
-    if args.upload_to:
-        from deep_vision_tpu.tools.cloud import upload_artifact
-
-        uri = upload_artifact(ckpt_dir, args.upload_to)
-        print(f"uploaded checkpoints to {uri}")
+    _maybe_upload(args, ckpt_dir)
     return 0
 
 

@@ -62,3 +62,54 @@ def test_cyclegan_step(mesh8):
         assert np.isfinite(float(m[k])), k
     out = trainer.translate(a[:2])
     assert out.shape == (2, *shape)
+
+
+def test_gan_cli_checkpoint_and_resume(tmp_path, mesh8, capsys):
+    """GAN checkpoint/resume via the CLI: the reference's restore-or-
+    initialize pattern (DCGAN/tensorflow/main.py:34-40)."""
+    from deep_vision_tpu.train_cli import main
+
+    ck = str(tmp_path / "ck")
+    rc = main(["-m", "dcgan_mnist", "--fake-data", "--epochs", "1",
+               "--batch-size", "8", "--fake-batches", "1",
+               "--ckpt-dir", ck])
+    assert rc == 0
+    rc = main(["-m", "dcgan_mnist", "--fake-data", "--epochs", "2",
+               "--batch-size", "8", "--fake-batches", "1",
+               "--ckpt-dir", ck, "-c", "auto"])
+    assert rc == 0
+    assert "resumed GAN training at epoch 1" in capsys.readouterr().out
+
+
+def test_cyclegan_trainer_save_restore_roundtrip(tmp_path, mesh8):
+    import numpy as np
+    from deep_vision_tpu.core import CheckpointManager
+    from deep_vision_tpu.models import get_model
+    from deep_vision_tpu.train.gan import CycleGanTrainer
+    from deep_vision_tpu.train.optimizers import build_optimizer
+
+    tx_fn = lambda: build_optimizer("adam", 2e-4, b1=0.5)
+    mk = lambda: CycleGanTrainer(
+        get_model("cyclegan_generator"), get_model("cyclegan_generator"),
+        get_model("cyclegan_discriminator"), get_model("cyclegan_discriminator"),
+        tx_fn, tx_fn, image_shape=(64, 64, 3), mesh=mesh8,
+    )
+    t1 = mk()
+    rng = np.random.RandomState(0)
+    a = rng.rand(8, 64, 64, 3).astype(np.float32) * 2 - 1
+    b = rng.rand(8, 64, 64, 3).astype(np.float32) * 2 - 1
+    t1.train_step(a, b)
+    ck = CheckpointManager(str(tmp_path / "ck"))
+    t1.save(ck, epoch=0)
+    ck.wait()
+
+    t2 = mk()
+    next_epoch = t2.restore(ck)
+    assert next_epoch == 1
+    import jax
+
+    p1 = jax.tree_util.tree_leaves(t1.gab.params)
+    p2 = jax.tree_util.tree_leaves(t2.gab.params)
+    for x, y in zip(p1, p2):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+    assert int(t2.gab.step) == int(t1.gab.step)
