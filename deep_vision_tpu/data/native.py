@@ -15,7 +15,6 @@ from typing import Iterator, List, Optional, Sequence
 _OK, _EOF, _CORRUPT, _IOERR, _TRUNCATED = 0, 1, 2, 3, 4
 
 _lib: Optional[ctypes.CDLL] = None
-_lib_tried = False
 
 
 def _repo_lib_path() -> str:
@@ -26,12 +25,11 @@ def _repo_lib_path() -> str:
 
 def load_library(path: Optional[str] = None) -> Optional[ctypes.CDLL]:
     """Load libdvtpu.so (env DVTPU_NATIVE_LIB > repo native/). None if absent."""
-    global _lib, _lib_tried
+    # only success is cached: the library may be built after the first probe
+    # (the test fixture does exactly that), so a miss re-stats each call
+    global _lib
     if _lib is not None:
         return _lib
-    if _lib_tried and path is None:
-        return None
-    _lib_tried = True
     candidates = (
         [path] if path else
         [os.environ.get("DVTPU_NATIVE_LIB", ""), _repo_lib_path()]

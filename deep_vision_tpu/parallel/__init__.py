@@ -6,3 +6,13 @@ from deep_vision_tpu.parallel.mesh import (
     shard_batch,
     local_mesh_devices,
 )
+from deep_vision_tpu.parallel.moe import (
+    expert_param_sharding,
+    moe_ffn,
+    moe_ffn_dense,
+)
+from deep_vision_tpu.parallel.pipeline import (
+    pipeline_apply,
+    pipeline_param_sharding,
+    stack_pipeline_params,
+)

@@ -1,0 +1,159 @@
+"""Expert parallelism: mixture-of-experts FFN with all_to_all dispatch.
+
+The reference is a dense CNN zoo with no conditional computation (SURVEY.md
+§2), but expert parallelism is part of this framework's first-class
+distributed story (DP x TP x PP x SP x EP) — vision MoEs (V-MoE) scale
+exactly this way. Design is the GShard/Switch einsum formulation, which is
+the TPU-native one: routing becomes two dense einsums against a one-hot
+dispatch tensor (MXU work, static shapes, no gather/scatter), and the only
+communication is a pair of `jax.lax.all_to_all` collectives that ride ICI —
+tokens travel to the devices holding their expert and back.
+
+Layout: tokens sharded over `axis_name` (each device routes its local
+tokens), experts sharded over the same axis (each device owns E/n experts).
+Capacity is static (TPU shapes must be): each expert accepts at most C
+tokens per device per step; overflow tokens fall through the residual
+connection untouched — the standard Switch-Transformer semantics.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from deep_vision_tpu.parallel.mesh import DATA_AXIS
+
+
+def expert_ffn(params, x):
+    """Default expert: 2-layer GELU MLP. params: {'w1','b1','w2','b2'}."""
+    h = jax.nn.gelu(x @ params["w1"] + params["b1"])
+    return h @ params["w2"] + params["b2"]
+
+
+def _top1_dispatch(gates, capacity: int):
+    """Switch top-1 routing -> (dispatch, combine) tensors.
+
+    gates: (T, E) softmax router outputs.
+    dispatch: (T, E, C) one-hot — token t occupies slot c of expert e.
+    combine:  (T, E, C) = dispatch * gate prob (the output mixing weights).
+    Tokens beyond an expert's capacity get an all-zero dispatch row.
+    """
+    t, e = gates.shape
+    expert = jnp.argmax(gates, axis=-1)  # (T,)
+    onehot = jax.nn.one_hot(expert, e, dtype=gates.dtype)  # (T, E)
+    # position of each token within its expert's queue (0-based, in token
+    # order — the deterministic tie-break the einsum formulation gives)
+    pos = jnp.cumsum(onehot, axis=0) - onehot  # (T, E)
+    keep = onehot * (pos < capacity)  # drop overflow
+    slot = jax.nn.one_hot(
+        jnp.sum(pos * onehot, axis=-1).astype(jnp.int32), capacity,
+        dtype=gates.dtype,
+    )  # (T, C)
+    dispatch = keep[:, :, None] * slot[:, None, :]  # (T, E, C)
+    prob = jnp.sum(gates * onehot, axis=-1)  # (T,) chosen-expert prob
+    combine = dispatch * prob[:, None, None]
+    return dispatch, combine
+
+
+def _moe_local(router_w, expert_params, x, *, axis_name: str, capacity: int,
+               expert_fn: Callable, n_experts: int):
+    """Per-device body (under shard_map). x: (T_loc, D) local tokens."""
+    n = jax.lax.psum(1, axis_name)
+    e_loc = n_experts // n
+    gates = jax.nn.softmax(x @ router_w)  # (T_loc, E) — router replicated
+    dispatch, combine = _top1_dispatch(gates, capacity)
+    # pack: (E, C, D) expert inputs from the local tokens
+    expert_in = jnp.einsum("tec,td->ecd", dispatch, x)
+    # all_to_all #1: split the global-expert dim across devices, concat the
+    # senders -> (E_loc, n, C, D): every device's slots for MY experts
+    expert_in = expert_in.reshape(n, e_loc, capacity, -1)
+    expert_in = jax.lax.all_to_all(
+        expert_in, axis_name, split_axis=0, concat_axis=0, tiled=False
+    )  # (n, E_loc, C, D) with leading dim = source device
+    expert_in = expert_in.transpose(1, 0, 2, 3).reshape(
+        e_loc, n * capacity, -1
+    )
+    # local experts run on their (n*C, D) batch — vmap over the expert dim,
+    # each expert its own params slice
+    expert_out = jax.vmap(expert_fn)(expert_params, expert_in)
+    # all_to_all #2: route results back to the token-owning devices
+    expert_out = expert_out.reshape(e_loc, n, capacity, -1).transpose(
+        1, 0, 2, 3
+    )
+    expert_out = jax.lax.all_to_all(
+        expert_out, axis_name, split_axis=0, concat_axis=0, tiled=False
+    ).reshape(n_experts, capacity, -1)
+    # unpack + mix; dropped tokens contribute 0 (pure residual pass-through)
+    return jnp.einsum("tec,ecd->td", combine, expert_out)
+
+
+def moe_ffn(
+    router_w,
+    expert_params,
+    x,
+    mesh: Mesh,
+    *,
+    capacity: int,
+    expert_fn: Callable = expert_ffn,
+    axis_name: str = DATA_AXIS,
+):
+    """Expert-parallel top-1 MoE layer over tokens sharded on `axis_name`.
+
+    router_w: (D, E) routing weights (replicated).
+    expert_params: pytree whose leaves have leading dim E, sharded over
+    `axis_name` (device i holds experts [i*E/n, (i+1)*E/n)).
+    x: (T, D) global tokens, T divisible by the axis size.
+    capacity: per-expert, per-device slot count C. The output adds to a
+    residual stream: dropped (over-capacity) tokens return zeros.
+    """
+    n = mesh.shape[axis_name]
+    e = router_w.shape[-1]
+    if e % n != 0:
+        raise ValueError(f"{e} experts not divisible over {n} devices")
+    body = functools.partial(
+        _moe_local,
+        axis_name=axis_name,
+        capacity=capacity,
+        expert_fn=expert_fn,
+        n_experts=e,
+    )
+    expert_specs = jax.tree_util.tree_map(
+        lambda p: P(axis_name, *([None] * (p.ndim - 1))), expert_params
+    )
+    mapped = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P(), expert_specs, P(axis_name)),
+        out_specs=P(axis_name),
+    )
+    return mapped(router_w, expert_params, x)
+
+
+def expert_param_sharding(mesh: Mesh, expert_params,
+                          axis_name: str = DATA_AXIS):
+    """Shard the leading (expert) dim of every leaf over `axis_name`."""
+    return jax.tree_util.tree_map(
+        lambda p: NamedSharding(mesh, P(axis_name, *([None] * (p.ndim - 1)))),
+        expert_params,
+    )
+
+
+def moe_ffn_dense(router_w, expert_params, x, *,
+                  expert_fn: Callable = expert_ffn):
+    """Single-device reference: every expert on all tokens (golden for tests).
+
+    No capacity limit — equals `moe_ffn` exactly when capacity >= the
+    busiest expert's per-device load.
+    """
+    gates = jax.nn.softmax(x @ router_w)  # (T, E)
+    choice = jnp.argmax(gates, axis=-1)
+    prob = jnp.take_along_axis(gates, choice[:, None], axis=-1)
+    all_out = jax.vmap(expert_fn, in_axes=(0, None))(expert_params, x)
+    # (E, T, D) -> pick each token's expert
+    picked = jnp.take_along_axis(
+        all_out, choice[None, :, None], axis=0
+    )[0]
+    return picked * prob

@@ -636,7 +636,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     start_epoch = 0
     if args.checkpoint:
         if args.checkpoint != "auto":
-            trainer.ckpt = type(trainer.ckpt)(args.checkpoint)
+            # saves (and the end-of-run upload) follow the resume dir
+            ckpt_dir = args.checkpoint
+            trainer.ckpt = type(trainer.ckpt)(ckpt_dir)
         start_epoch = trainer.resume()
         print(f"resumed from step {int(trainer.state.step)} -> epoch {start_epoch}")
     if args.eval_only:

@@ -1,0 +1,101 @@
+"""One-off ablation harness for the bench train step (not part of the API).
+
+Times variants of the ResNet-50 bench step on the real chip to locate the
+remaining gap to the 2610 img/s/chip target: batch scaling, forward-only,
+grad-without-update, bf16 master params.
+"""
+from __future__ import annotations
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def make_step(batch_size, *, mode="full", param_dtype=jnp.float32):
+    from deep_vision_tpu.core.train_state import create_train_state
+    from deep_vision_tpu.losses.classification import classification_loss_fn
+    from deep_vision_tpu.models import get_model
+    from deep_vision_tpu.parallel.mesh import create_mesh, data_sharding, replicated
+    from deep_vision_tpu.train.optimizers import build_optimizer
+
+    mesh = create_mesh()
+    model = get_model("resnet50", num_classes=1000, dtype=jnp.bfloat16, stem="s2d")
+    tx = build_optimizer("sgd", learning_rate=0.1, momentum=0.9, weight_decay=1e-4)
+    sample = jnp.ones((8, 112, 112, 12), jnp.float32)
+    state = create_train_state(model, tx, sample)
+    if param_dtype != jnp.float32:
+        state = state.replace(
+            params=jax.tree_util.tree_map(lambda p: p.astype(param_dtype), state.params)
+        )
+    state = jax.device_put(state, replicated(mesh))
+    rng = np.random.RandomState(0)
+    batch = {
+        "image": rng.rand(batch_size, 112, 112, 12).astype(np.float32).astype(jnp.bfloat16),
+        "label": rng.randint(0, 1000, size=(batch_size,)).astype(np.int32),
+    }
+    batch = {k: jax.device_put(v, data_sharding(mesh, v.ndim)) for k, v in batch.items()}
+
+    def loss_fn(params, state, batch):
+        variables = {"params": params, "batch_stats": state.batch_stats}
+        outputs, new_model_state = state.apply_fn(
+            variables, batch["image"], train=True,
+            rngs={"dropout": jax.random.fold_in(state.rng, state.step)},
+            mutable=["batch_stats"],
+        )
+        loss, _ = classification_loss_fn(outputs, batch)
+        return loss, new_model_state["batch_stats"]
+
+    if mode == "fwd":
+        def step(state, batch):
+            loss, _ = loss_fn(state.params, state, batch)
+            return state, loss
+    elif mode == "grad":
+        def step(state, batch):
+            (loss, _), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                state.params, state, batch)
+            # fold grads into loss so nothing is dead code
+            return state, loss + jax.tree_util.tree_reduce(
+                lambda a, g: a + jnp.sum(g) * 0.0, grads, 0.0)
+    else:
+        def step(state, batch):
+            (loss, new_bs), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                state.params, state, batch)
+            return state.apply_gradients(grads).replace(batch_stats=new_bs), loss
+
+    return jax.jit(step, donate_argnums=0), state, batch
+
+
+def time_variant(name, batch_size, **kw):
+    step, state, batch = make_step(batch_size, **kw)
+    t0 = time.perf_counter()
+    for _ in range(5):
+        state, loss = step(state, batch)
+    float(loss)
+    warm = time.perf_counter() - t0
+    dts = []
+    for _ in range(2):
+        t0 = time.perf_counter()
+        for _ in range(15):
+            state, loss = step(state, batch)
+        float(loss)
+        dts.append((time.perf_counter() - t0) / 15)
+    ms = min(dts) * 1e3
+    print(f"{name}: {ms:.1f} ms/step  {batch_size / min(dts):.0f} img/s  "
+          f"(warmup {warm:.0f}s)", flush=True)
+
+
+if __name__ == "__main__":
+    which = sys.argv[1:] or ["full256", "full512", "fwd256", "grad256", "bf16_512"]
+    if "full256" in which:
+        time_variant("full  b256", 256)
+    if "full512" in which:
+        time_variant("full  b512", 512)
+    if "fwd256" in which:
+        time_variant("fwd   b256", 256, mode="fwd")
+    if "grad256" in which:
+        time_variant("grad  b256", 256, mode="grad")
+    if "bf16_512" in which:
+        time_variant("bf16p b512", 512, param_dtype=jnp.bfloat16)
