@@ -209,3 +209,21 @@ register_config(ExperimentConfig(
     schedule={"kind": "linear_decay", "hold_epochs": 100, "total_epochs": 200},
     dataset={"kind": "records", "schema": "image_only"},
 ))
+
+# -- attention family (net-new; no reference counterpart) -------------------
+
+for _name, _model, _mkw in (
+    ("vit_s16", "vit_s16", {}),
+    ("vmoe_s16", "vmoe_s16", {}),
+):
+    # AdamW recipe (ViT paper, app. B.1 scaled to single-host): decoupled
+    # weight decay, linear warmup + cosine decay via the schedule registry
+    register_config(ExperimentConfig(
+        name=_name, task="classification", model=_model,
+        model_kwargs=_mkw, batch_size=256, epochs=90,
+        optimizer={"name": "adamw", "learning_rate": 1e-3,
+                   "weight_decay": 1e-4},
+        schedule={"kind": "cosine", "warmup_epochs": 5,
+                  "total_epochs": 90},
+        dataset={"kind": "imagenet"},
+    ))

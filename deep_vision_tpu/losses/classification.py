@@ -36,10 +36,15 @@ def classification_loss_fn(
     batch,
     aux_weight: float = 0.3,
     label_smoothing: float = 0.0,
+    penalty_weight: float = 0.01,
 ):
     """loss + metrics from model outputs (logits or (logits, *aux)) + batch.
 
     batch: {'image': ..., 'label': int (B,)}.
+    Aux entries may be logits tensors (Inception heads: weighted CE at
+    `aux_weight`) or dicts of named scalar penalties (e.g. the ViT-MoE
+    Switch load-balancing loss, key 'moe_aux': added at `penalty_weight`
+    and surfaced as a metric).
     """
     labels = batch["label"]
     weights = batch.get("_mask")
@@ -49,11 +54,18 @@ def classification_loss_fn(
     else:
         logits = outputs
     loss = cross_entropy_loss(logits, labels, label_smoothing, weights)
+    metrics = {}
     for aux in aux_logits:
-        if aux is not None:
+        if aux is None:
+            continue
+        if isinstance(aux, dict):
+            for name, value in aux.items():
+                loss = loss + penalty_weight * value
+                metrics[name] = value
+        else:
             loss = loss + aux_weight * cross_entropy_loss(
                 aux, labels, label_smoothing, weights
             )
-    metrics = {"loss": loss}
+    metrics["loss"] = loss
     metrics.update(topk_accuracy(logits, labels, weights=weights))
     return loss, metrics

@@ -1,0 +1,239 @@
+"""Vision Transformer (+ V-MoE variant): the framework's attention flagship.
+
+Net-new beyond the reference (its zoo is all-CNN, SURVEY.md §2.9): an
+attention-based image classifier is what the framework's long-context and
+expert-parallel machinery exists for, so the zoo ships one. Architecture
+follows ViT (Dosovitskiy 2020) with the TPU-friendly choices:
+
+- patchify as a single stride-P conv (one big MXU matmul, no gather);
+- token global-average pooling instead of a class token (keeps the sequence
+  length a power-of-two-ish multiple of 8/128 tiling at common resolutions
+  and sidesteps concat-of-one ragged shapes);
+- attention auto-routes to the fused Pallas flash kernel
+  (`ops/pallas/flash_attention.py`) when the sequence is long enough to
+  matter and runs the exact dense einsum otherwise — high-res inputs get
+  O(T) memory, 224px inputs get zero kernel-launch overhead;
+- pre-norm blocks, GELU MLP, bf16-friendly: LayerNorm statistics in f32,
+  params f32, activations in the module dtype.
+
+The V-MoE variant (Riquelme 2021) swaps every other MLP for a top-1
+(Switch) mixture-of-experts whose expert params are STACKED on a leading
+expert axis — exactly the layout `parallel.moe.expert_param_sharding`
+shards for expert-parallel training and `parallel.moe.moe_ffn` consumes
+under shard_map. Inside the module the routing runs the dense einsum
+formulation (`moe_ffn_dense` semantics, no capacity drops: exact, and the
+right thing on a single chip); the router's gates feed the Switch
+load-balancing aux loss, returned as an aux output in train mode like
+Inception V1's aux heads (losses/classification.py handles the plumbing).
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from deep_vision_tpu.models import register_model
+from deep_vision_tpu.parallel.moe import load_balancing_loss
+
+# below this many tokens the dense einsum beats the flash kernel (and the
+# kernel's 128-lane tiling would need padding anyway)
+FLASH_MIN_TOKENS = 1024
+
+
+class Attention(nn.Module):
+    num_heads: int
+    dtype: Optional[jnp.dtype] = None
+
+    @nn.compact
+    def __call__(self, x):
+        b, t, d = x.shape
+        h = self.num_heads
+        assert d % h == 0, f"dim {d} not divisible by {h} heads"
+        qkv = nn.DenseGeneral((3, h, d // h), dtype=self.dtype,
+                              name="qkv")(x)
+        q, k, v = (qkv[:, :, i] for i in range(3))  # (B, T, H, Dh)
+        use_flash = (
+            jax.default_backend() == "tpu"
+            and t >= FLASH_MIN_TOKENS
+            and t % 128 == 0
+        )
+        if use_flash:
+            from deep_vision_tpu.ops.pallas.flash_attention import (
+                flash_attention,
+            )
+
+            o = flash_attention(q, k, v)
+        else:
+            scale = (d // h) ** -0.5
+            s = jnp.einsum("bthd,bshd->bhts", q, k) * scale
+            p = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(q.dtype)
+            o = jnp.einsum("bhts,bshd->bthd", p, v)
+        return nn.DenseGeneral(d, axis=(-2, -1), dtype=self.dtype,
+                               name="out")(o)
+
+
+class Mlp(nn.Module):
+    hidden: int
+    dtype: Optional[jnp.dtype] = None
+
+    @nn.compact
+    def __call__(self, x):
+        d = x.shape[-1]
+        x = nn.Dense(self.hidden, dtype=self.dtype)(x)
+        x = nn.gelu(x)
+        return nn.Dense(d, dtype=self.dtype)(x)
+
+
+class MoeMlp(nn.Module):
+    """Top-1 Switch MoE MLP; expert params stacked on a leading E axis.
+
+    Returns (out, gates) — gates (B*T, E) feed the load-balancing loss.
+    Expert weights use the (E, d_in, d_out) layout of `parallel.moe`, so
+    `expert_param_sharding` / `moe_ffn` apply unchanged for expert-parallel
+    training across a mesh axis.
+    """
+
+    num_experts: int
+    hidden: int
+    dtype: Optional[jnp.dtype] = None
+
+    @nn.compact
+    def __call__(self, x):
+        b, t, d = x.shape
+        e, h = self.num_experts, self.hidden
+        tok = x.reshape(b * t, d)
+        router = self.param(
+            "router", nn.initializers.lecun_normal(), (d, e), jnp.float32
+        )
+        w1 = self.param(
+            "w1", nn.initializers.lecun_normal(), (e, d, h), jnp.float32
+        )
+        b1 = self.param("b1", nn.initializers.zeros, (e, h), jnp.float32)
+        w2 = self.param(
+            "w2", nn.initializers.lecun_normal(), (e, h, d), jnp.float32
+        )
+        b2 = self.param("b2", nn.initializers.zeros, (e, d), jnp.float32)
+        dt = self.dtype or x.dtype
+        # router in f32 (softmax over logits is precision-sensitive)
+        gates = jax.nn.softmax(tok.astype(jnp.float32) @ router)
+        choice = jnp.argmax(gates, axis=-1)
+        prob = jnp.take_along_axis(gates, choice[:, None], axis=-1)
+        # dense dispatch: one-hot einsum packs each token's chosen expert
+        # contribution; E small (<=16) so compute is E x the MLP, all MXU
+        onehot = jax.nn.one_hot(choice, e, dtype=dt)
+        hmid = jax.nn.gelu(
+            jnp.einsum("te,td,edh->teh", onehot, tok.astype(dt),
+                       w1.astype(dt)) + b1.astype(dt)
+        )
+        # onehot on BOTH sides: hmid rows of unselected experts are
+        # gelu(0 + b1[e]) != 0 once b1 trains, and must not leak into the
+        # output sum (top-1 Switch semantics == parallel/moe.moe_ffn_dense)
+        out = jnp.einsum(
+            "te,teh,ehd->td", onehot, hmid, w2.astype(dt)
+        ) + jnp.einsum("te,ed->td", onehot, b2.astype(dt))
+        out = out * prob.astype(dt)
+        return out.reshape(b, t, d), gates
+
+
+class ViTBlock(nn.Module):
+    num_heads: int
+    mlp_ratio: int = 4
+    num_experts: int = 0  # 0 = dense MLP
+    dtype: Optional[jnp.dtype] = None
+
+    @nn.compact
+    def __call__(self, x):
+        d = x.shape[-1]
+        y = nn.LayerNorm(dtype=jnp.float32)(x).astype(x.dtype)
+        x = x + Attention(self.num_heads, dtype=self.dtype)(y)
+        y = nn.LayerNorm(dtype=jnp.float32)(x).astype(x.dtype)
+        gates = None
+        if self.num_experts:
+            y, gates = MoeMlp(
+                self.num_experts, d * self.mlp_ratio, dtype=self.dtype
+            )(y)
+        else:
+            y = Mlp(d * self.mlp_ratio, dtype=self.dtype)(y)
+        return x + y, gates
+
+
+class ViT(nn.Module):
+    """ViT classifier. Input NHWC; output logits (f32)."""
+
+    depth: int = 12
+    dim: int = 384
+    num_heads: int = 6
+    patch: int = 16
+    num_classes: int = 1000
+    mlp_ratio: int = 4
+    num_experts: int = 0  # >0: MoE every other block (V-MoE "last-2"-ish)
+    moe_every: int = 2
+    dropout: float = 0.0
+    dtype: Optional[jnp.dtype] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        b, hh, ww, _ = x.shape
+        p = self.patch
+        assert hh % p == 0 and ww % p == 0, (
+            f"image {hh}x{ww} not divisible by patch {p}"
+        )
+        dt = self.dtype or x.dtype
+        x = nn.Conv(
+            self.dim, (p, p), strides=(p, p), padding="VALID", dtype=dt,
+            name="patch_embed",
+        )(x.astype(dt))
+        x = x.reshape(b, -1, self.dim)  # (B, T, D)
+        t = x.shape[1]
+        pos = self.param(
+            "pos_embed", nn.initializers.normal(0.02), (1, t, self.dim),
+            jnp.float32,
+        )
+        x = x + pos.astype(dt)
+        if self.dropout:
+            x = nn.Dropout(self.dropout, deterministic=not train)(x)
+        all_gates = []
+        for i in range(self.depth):
+            moe = (
+                self.num_experts
+                if self.num_experts
+                and (i % self.moe_every == self.moe_every - 1)
+                else 0
+            )
+            x, gates = ViTBlock(
+                self.num_heads, self.mlp_ratio, num_experts=moe,
+                dtype=self.dtype,
+            )(x)
+            if gates is not None:
+                all_gates.append(gates)
+        x = nn.LayerNorm(dtype=jnp.float32)(x.astype(jnp.float32))
+        x = jnp.mean(x, axis=1)  # token-mean pool
+        logits = nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+        if train and all_gates:
+            # Switch aux loss per MoE block, averaged; the classification
+            # loss adds `moe_aux_weight * aux` (losses/classification.py)
+            aux = jnp.mean(
+                jnp.stack([load_balancing_loss(g) for g in all_gates])
+            )
+            return logits, {"moe_aux": aux}
+        return logits
+
+
+@register_model("vit_s16")
+def vit_s16(num_classes: int = 1000, dtype=None, **_):
+    return ViT(depth=12, dim=384, num_heads=6, num_classes=num_classes,
+               dtype=dtype)
+
+
+@register_model("vit_b16")
+def vit_b16(num_classes: int = 1000, dtype=None, **_):
+    return ViT(depth=12, dim=768, num_heads=12, num_classes=num_classes,
+               dtype=dtype)
+
+
+@register_model("vmoe_s16")
+def vmoe_s16(num_classes: int = 1000, dtype=None, num_experts: int = 8, **_):
+    return ViT(depth=12, dim=384, num_heads=6, num_classes=num_classes,
+               num_experts=num_experts, dtype=dtype)

@@ -141,6 +141,23 @@ def expert_param_sharding(mesh: Mesh, expert_params,
     )
 
 
+def load_balancing_loss(gates) -> jax.Array:
+    """Switch-Transformer auxiliary loss: E * sum_e f_e * P_e.
+
+    gates: (T, E) softmax router outputs. f_e is the fraction of tokens
+    whose argmax picks expert e, P_e the mean router probability for e;
+    minimized (== 1) when routing is uniform. Add `aux_weight *
+    load_balancing_loss(gates)` to the task loss when training a router —
+    without it top-1 routing collapses onto a few experts and the rest of
+    the capacity (and the all_to_all bandwidth) idles.
+    """
+    t, e = gates.shape
+    choice = jnp.argmax(gates, axis=-1)
+    f = jnp.mean(jax.nn.one_hot(choice, e, dtype=gates.dtype), axis=0)
+    p = jnp.mean(gates, axis=0)
+    return e * jnp.sum(f * p)
+
+
 def moe_ffn_dense(router_w, expert_params, x, *,
                   expert_fn: Callable = expert_ffn):
     """Single-device reference: every expert on all tokens (golden for tests).

@@ -29,8 +29,6 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from deep_vision_tpu.parallel.mesh import MODEL_AXIS
 
-PIPE_AXIS = "pipe"
-
 
 def stack_pipeline_params(params_list):
     """Stack S per-stage param pytrees on a new leading stage axis.

@@ -287,6 +287,8 @@ def _build_schedule(cfg: ExperimentConfig, steps_per_epoch: int):
         kw["total_steps"] = kw.pop("total_epochs") * steps_per_epoch
     if "hold_epochs" in kw:
         kw["hold_steps"] = kw.pop("hold_epochs") * steps_per_epoch
+    if "warmup_epochs" in kw:
+        kw["warmup_steps"] = kw.pop("warmup_epochs") * steps_per_epoch
     return make_schedule(kind, base_lr, **kw)
 
 

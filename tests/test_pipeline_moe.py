@@ -147,6 +147,23 @@ class TestMoe:
         # (routed rows through a 2-layer MLP with bias 0 are ~never exactly 0)
         assert (np.abs(arr).sum(axis=-1) == 0).any()
 
+    def test_load_balancing_loss_uniform_is_one(self):
+        from deep_vision_tpu.parallel.moe import load_balancing_loss
+
+        e = 4
+        # perfectly uniform routing: every expert equally probable AND
+        # equally chosen -> loss hits its minimum of exactly 1
+        gates = jnp.tile(jnp.full((1, e), 1.0 / e), (8, 1))
+        # break argmax ties deterministically across experts
+        gates = gates + jnp.eye(e)[jnp.arange(8) % e] * 1e-6
+        gates = gates / gates.sum(-1, keepdims=True)
+        assert abs(float(load_balancing_loss(gates)) - 1.0) < 1e-4
+        # collapsed routing: all tokens on one expert -> loss ~ E
+        collapsed = jnp.tile(
+            jax.nn.softmax(jnp.array([[10.0, 0, 0, 0]])), (8, 1)
+        )
+        assert float(load_balancing_loss(collapsed)) > 3.0
+
     def test_experts_not_divisible_raises(self, mesh8):
         router_w, ep, x = _moe_fixture(e=6, seed=3)
         with pytest.raises(ValueError, match="divisible"):

@@ -65,30 +65,49 @@ def make_step(batch_size, *, mode="full", param_dtype=jnp.float32):
                 state.params, state, batch)
             return state.apply_gradients(grads).replace(batch_stats=new_bs), loss
 
+    if mode == "scan20":
+        def scan_step(state, batch):
+            def body(s, _):
+                s2, loss = step(s, batch)
+                return s2, loss
+
+            state, losses = jax.lax.scan(body, state, None, length=20)
+            return state, losses[-1]
+
+        return jax.jit(scan_step, donate_argnums=0), state, batch
+
     return jax.jit(step, donate_argnums=0), state, batch
 
 
 def time_variant(name, batch_size, **kw):
+    inner = 20 if kw.get("mode") == "scan20" else 1  # steps per dispatch
+    calls = 1 if inner > 1 else 15
     step, state, batch = make_step(batch_size, **kw)
     t0 = time.perf_counter()
-    for _ in range(5):
+    for _ in range(5 if inner == 1 else 1):
         state, loss = step(state, batch)
     float(loss)
     warm = time.perf_counter() - t0
     dts = []
     for _ in range(2):
         t0 = time.perf_counter()
-        for _ in range(15):
+        for _ in range(calls):
             state, loss = step(state, batch)
         float(loss)
-        dts.append((time.perf_counter() - t0) / 15)
+        dts.append((time.perf_counter() - t0) / (calls * inner))
     ms = min(dts) * 1e3
     print(f"{name}: {ms:.1f} ms/step  {batch_size / min(dts):.0f} img/s  "
           f"(warmup {warm:.0f}s)", flush=True)
 
 
 if __name__ == "__main__":
+    known = {"full256", "full512", "fwd256", "grad256", "bf16_512", "scan20"}
     which = sys.argv[1:] or ["full256", "full512", "fwd256", "grad256", "bf16_512"]
+    unknown = set(which) - known
+    if unknown:
+        raise SystemExit(f"unknown variants {sorted(unknown)}; have {sorted(known)}")
+    if "scan20" in which:
+        time_variant("scan20 b256", 256, mode="scan20")
     if "full256" in which:
         time_variant("full  b256", 256)
     if "full512" in which:
