@@ -54,10 +54,13 @@ class Attention(nn.Module):
         qkv = nn.DenseGeneral((3, h, d // h), dtype=self.dtype,
                               name="qkv")(x)
         q, k, v = (qkv[:, :, i] for i in range(3))  # (B, T, H, Dh)
+        # t must divide the kernel's block_q=512 AND block_k=1024 grid
+        # (flash_attention.py asserts it), so the guard is t % 1024 == 0 —
+        # t % 128 alone would admit 1280/1536-token inputs the kernel rejects
         use_flash = (
             jax.default_backend() == "tpu"
             and t >= FLASH_MIN_TOKENS
-            and t % 128 == 0
+            and t % 1024 == 0
         )
         if use_flash:
             from deep_vision_tpu.ops.pallas.flash_attention import (
@@ -219,6 +222,64 @@ class ViT(nn.Module):
             )
             return logits, {"moe_aux": aux}
         return logits
+
+
+def pipeline_vit_trunk(model: ViT, variables, x, mesh, *,
+                       num_microbatches: int, axis_name: str = "model"):
+    """Run a dense ViT's block trunk as a GPipe pipeline over `axis_name`.
+
+    The ViT trunk is the textbook pipeline workload — `depth` blocks with
+    identical param shapes and one fixed (B, T, D) activation shape. This
+    bridges the zoo model to `parallel.pipeline.pipeline_apply`: blocks are
+    grouped into `mesh.shape[axis_name]` stages (depth must divide evenly),
+    per-stage params are stacked/sharded, and each device runs its
+    contiguous block span with one ppermute hop between stages.
+
+    x: (B, T, D) tokens (after patch embed + pos). Returns (B, T, D).
+    Matches the sequential trunk exactly (see tests/test_vit.py); grads flow,
+    so a pipelined train step is jax.grad over this. MoE blocks are not
+    pipelineable this way (their param shapes differ); use dense ViT.
+    """
+    from deep_vision_tpu.parallel.pipeline import (
+        pipeline_apply,
+        pipeline_param_sharding,
+        stack_pipeline_params,
+    )
+
+    assert model.num_experts == 0, "pipeline trunk requires a dense ViT"
+    n_stages = mesh.shape[axis_name]
+    depth = model.depth
+    assert depth % n_stages == 0, (
+        f"depth {depth} not divisible into {n_stages} stages"
+    )
+    span = depth // n_stages
+    params = variables["params"]
+    block = ViTBlock(model.num_heads, model.mlp_ratio, dtype=model.dtype)
+    # stage s holds blocks [s*span, (s+1)*span), stacked on a span axis
+    stage_params = [
+        jax.tree_util.tree_map(
+            lambda *xs: jnp.stack(xs),
+            *[params[f"ViTBlock_{s * span + j}"] for j in range(span)],
+        )
+        for s in range(n_stages)
+    ]
+    stacked = stack_pipeline_params(stage_params)
+    stacked = jax.device_put(
+        stacked, pipeline_param_sharding(mesh, stacked, axis_name)
+    )
+
+    def stage_fn(p, h):
+        def body(h, block_p):
+            h, _ = block.apply({"params": block_p}, h)
+            return h, None
+
+        h, _ = jax.lax.scan(body, h, p)
+        return h
+
+    return pipeline_apply(
+        stage_fn, stacked, x, mesh,
+        num_microbatches=num_microbatches, axis_name=axis_name,
+    )
 
 
 @register_model("vit_s16")

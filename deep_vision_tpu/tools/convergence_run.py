@@ -16,10 +16,12 @@ import argparse
 import json
 import os
 import time
+from typing import Optional
 
 
 def run(steps: int = 200, batch: int = 64, classes: int = 64,
-        out_path: str = "artifacts/resnet50_tpu_convergence.json") -> dict:
+        model_name: str = "resnet50", out_path: Optional[str] = None) -> dict:
+    out_path = out_path or f"artifacts/{model_name}_tpu_convergence.json"
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -30,38 +32,56 @@ def run(steps: int = 200, batch: int = 64, classes: int = 64,
     from deep_vision_tpu.models import get_model
     from deep_vision_tpu.train.optimizers import build_optimizer
 
-    model = get_model("resnet50", num_classes=classes, dtype=jnp.bfloat16,
-                      stem="s2d")
-    tx = build_optimizer("sgd", 0.05, momentum=0.9, weight_decay=1e-4)
-    state = create_train_state(
-        model, tx, jnp.ones((8, 56, 56, 12), jnp.float32), jax.random.PRNGKey(0)
-    )
-
     # fixed fixture: `batch` images / `classes` labels, memorizable in O(100)
     # steps — real-data ImageNet is not present in this environment, so the
     # evidence is "the full recipe optimizes on hardware", not accuracy parity
     rng = np.random.RandomState(0)
     imgs = rng.rand(batch, 112, 112, 3).astype(np.float32)
-    batch_d = {
-        "image": jnp.asarray(
+    if model_name == "resnet50":
+        model = get_model("resnet50", num_classes=classes, dtype=jnp.bfloat16,
+                          stem="s2d")
+        tx = build_optimizer("sgd", 0.05, momentum=0.9, weight_decay=1e-4)
+        sample = jnp.ones((8, 56, 56, 12), jnp.float32)
+        recipe = "resnet50 (bf16, s2d stem, SGD 0.05/0.9/1e-4)"
+        images = jnp.asarray(
             np.stack([space_to_depth(i) for i in imgs]), jnp.bfloat16
-        ),
+        )
+    else:  # the attention family: AdamW recipe on raw 112px inputs
+        model = get_model(model_name, num_classes=classes,
+                          dtype=jnp.bfloat16)
+        tx = build_optimizer("adamw", 1e-3, weight_decay=1e-4)
+        sample = jnp.ones((8, 112, 112, 3), jnp.float32)
+        recipe = f"{model_name} (bf16, AdamW 1e-3/1e-4)"
+        images = jnp.asarray(imgs, jnp.bfloat16)
+    state = create_train_state(model, tx, sample, jax.random.PRNGKey(0))
+
+    batch_d = {
+        "image": images,
         "label": jnp.asarray(np.arange(batch) % classes, jnp.int32),
     }
 
     def train_step(state, batch):
         def loss_fn(params):
-            variables = {"params": params, "batch_stats": state.batch_stats}
-            out, nms = state.apply_fn(
+            variables = {"params": params}
+            # NB mutable=False, not []: flax returns (y, vars) for ANY list
+            mutable = False
+            if state.batch_stats:
+                variables["batch_stats"] = state.batch_stats
+                mutable = ["batch_stats"]
+            out = state.apply_fn(
                 variables, batch["image"], train=True,
                 rngs={"dropout": jax.random.fold_in(state.rng, state.step)},
-                mutable=["batch_stats"])
+                mutable=mutable)
+            out, nms = out if mutable else (out, {})
             loss, _ = classification_loss_fn(out, batch)
-            return loss, nms["batch_stats"]
+            return loss, nms.get("batch_stats", {})
 
         (loss, bs), grads = jax.value_and_grad(loss_fn, has_aux=True)(
             state.params)
-        return state.apply_gradients(grads).replace(batch_stats=bs), loss
+        new_state = state.apply_gradients(grads)
+        if state.batch_stats:
+            new_state = new_state.replace(batch_stats=bs)
+        return new_state, loss
 
     step = jax.jit(train_step, donate_argnums=0)
     losses = []
@@ -74,7 +94,7 @@ def run(steps: int = 200, batch: int = 64, classes: int = 64,
 
     dev = jax.devices()[0]
     result = {
-        "model": "resnet50 (bf16, s2d stem, SGD 0.05/0.9/1e-4)",
+        "model": recipe,
         "device": f"{dev.platform}:{dev.device_kind}",
         "steps": steps,
         "batch": batch,
@@ -94,11 +114,14 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("--steps", type=int, default=200)
     p.add_argument("--batch", type=int, default=64)
-    p.add_argument("--out", default="artifacts/resnet50_tpu_convergence.json")
+    p.add_argument("--model", default="resnet50",
+                   help="resnet50 | vit_s16 | vmoe_s16")
+    p.add_argument("--out", default=None)
     args = p.parse_args(argv)
-    r = run(args.steps, args.batch, out_path=args.out)
+    out = args.out or f"artifacts/{args.model}_tpu_convergence.json"
+    r = run(args.steps, args.batch, model_name=args.model, out_path=out)
     print(f"device={r['device']} first={r['first_loss']} "
-          f"final={r['final_loss']} wall={r['wall_seconds']}s -> {args.out}")
+          f"final={r['final_loss']} wall={r['wall_seconds']}s -> {out}")
     ok = r["final_loss"] < 0.5 * r["first_loss"]
     print("CONVERGED" if ok else "DID NOT CONVERGE")
     return 0 if ok else 1

@@ -1,0 +1,67 @@
+"""Exponential moving average of model params (evaluation weights).
+
+Net-new utility (the reference has nothing like it; modern vision recipes —
+MoCo, EfficientNet, the CenterNet paper's test-time setup — evaluate an EMA
+of the weights rather than the raw optimum). Device-resident and jitted: the
+update is one fused multiply-add pass over the param tree, so enabling it
+costs a single extra HBM sweep per step.
+
+Usage (standalone):
+
+    ema = EmaParams(state.params, decay=0.999)
+    for batch in data:
+        state, _ = train_step(state, batch)
+        ema.update(state.params)
+    eval_metrics = eval_fn(ema.params)
+
+or via ``Trainer(..., ema_decay=0.999)`` which maintains it automatically
+and evaluates with the averaged weights.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def _ema_update(ema, params, decay):
+    # debiasing handled by the warmup decay schedule below, not a division:
+    # keeps the update a single fused pass with no extra state
+    return jax.tree_util.tree_map(
+        lambda e, p: e * decay + p.astype(e.dtype) * (1.0 - decay),
+        ema, params,
+    )
+
+
+class EmaParams:
+    """Shadow copy of a param pytree, EMA-updated in place on device."""
+
+    def __init__(self, params, decay: float = 0.999, warmup: bool = True):
+        self.decay = float(decay)
+        self.warmup = warmup
+        self._count = 0
+        # copy=True: the caller's params are typically the train state that
+        # jitted steps DONATE — an aliased buffer would be deleted by the
+        # first step and poison the first update
+        self.params = jax.tree_util.tree_map(
+            lambda p: jnp.array(p, jnp.float32, copy=True), params
+        )
+
+    def update(self, params) -> None:
+        self._count += 1
+        d = self.decay
+        if self.warmup:
+            # tf.train.ExponentialMovingAverage zero-debias: ramp the decay
+            # so early steps aren't dominated by the random init
+            d = min(d, (1.0 + self._count) / (10.0 + self._count))
+        self.params = _ema_update(self.params, params, d)
+
+    # -- checkpoint side-car ------------------------------------------------
+    def state_dict(self) -> dict:
+        return {"count": self._count, "decay": self.decay,
+                "warmup": self.warmup}
+
+    def load_state_dict(self, d: dict) -> None:
+        self._count = int(d.get("count", 0))
+        self.decay = float(d.get("decay", self.decay))
+        self.warmup = bool(d.get("warmup", self.warmup))

@@ -68,6 +68,8 @@ class Trainer:
         eval_logger: Optional[MetricLogger] = None,
         profile_dir: Optional[str] = None,
         profile_steps: tuple = (10, 20),
+        checkify_errors: bool = False,
+        ema_decay: Optional[float] = None,
     ):
         self.mesh = mesh if mesh is not None else create_mesh()
         self.model = model  # single source of truth for summaries/export
@@ -89,6 +91,24 @@ class Trainer:
         state = create_train_state(model, tx, sample_input, rng)
         # device boundary: state lives replicated on the mesh from here on
         self.state = jax.device_put(state, replicated(self.mesh))
+        # EMA evaluation weights (train/ema.py): updated after every step,
+        # used by eval_step. Checkpointed in a SIBLING manager under
+        # <ckpt_dir>/ema so the main checkpoint's on-disk structure is
+        # identical with or without the flag — runs stay resumable either
+        # way (the shadow just re-seeds from the restored params when no
+        # EMA history exists).
+        self.ema = None
+        self._ema_ckpt = None
+        if ema_decay is not None:
+            from deep_vision_tpu.train.ema import EmaParams
+
+            self.ema = EmaParams(self.state.params, decay=ema_decay)
+            if self.ckpt is not None:
+                import os as _os
+
+                self._ema_ckpt = type(self.ckpt)(
+                    _os.path.join(self.ckpt.directory, "ema")
+                )
         # base LR for plateau scaling: scale is applied to this absolute value,
         # never compounded onto an already-scaled current LR
         try:
@@ -96,7 +116,25 @@ class Trainer:
         except (AttributeError, KeyError, TypeError):
             self._base_lr = None
 
-        self._train_step = jax.jit(self._train_step_impl, donate_argnums=0)
+        # Sanitizer mode (SURVEY §2.7: the functional-runtime analog of race
+        # detectors/ASAN the reference never had): jax.experimental.checkify
+        # instruments every op in the jitted step with NaN / out-of-bounds /
+        # div-by-zero checks; train_step then raises a located error instead
+        # of silently propagating garbage. ~2x step cost — a debugging mode,
+        # vs --debug-nans which re-runs ops eagerly only after a NaN fetch.
+        self._checkify = checkify_errors
+        if checkify_errors:
+            from jax.experimental import checkify
+
+            checked = checkify.checkify(
+                self._train_step_impl, errors=checkify.all_checks
+            )
+            self._train_step_err = jax.jit(checked)
+            self._train_step = None
+        else:
+            self._train_step = jax.jit(
+                self._train_step_impl, donate_argnums=0
+            )
         self._eval_step = jax.jit(self._eval_step_impl)
 
     # -- jitted steps ------------------------------------------------------
@@ -167,12 +205,22 @@ class Trainer:
     def train_step(self, batch) -> dict:
         self._profiler_hook()
         batch = shard_batch(self.mesh, self._pad_and_mask(batch))
-        self.state, metrics = self._train_step(self.state, batch)
+        if self._checkify:
+            err, (new_state, metrics) = self._train_step_err(self.state, batch)
+            err.throw()  # located NaN/OOB/div0 inside the step, if any
+            self.state = new_state
+        else:
+            self.state, metrics = self._train_step(self.state, batch)
+        if self.ema is not None:
+            self.ema.update(self.state.params)
         return metrics
 
     def eval_step(self, batch) -> dict:
         batch = shard_batch(self.mesh, self._pad_and_mask(batch))
-        return self._eval_step(self.state, batch)
+        state = self.state
+        if self.ema is not None:
+            state = state.replace(params=self.ema.params)
+        return self._eval_step(state, batch)
 
     @property
     def current_lr(self) -> float:
@@ -256,11 +304,18 @@ class Trainer:
                     int(self.state.step), self.state, host_state=host_state,
                     metrics=val_summary,
                 )
+                if self._ema_ckpt is not None:
+                    self._ema_ckpt.save_tree(
+                        int(self.state.step), dict(self.ema.params),
+                        host_state=self.ema.state_dict(),
+                    )
         if self._profiling:  # stop gate never reached (short run)
             jax.profiler.stop_trace()
             self._profiling = False
         if self.ckpt is not None:
             self.ckpt.wait()
+        if self._ema_ckpt is not None:
+            self._ema_ckpt.wait()
         return self.state
 
     def resume(self, step: Optional[int] = None) -> int:
@@ -268,6 +323,22 @@ class Trainer:
         assert self.ckpt is not None, "no CheckpointManager configured"
         self.state, host_state = self.ckpt.restore(self.state, step)
         self.state = jax.device_put(self.state, replicated(self.mesh))
+        if self.ema is not None:
+            restored_ema, ema_host = (None, None)
+            if self._ema_ckpt is not None:
+                restored_ema, ema_host = self._ema_ckpt.restore_tree(
+                    dict(self.ema.params), step
+                )
+            if restored_ema is not None:
+                self.ema.params = restored_ema
+                self.ema.load_state_dict(ema_host or {})
+            else:
+                # checkpoint predates --ema-decay: seed from the restored
+                # weights rather than the fresh init
+                from deep_vision_tpu.train.ema import EmaParams
+
+                self.ema = EmaParams(self.state.params, decay=self.ema.decay,
+                                     warmup=self.ema.warmup)
         if not host_state:
             return 0
         self.logger.load_state_dict(host_state.get("train_logger", {}))

@@ -294,7 +294,9 @@ def _build_schedule(cfg: ExperimentConfig, steps_per_epoch: int):
 
 def build_trainer(cfg: ExperimentConfig, train_fn, ckpt_dir: Optional[str],
                   tb_dir: Optional[str] = None,
-                  profile_dir: Optional[str] = None):
+                  profile_dir: Optional[str] = None,
+                  checkify_errors: bool = False,
+                  ema_decay: Optional[float] = None):
     import functools
 
     import jax.numpy as jnp
@@ -357,6 +359,7 @@ def build_trainer(cfg: ExperimentConfig, train_fn, ckpt_dir: Optional[str],
         model, tx, loss_fn, sample, plateau=plateau,
         plateau_metric=plateau_metric, checkpoint_manager=ckpt,
         logger=logger, eval_logger=eval_logger, profile_dir=profile_dir,
+        checkify_errors=checkify_errors, ema_decay=ema_decay,
     )
 
 
@@ -504,6 +507,14 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--summary", action="store_true",
                         help="print the per-parameter model summary table "
                              "(torchsummary analog) before training")
+    parser.add_argument("--ema-decay", type=float, default=None,
+                        help="maintain an EMA of the weights at this decay "
+                             "and evaluate with it (train/ema.py)")
+    parser.add_argument("--checkify", action="store_true",
+                        help="run the train step under jax.experimental."
+                             "checkify (NaN/out-of-bounds/div0 checks on "
+                             "every op, ~2x step cost) and raise a located "
+                             "error — the compiled-mode sanitizer")
     parser.add_argument("--debug-nans", action="store_true",
                         help="jax_debug_nans: re-run the op that produced "
                              "the first NaN un-jitted and raise there (the "
@@ -621,7 +632,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     ckpt_dir = args.ckpt_dir or os.path.join("checkpoints", cfg.name)
     trainer = build_trainer(cfg, train_fn, ckpt_dir,
                             tb_dir=args.tensorboard_dir,
-                            profile_dir=args.profile_dir)
+                            profile_dir=args.profile_dir,
+                            checkify_errors=args.checkify,
+                            ema_decay=args.ema_decay)
     # param accounting before training, like summary(net, (3,224,224)) at
     # ResNet/pytorch/train.py:350 / model.summary() at YOLO/tensorflow/train.py:297
     from deep_vision_tpu.core.summary import count_params

@@ -94,3 +94,28 @@ def test_export_restores_checkpoint(tmp_path):
     want = np.asarray(model.apply(variables, x, train=False))
     np.testing.assert_allclose(np.asarray(back.call(x)), want,
                                rtol=1e-5, atol=1e-5)
+
+
+def test_roundtrip_vit(tmp_path):
+    """StableHLO export of the attention family (flash path folds to dense
+    at this T; export always runs eval mode so no aux tuple)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from deep_vision_tpu.models import get_model
+    from deep_vision_tpu.tools.export import export_model, load_exported
+
+    model = get_model("vmoe_s16", num_classes=5)
+    x = jnp.asarray(np.random.RandomState(0).rand(2, 32, 32, 3), jnp.float32)
+    variables = model.init(jax.random.PRNGKey(0), x, train=False)
+    exported = export_model(model, variables, x)
+    path = str(tmp_path / "vmoe.stablehlo")
+    with open(path, "wb") as f:
+        f.write(exported.serialize())
+    restored = load_exported(path)
+    np.testing.assert_allclose(
+        np.asarray(restored.call(x)),
+        np.asarray(model.apply(variables, x, train=False)),
+        rtol=1e-5, atol=1e-5,
+    )

@@ -133,3 +133,27 @@ def test_fit_raises_on_diverged_loss(mesh8):
     images[0] = np.nan  # a poisoned batch: the loss goes non-finite
     with pytest.raises(FloatingPointError, match="diverged"):
         trainer.fit(lambda: batches(images, labels, 32), epochs=3)
+
+
+def test_checkify_mode_locates_nan_in_step(mesh8):
+    """Sanitizer mode (SURVEY §2.7): checkify raises a located error on the
+    first poisoned op inside the jitted step, instead of finishing the epoch
+    with garbage."""
+    from jax.experimental import checkify as _checkify
+
+    model = get_model("lenet5", num_classes=4)
+    tx = build_optimizer("sgd", 1e-3)
+    trainer = Trainer(
+        model, tx, classification_loss_fn,
+        sample_input=jnp.zeros((8, 32, 32, 1)), mesh=mesh8,
+        checkify_errors=True,
+    )
+    images, labels = synthetic_mnist(64)
+    # clean step passes and trains
+    m = trainer.train_step({"image": images[:32], "label": labels[:32]})
+    assert np.isfinite(float(m["loss"]))
+    # poisoned batch raises from inside the step with a location
+    bad = images[:32].copy()
+    bad[0] = np.nan
+    with pytest.raises(_checkify.JaxRuntimeError, match="nan"):
+        trainer.train_step({"image": bad, "label": labels[:32]})

@@ -106,6 +106,32 @@ def test_vit_registry_and_config():
     assert cfg.schedule["kind"] == "cosine"
 
 
+def test_pipeline_vit_trunk_matches_sequential():
+    """The GPipe-pipelined ViT trunk must equal running the blocks in order."""
+    from deep_vision_tpu.models.vit import ViTBlock, pipeline_vit_trunk
+    from deep_vision_tpu.parallel.mesh import create_mesh
+
+    mesh = create_mesh(data=2, model=4)
+    model = ViT(depth=8, dim=32, num_heads=2, patch=8, num_classes=10)
+    x_img = jnp.asarray(
+        np.random.RandomState(0).rand(4, 32, 32, 3), jnp.float32
+    )
+    variables = model.init(jax.random.PRNGKey(0), x_img, train=False)
+    tokens = jnp.asarray(
+        np.random.RandomState(1).randn(4, 16, 32), jnp.float32
+    )
+    out = pipeline_vit_trunk(model, variables, tokens, mesh,
+                             num_microbatches=2)
+    block = ViTBlock(model.num_heads, model.mlp_ratio)
+    ref = tokens
+    for i in range(model.depth):
+        ref, _ = block.apply(
+            {"params": variables["params"][f"ViTBlock_{i}"]}, ref
+        )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
 def test_vit_short_training_reduces_loss():
     # 1-patch-class toy problem: ViT must fit it in a few steps
     import optax
