@@ -87,6 +87,25 @@ def sync_hosts(name: str = "barrier") -> None:
     multihost_utils.sync_global_devices(name)
 
 
+def agree_flag(local_flag: bool) -> bool:
+    """Global OR of a per-host boolean (True if ANY host raised it).
+
+    The preemption-consensus primitive (train/trainer.py): SIGTERM lands on
+    hosts at different instants; every host calls this at the same step
+    boundary, the allgather rendezvouses them, and all act on the same
+    answer — no host enters a checkpoint collective while another enters
+    the next step's all-reduce. Single-process: returns the flag as-is.
+    """
+    if jax.process_count() == 1:
+        return bool(local_flag)
+    from jax.experimental import multihost_utils
+
+    flags = multihost_utils.process_allgather(
+        np.asarray([bool(local_flag)])
+    )
+    return bool(np.any(flags))
+
+
 def per_host_batch_size(global_batch_size: int) -> int:
     """Rows this host must feed per step (global batch / host count); the
     global-batch contract mirrors `batch * num_replicas` at

@@ -87,6 +87,7 @@ class Trainer:
         self.profile_dir = profile_dir
         self.profile_steps = profile_steps
         self._profiling = False
+        self._preempt_requested = False
 
         state = create_train_state(model, tx, sample_input, rng)
         # device boundary: state lives replicated on the mesh from here on
@@ -233,6 +234,8 @@ class Trainer:
         self.eval_logger.start_epoch()
         step = 0
         for batch in eval_data:
+            if getattr(self, "_preempt_requested", False):
+                break  # caller checks _preempt_agreed and checkpoints
             n = np.asarray(batch[self.input_key]).shape[0]
             metrics = self.eval_step(batch)
             self.eval_logger.log_step(step, metrics, batch_size=n, epoch=epoch)
@@ -247,76 +250,165 @@ class Trainer:
         start_epoch: int = 0,
         eval_first: bool = False,  # epoch-0 sanity pass (ResNet/pytorch/train.py:390)
         save_every: int = 1,
+        handle_preemption: bool = True,
     ):
-        if eval_first and eval_data_fn is not None:
-            self.evaluate(eval_data_fn(), epoch=start_epoch)
-        for epoch in range(start_epoch, epochs):
-            self.logger.start_epoch()
-            for batch in train_data_fn():
-                n = np.asarray(batch[self.input_key]).shape[0]
-                metrics = self.train_step(batch)
-                self.logger.log_step(
-                    int(self.state.step), metrics, batch_size=n, epoch=epoch,
-                    lr=self.current_lr,
-                )
-            summary = self.logger.end_epoch(epoch)
-            # failure detection the reference has none of (SURVEY §5): a
-            # diverged run must stop loudly, not burn the remaining epochs.
-            # Checked at epoch granularity so the hot loop stays sync-free.
-            loss_avg = summary.get("loss")
-            if loss_avg is not None and not np.isfinite(loss_avg):
-                # leave postmortem artifacts intact: flush the in-flight
-                # async checkpoint and close any open profiler trace first
-                if self.ckpt is not None:
-                    self.ckpt.wait()
-                if self._profiling:
-                    jax.profiler.stop_trace()
-                    self._profiling = False
-                raise FloatingPointError(
-                    f"training diverged: epoch {epoch} mean loss is "
-                    f"{loss_avg} (re-run with train.py --debug-nans to "
-                    "locate the first non-finite op)"
-                )
+        """Epoch driver. With `handle_preemption` (default), SIGTERM — what a
+        TPU VM gets ~30s before a maintenance event or spot reclaim — is
+        caught, the current step finishes, a checkpoint + host sidecar are
+        written synchronously, and fit returns early; `resume()` continues
+        the run. The elastic-recovery story the reference lacked entirely
+        (SURVEY §2.7: 'recovery = manual resume from checkpoint'). Installed
+        only on the main thread (signal module requirement)."""
+        prev_handler = None
+        self._preempt_requested = False
+        if handle_preemption:
+            import signal as _signal
+            import threading
 
-            val_summary = {}
-            if eval_data_fn is not None:
-                val_summary = self.evaluate(eval_data_fn(), epoch=epoch)
+            if threading.current_thread() is threading.main_thread():
+                def _on_sigterm(signum, frame):
+                    self._preempt_requested = True
 
-            if (
-                self.plateau is not None
-                and self.plateau_metric in val_summary
-                and self._base_lr is not None
-            ):
-                scale = self.plateau.step(val_summary[self.plateau_metric])
-                self.state = self.state.replace(
-                    opt_state=_set_lr(self.state.opt_state, self._base_lr * scale)
-                )
+                prev_handler = _signal.signal(_signal.SIGTERM, _on_sigterm)
 
-            if self.ckpt is not None and (epoch + 1) % save_every == 0:
-                host_state = {
-                    "epoch": epoch,
-                    "train_logger": self.logger.state_dict(),
-                    "val_logger": self.eval_logger.state_dict(),
-                }
-                if self.plateau is not None:
-                    host_state["plateau"] = self.plateau.state_dict()
-                self.ckpt.save(
-                    int(self.state.step), self.state, host_state=host_state,
-                    metrics=val_summary,
-                )
-                if self._ema_ckpt is not None:
-                    self._ema_ckpt.save_tree(
-                        int(self.state.step), dict(self.ema.params),
-                        host_state=self.ema.state_dict(),
-                    )
-        if self._profiling:  # stop gate never reached (short run)
-            jax.profiler.stop_trace()
-            self._profiling = False
-        if self.ckpt is not None:
-            self.ckpt.wait()
+        try:
+            if eval_first and eval_data_fn is not None:
+                self.evaluate(eval_data_fn(), epoch=start_epoch)
+            for epoch in range(start_epoch, epochs):
+                status, summary = self._run_epoch(train_data_fn, epoch)
+                if status == "preempted":
+                    return self.state
+                if self._post_epoch(summary, eval_data_fn, epoch,
+                                    save_every) == "preempted":
+                    return self.state
+        finally:
+            if prev_handler is not None:
+                import signal as _signal
+
+                _signal.signal(_signal.SIGTERM, prev_handler)
+            if self._profiling:  # stop gate never reached (short run)
+                jax.profiler.stop_trace()
+                self._profiling = False
+            if self.ckpt is not None:
+                self.ckpt.wait()
+            if self._ema_ckpt is not None:
+                self._ema_ckpt.wait()
+        return self.state
+
+    def _save_checkpoint(self, epoch: int, val_summary=None) -> bool:
+        host_state = {
+            "epoch": epoch,
+            "train_logger": self.logger.state_dict(),
+            "val_logger": self.eval_logger.state_dict(),
+        }
+        if self.plateau is not None:
+            host_state["plateau"] = self.plateau.state_dict()
+        saved = self.ckpt.save(
+            int(self.state.step), self.state, host_state=host_state,
+            metrics=val_summary,
+        )
+        if self._ema_ckpt is not None:
+            self._ema_ckpt.save_tree(
+                int(self.state.step), dict(self.ema.params),
+                host_state=self.ema.state_dict(),
+            )
+        return bool(saved)
+
+    def _preempt_agreed(self) -> bool:
+        """Did SIGTERM arrive — and do ALL hosts agree? Per-host flags are
+        raised at different instants; acting on a local flag alone would
+        have host A entering the checkpoint collective while host B enters
+        the next step's gradient all-reduce: distributed deadlock. The
+        allgather here is itself a collective every host joins at the same
+        step boundary, so the decision is globally consistent."""
+        if jax.process_count() == 1:
+            return self._preempt_requested
+        from deep_vision_tpu.parallel import multihost
+
+        return multihost.agree_flag(self._preempt_requested)
+
+    def _preempt_save(self, epoch: int) -> None:
+        """Synchronous best-effort checkpoint on the preemption path, honest
+        about the outcome (the VM dies shortly; the operator must know
+        whether the step made it to disk)."""
+        step = int(self.state.step)
+        if self.ckpt is None:
+            print(f"preempted at step {step}: NO checkpoint manager, "
+                  "state not saved; exiting fit", flush=True)
+            return
+        saved = self._save_checkpoint(epoch)
+        self.ckpt.wait()
         if self._ema_ckpt is not None:
             self._ema_ckpt.wait()
-        return self.state
+        if saved:
+            print(f"preempted at step {step}: checkpoint written, "
+                  "exiting fit", flush=True)
+        else:
+            print(f"preempted at step {step}: checkpoint manager DECLINED "
+                  f"the save (latest on disk: {self.ckpt.latest_step()}); "
+                  "exiting fit", flush=True)
+
+    def _run_epoch(self, train_data_fn, epoch):
+        """One epoch of steps; returns ("preempted"|None, logger summary)."""
+        self.logger.start_epoch()
+        for batch in train_data_fn():
+            n = np.asarray(batch[self.input_key]).shape[0]
+            metrics = self.train_step(batch)
+            self.logger.log_step(
+                int(self.state.step), metrics, batch_size=n, epoch=epoch,
+                lr=self.current_lr,
+            )
+            if self._preempt_agreed():
+                # no end_epoch: a partial-epoch summary would pollute the
+                # history/TensorBoard rows the re-run epoch writes again.
+                # epoch-1: this epoch is incomplete, resume re-runs it
+                self._preempt_save(epoch - 1)
+                return "preempted", None
+        return None, self.logger.end_epoch(epoch)
+
+    def _post_epoch(self, summary, eval_data_fn, epoch, save_every):
+        # failure detection the reference has none of (SURVEY §5): a
+        # diverged run must stop loudly, not burn the remaining epochs.
+        # Checked at epoch granularity so the hot loop stays sync-free.
+        loss_avg = summary.get("loss")
+        if loss_avg is not None and not np.isfinite(loss_avg):
+            # leave postmortem artifacts intact: flush the in-flight
+            # async checkpoint and close any open profiler trace first
+            if self.ckpt is not None:
+                self.ckpt.wait()
+            if self._profiling:
+                jax.profiler.stop_trace()
+                self._profiling = False
+            raise FloatingPointError(
+                f"training diverged: epoch {epoch} mean loss is "
+                f"{loss_avg} (re-run with train.py --debug-nans to "
+                "locate the first non-finite op)"
+            )
+
+        # honor a SIGTERM that landed after the last step (or during eval,
+        # which bails early): the epoch's training IS complete, save as such
+        if self._preempt_agreed():
+            self._preempt_save(epoch)
+            return "preempted"
+        val_summary = {}
+        if eval_data_fn is not None:
+            val_summary = self.evaluate(eval_data_fn(), epoch=epoch)
+        if self._preempt_agreed():
+            self._preempt_save(epoch)
+            return "preempted"
+
+        if (
+            self.plateau is not None
+            and self.plateau_metric in val_summary
+            and self._base_lr is not None
+        ):
+            scale = self.plateau.step(val_summary[self.plateau_metric])
+            self.state = self.state.replace(
+                opt_state=_set_lr(self.state.opt_state, self._base_lr * scale)
+            )
+
+        if self.ckpt is not None and (epoch + 1) % save_every == 0:
+            self._save_checkpoint(epoch, val_summary)
 
     def resume(self, step: Optional[int] = None) -> int:
         """Restore state + host loggers/plateau; returns next epoch to run."""

@@ -53,6 +53,12 @@ assert out == 6.0, out
 assert mh.per_host_batch_size(8) == 4
 
 mh.sync_hosts("test-barrier")
+
+# preemption consensus: only host 0 raises the flag; BOTH must act on it
+# (the trainer's SIGTERM path deadlocks if hosts disagree on the step)
+assert mh.agree_flag(pid == 0) is True
+assert mh.agree_flag(False) is False
+
 print(f"proc {pid} OK total={out}")
 """
 

@@ -157,3 +157,78 @@ def test_checkify_mode_locates_nan_in_step(mesh8):
     bad[0] = np.nan
     with pytest.raises(_checkify.JaxRuntimeError, match="nan"):
         trainer.train_step({"image": bad, "label": labels[:32]})
+
+
+def test_preemption_checkpoints_and_resumes(mesh8, tmp_path):
+    """Elastic recovery (SURVEY §2.7 upstream: 'recovery = manual resume'):
+    SIGTERM mid-epoch finishes the in-flight step, writes a checkpoint, and
+    fit returns; a fresh Trainer resumes the incomplete epoch."""
+    import os
+    import signal
+
+    from deep_vision_tpu.core import CheckpointManager
+
+    images, labels = synthetic_mnist()
+
+    def make():
+        return Trainer(
+            get_model("lenet5", num_classes=4),
+            build_optimizer("adam", 1e-3),
+            classification_loss_fn,
+            sample_input=jnp.zeros((8, 32, 32, 1)),
+            mesh=mesh8,
+            checkpoint_manager=CheckpointManager(str(tmp_path)),
+        )
+
+    def preempting_batches():
+        for i, b in enumerate(batches(images, labels, 32)):
+            if i == 2:  # "maintenance event" after 2 steps of epoch 0
+                os.kill(os.getpid(), signal.SIGTERM)
+            yield b
+
+    trainer = make()
+    trainer.fit(preempting_batches, epochs=5)  # returns instead of dying
+    saved_step = int(trainer.state.step)
+    assert saved_step == 3  # the in-flight 3rd step completed, then stopped
+
+    trainer2 = make()
+    next_epoch = trainer2.resume()
+    assert next_epoch == 0  # incomplete epoch is re-run
+    assert int(trainer2.state.step) == saved_step
+    trainer2.fit(lambda: batches(images, labels, 32), epochs=2,
+                 start_epoch=next_epoch)
+    assert int(trainer2.state.step) == saved_step + 2 * 8
+
+
+def test_preemption_during_eval_saves_completed_epoch(mesh8, tmp_path):
+    """SIGTERM mid-eval: eval bails early, the finished training epoch is
+    checkpointed as complete, and resume continues at the NEXT epoch."""
+    import os
+    import signal
+
+    from deep_vision_tpu.core import CheckpointManager
+
+    images, labels = synthetic_mnist()
+
+    def make():
+        return Trainer(
+            get_model("lenet5", num_classes=4),
+            build_optimizer("adam", 1e-3),
+            classification_loss_fn,
+            sample_input=jnp.zeros((8, 32, 32, 1)),
+            mesh=mesh8,
+            checkpoint_manager=CheckpointManager(str(tmp_path)),
+        )
+
+    def preempting_eval():
+        os.kill(os.getpid(), signal.SIGTERM)
+        yield from batches(images[:64], labels[:64], 32)
+
+    trainer = make()
+    trainer.fit(lambda: batches(images, labels, 32), preempting_eval,
+                epochs=5)
+    assert int(trainer.state.step) == 8  # epoch 0 trained fully
+
+    trainer2 = make()
+    assert trainer2.resume() == 1  # epoch 0 is complete; eval is re-runnable
+    assert int(trainer2.state.step) == 8
