@@ -106,6 +106,66 @@ def agree_flag(local_flag: bool) -> bool:
     return bool(np.any(flags))
 
 
+class PreemptionGuard:
+    """SIGTERM → a cross-host-consistent "stop now" signal.
+
+    The context manager installs a SIGTERM handler (main thread only; the
+    previous handler is restored on exit). `agreed()` is the ONLY correct
+    way to act on the flag in multi-host runs: hosts receive SIGTERM at
+    different instants, and a host acting on its local flag alone would
+    enter a checkpoint collective while another enters the next step's
+    all-reduce — distributed deadlock. `agreed()` polls a cross-host OR
+    (`agree_flag`) every `poll_every` calls — a deterministic cadence, so
+    every host rendezvouses at the same call boundary — and always when
+    `force=True` (epoch/eval boundaries). The agreed answer is sticky.
+    Single-process: returns the local flag directly, no collectives.
+
+    `poll_every` trades detection latency for hot-loop sync: SIGTERM gives
+    ~30s of grace, so polling every 10 steps costs nothing in practice
+    while keeping the train loop free of a per-step host-blocking
+    allgather.
+    """
+
+    def __init__(self, poll_every: int = 10):
+        self.poll_every = max(1, int(poll_every))
+        self.requested = False
+        self._agreed = False
+        self._calls = 0
+        self._prev_handler = None
+
+    def _on_sigterm(self, signum, frame):
+        self.requested = True
+
+    def __enter__(self):
+        import signal
+        import threading
+
+        if threading.current_thread() is threading.main_thread():
+            self._prev_handler = signal.signal(
+                signal.SIGTERM, self._on_sigterm
+            )
+        return self
+
+    def __exit__(self, *exc):
+        import signal
+
+        if self._prev_handler is not None:
+            signal.signal(signal.SIGTERM, self._prev_handler)
+            self._prev_handler = None
+        return False
+
+    def agreed(self, force: bool = False) -> bool:
+        if self._agreed:
+            return True
+        if jax.process_count() == 1:
+            self._agreed = self.requested
+            return self._agreed
+        self._calls += 1
+        if force or self._calls % self.poll_every == 0:
+            self._agreed = agree_flag(self.requested)
+        return self._agreed
+
+
 def per_host_batch_size(global_batch_size: int) -> int:
     """Rows this host must feed per step (global batch / host count); the
     global-batch contract mirrors `batch * num_replicas` at

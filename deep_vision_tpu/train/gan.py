@@ -146,12 +146,19 @@ class DcganTrainer:
 
     # checkpoint/resume: the tf.train.Checkpoint G/D/optimizers capture +
     # restore-or-initialize pattern (DCGAN/tensorflow/main.py:34-40)
-    def save(self, ckpt, epoch: int) -> None:
-        ckpt.save_tree(
-            epoch,
+    def save(self, ckpt, epoch: int, completed_epoch: int | None = None) -> bool:
+        """Checkpoint under the GLOBAL optimizer step (unique, monotonic):
+        epoch-keyed steps collide when a preemption save and the re-run
+        epoch's boundary save land on the same epoch number, and orbax
+        silently declines the second. `completed_epoch` (default: epoch) is
+        what restore() resumes after — the preemption path passes epoch-1
+        so the interrupted epoch re-runs. Returns whether orbax saved."""
+        return bool(ckpt.save_tree(
+            int(self.g_state.step),
             {"g": _state_arrays(self.g_state), "d": _state_arrays(self.d_state)},
-            host_state={"epoch": epoch},
-        )
+            host_state={"epoch": epoch if completed_epoch is None
+                        else completed_epoch},
+        ))
 
     def restore(self, ckpt) -> int:
         """Restore-or-initialize; returns the next epoch to run (0 if fresh)."""
@@ -163,9 +170,14 @@ class DcganTrainer:
             return 0
         self.g_state = _load_state_arrays(self.g_state, restored["g"])
         self.d_state = _load_state_arrays(self.d_state, restored["d"])
-        # sidecar may be missing (deleted, or a crash between the tree save
-        # and the JSON write): the step index IS the epoch we saved under
-        return int((host or {}).get("epoch", ckpt.latest_step())) + 1
+        if host is None or "epoch" not in host:
+            # sidecar lost (crash between tree save and JSON write): the
+            # step index is an optimizer step, not an epoch — re-run from
+            # epoch 0 with the restored weights rather than guess
+            print("GAN restore: no epoch sidecar; weights restored, "
+                  "restarting epoch count at 0")
+            return 0
+        return int(host["epoch"]) + 1
 
 
 class CycleGanTrainer:
@@ -190,13 +202,14 @@ class CycleGanTrainer:
 
     # checkpoint/resume: G_ab/G_ba/D_a/D_b + epoch, saved every N epochs
     # (CycleGAN/tensorflow/train.py:133-148, 329-333)
-    def save(self, ckpt, epoch: int) -> None:
-        ckpt.save_tree(
-            epoch,
+    def save(self, ckpt, epoch: int, completed_epoch: int | None = None) -> bool:
+        return bool(ckpt.save_tree(
+            int(self.gab.step),
             {"gab": _state_arrays(self.gab), "gba": _state_arrays(self.gba),
              "da": _state_arrays(self.da), "db": _state_arrays(self.db)},
-            host_state={"epoch": epoch},
-        )
+            host_state={"epoch": epoch if completed_epoch is None
+                        else completed_epoch},
+        ))
 
     def restore(self, ckpt) -> int:
         template = {
@@ -210,7 +223,11 @@ class CycleGanTrainer:
         self.gba = _load_state_arrays(self.gba, restored["gba"])
         self.da = _load_state_arrays(self.da, restored["da"])
         self.db = _load_state_arrays(self.db, restored["db"])
-        return int((host or {}).get("epoch", ckpt.latest_step())) + 1
+        if host is None or "epoch" not in host:
+            print("GAN restore: no epoch sidecar; weights restored, "
+                  "restarting epoch count at 0")
+            return 0
+        return int(host["epoch"]) + 1
 
     # generator step: one grad over BOTH generators (train.py:150-205)
     def _g_step_impl(self, gab: TrainState, gba: TrainState, da, db, real_a, real_b):

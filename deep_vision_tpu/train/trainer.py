@@ -87,7 +87,7 @@ class Trainer:
         self.profile_dir = profile_dir
         self.profile_steps = profile_steps
         self._profiling = False
-        self._preempt_requested = False
+        self._pguard = None  # PreemptionGuard, live only inside fit
 
         state = create_train_state(model, tx, sample_input, rng)
         # device boundary: state lives replicated on the mesh from here on
@@ -234,8 +234,10 @@ class Trainer:
         self.eval_logger.start_epoch()
         step = 0
         for batch in eval_data:
-            if getattr(self, "_preempt_requested", False):
-                break  # caller checks _preempt_agreed and checkpoints
+            # consensus (not the local flag): in multi-host runs every host
+            # must leave the eval collectives at the same batch boundary
+            if self._pguard is not None and self._pguard.agreed():
+                break  # caller re-checks with force=True and checkpoints
             n = np.asarray(batch[self.input_key]).shape[0]
             metrics = self.eval_step(batch)
             self.eval_logger.log_step(step, metrics, batch_size=n, epoch=epoch)
@@ -259,33 +261,25 @@ class Trainer:
         the run. The elastic-recovery story the reference lacked entirely
         (SURVEY §2.7: 'recovery = manual resume from checkpoint'). Installed
         only on the main thread (signal module requirement)."""
-        prev_handler = None
-        self._preempt_requested = False
-        if handle_preemption:
-            import signal as _signal
-            import threading
+        from deep_vision_tpu.parallel.multihost import PreemptionGuard
 
-            if threading.current_thread() is threading.main_thread():
-                def _on_sigterm(signum, frame):
-                    self._preempt_requested = True
+        self._pguard = PreemptionGuard() if handle_preemption else None
+        import contextlib
 
-                prev_handler = _signal.signal(_signal.SIGTERM, _on_sigterm)
-
+        ctx = self._pguard if self._pguard is not None else contextlib.nullcontext()
         try:
-            if eval_first and eval_data_fn is not None:
-                self.evaluate(eval_data_fn(), epoch=start_epoch)
-            for epoch in range(start_epoch, epochs):
-                status, summary = self._run_epoch(train_data_fn, epoch)
-                if status == "preempted":
-                    return self.state
-                if self._post_epoch(summary, eval_data_fn, epoch,
-                                    save_every) == "preempted":
-                    return self.state
+          with ctx:
+              if eval_first and eval_data_fn is not None:
+                  self.evaluate(eval_data_fn(), epoch=start_epoch)
+              for epoch in range(start_epoch, epochs):
+                  status, summary = self._run_epoch(train_data_fn, epoch)
+                  if status == "preempted":
+                      return self.state
+                  if self._post_epoch(summary, eval_data_fn, epoch,
+                                      save_every) == "preempted":
+                      return self.state
         finally:
-            if prev_handler is not None:
-                import signal as _signal
-
-                _signal.signal(_signal.SIGTERM, prev_handler)
+            self._pguard = None
             if self._profiling:  # stop gate never reached (short run)
                 jax.profiler.stop_trace()
                 self._profiling = False
@@ -313,19 +307,6 @@ class Trainer:
                 host_state=self.ema.state_dict(),
             )
         return bool(saved)
-
-    def _preempt_agreed(self) -> bool:
-        """Did SIGTERM arrive — and do ALL hosts agree? Per-host flags are
-        raised at different instants; acting on a local flag alone would
-        have host A entering the checkpoint collective while host B enters
-        the next step's gradient all-reduce: distributed deadlock. The
-        allgather here is itself a collective every host joins at the same
-        step boundary, so the decision is globally consistent."""
-        if jax.process_count() == 1:
-            return self._preempt_requested
-        from deep_vision_tpu.parallel import multihost
-
-        return multihost.agree_flag(self._preempt_requested)
 
     def _preempt_save(self, epoch: int) -> None:
         """Synchronous best-effort checkpoint on the preemption path, honest
@@ -358,7 +339,7 @@ class Trainer:
                 int(self.state.step), metrics, batch_size=n, epoch=epoch,
                 lr=self.current_lr,
             )
-            if self._preempt_agreed():
+            if self._pguard is not None and self._pguard.agreed():
                 # no end_epoch: a partial-epoch summary would pollute the
                 # history/TensorBoard rows the re-run epoch writes again.
                 # epoch-1: this epoch is incomplete, resume re-runs it
@@ -387,13 +368,13 @@ class Trainer:
 
         # honor a SIGTERM that landed after the last step (or during eval,
         # which bails early): the epoch's training IS complete, save as such
-        if self._preempt_agreed():
+        if self._pguard is not None and self._pguard.agreed(force=True):
             self._preempt_save(epoch)
             return "preempted"
         val_summary = {}
         if eval_data_fn is not None:
             val_summary = self.evaluate(eval_data_fn(), epoch=epoch)
-        if self._preempt_agreed():
+        if self._pguard is not None and self._pguard.agreed(force=True):
             self._preempt_save(epoch)
             return "preempted"
 

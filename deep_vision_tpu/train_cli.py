@@ -601,11 +601,20 @@ def main(argv: Optional[List[str]] = None) -> int:
             start_epoch = trainer.restore(gan_ckpt)
             if start_epoch:
                 print(f"resumed GAN training at epoch {start_epoch}")
+        # preemption-safe like Trainer.fit, via the SAME mechanism
+        # (multihost.PreemptionGuard: SIGTERM handler + cross-host
+        # consensus at a deterministic cadence)
+        from deep_vision_tpu.parallel.multihost import PreemptionGuard
+
+        guard = PreemptionGuard()
+        guard.__enter__()
         for epoch in range(start_epoch, cfg.epochs):
             # keep per-step metrics as device arrays; float() only at epoch
             # end so the host never blocks async dispatch mid-epoch
             collected: list = []
             for batch in train_fn():
+                if guard.agreed():
+                    break
                 if cfg.task == "dcgan":
                     metrics = trainer.train_step(batch["image"])
                 else:
@@ -623,8 +632,19 @@ def main(argv: Optional[List[str]] = None) -> int:
                     )
                     for k in keys
                 ))
+            if guard.agreed(force=True):
+                # epoch incomplete: mid-epoch states saved under the global
+                # optimizer step, marked so resume re-runs this epoch
+                saved = trainer.save(gan_ckpt, epoch,
+                                     completed_epoch=epoch - 1)
+                gan_ckpt.wait()
+                print(f"preempted in epoch {epoch}: "
+                      + ("checkpoint written" if saved
+                         else "checkpoint DECLINED (nothing new to save)"))
+                break
             if (epoch + 1) % gan_save_every == 0:
                 trainer.save(gan_ckpt, epoch)
+        guard.__exit__(None, None, None)
         gan_ckpt.wait()
         _maybe_upload(args, ckpt_dir)
         return 0

@@ -113,3 +113,30 @@ def test_cyclegan_trainer_save_restore_roundtrip(tmp_path, mesh8):
     for x, y in zip(p1, p2):
         np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
     assert int(t2.gab.step) == int(t1.gab.step)
+
+
+def test_gan_preempt_save_marks_incomplete_epoch(tmp_path):
+    """save(..., completed_epoch=epoch-1) stores mid-epoch states under the
+    current epoch's step but resumes AT that epoch (the CLI preemption
+    path); works at epoch 0 too (no orbax step collision, resumes at 0)."""
+    import jax
+
+    from deep_vision_tpu.core import CheckpointManager
+    from deep_vision_tpu.models import get_model
+    from deep_vision_tpu.train.gan import DcganTrainer
+    from deep_vision_tpu.train.optimizers import build_optimizer
+
+    def make():
+        return DcganTrainer(
+            get_model("dcgan_generator"), get_model("dcgan_discriminator"),
+            build_optimizer("adam", 1e-4, b1=0.5),
+            build_optimizer("adam", 1e-4, b1=0.5),
+            rng=jax.random.PRNGKey(0),
+        )
+
+    ckpt = CheckpointManager(str(tmp_path))
+    t = make()
+    t.save(ckpt, 0, completed_epoch=-1)  # preempted during epoch 0
+    ckpt.wait()
+    t2 = make()
+    assert t2.restore(CheckpointManager(str(tmp_path))) == 0  # re-run epoch 0
