@@ -174,6 +174,9 @@ class ViT(nn.Module):
     num_experts: int = 0  # >0: MoE every other block (V-MoE "last-2"-ish)
     moe_every: int = 2
     dropout: float = 0.0
+    remat: bool = False  # rematerialize each block: activations are
+    # recomputed in backward instead of stored — O(sqrt) activation memory,
+    # the lever for long-token-count training (jax.checkpoint under flax)
     dtype: Optional[jnp.dtype] = None
 
     @nn.compact
@@ -198,6 +201,7 @@ class ViT(nn.Module):
         if self.dropout:
             x = nn.Dropout(self.dropout, deterministic=not train)(x)
         all_gates = []
+        block_cls = nn.remat(ViTBlock) if self.remat else ViTBlock
         for i in range(self.depth):
             moe = (
                 self.num_experts
@@ -205,9 +209,12 @@ class ViT(nn.Module):
                 and (i % self.moe_every == self.moe_every - 1)
                 else 0
             )
-            x, gates = ViTBlock(
+            # explicit name: nn.remat would auto-name the module
+            # remat(CheckpointViTBlock_i), breaking checkpoint
+            # interchangeability with the stored-activation variant
+            x, gates = block_cls(
                 self.num_heads, self.mlp_ratio, num_experts=moe,
-                dtype=self.dtype,
+                dtype=self.dtype, name=f"ViTBlock_{i}",
             )(x)
             if gates is not None:
                 all_gates.append(gates)
@@ -283,18 +290,19 @@ def pipeline_vit_trunk(model: ViT, variables, x, mesh, *,
 
 
 @register_model("vit_s16")
-def vit_s16(num_classes: int = 1000, dtype=None, **_):
+def vit_s16(num_classes: int = 1000, dtype=None, remat: bool = False, **_):
     return ViT(depth=12, dim=384, num_heads=6, num_classes=num_classes,
-               dtype=dtype)
+               remat=remat, dtype=dtype)
 
 
 @register_model("vit_b16")
-def vit_b16(num_classes: int = 1000, dtype=None, **_):
+def vit_b16(num_classes: int = 1000, dtype=None, remat: bool = False, **_):
     return ViT(depth=12, dim=768, num_heads=12, num_classes=num_classes,
-               dtype=dtype)
+               remat=remat, dtype=dtype)
 
 
 @register_model("vmoe_s16")
-def vmoe_s16(num_classes: int = 1000, dtype=None, num_experts: int = 8, **_):
+def vmoe_s16(num_classes: int = 1000, dtype=None, num_experts: int = 8,
+             remat: bool = False, **_):
     return ViT(depth=12, dim=384, num_heads=6, num_classes=num_classes,
-               num_experts=num_experts, dtype=dtype)
+               num_experts=num_experts, remat=remat, dtype=dtype)

@@ -268,16 +268,16 @@ class Trainer:
 
         ctx = self._pguard if self._pguard is not None else contextlib.nullcontext()
         try:
-          with ctx:
-              if eval_first and eval_data_fn is not None:
-                  self.evaluate(eval_data_fn(), epoch=start_epoch)
-              for epoch in range(start_epoch, epochs):
-                  status, summary = self._run_epoch(train_data_fn, epoch)
-                  if status == "preempted":
-                      return self.state
-                  if self._post_epoch(summary, eval_data_fn, epoch,
-                                      save_every) == "preempted":
-                      return self.state
+            with ctx:
+                if eval_first and eval_data_fn is not None:
+                    self.evaluate(eval_data_fn(), epoch=start_epoch)
+                for epoch in range(start_epoch, epochs):
+                    status, summary = self._run_epoch(train_data_fn, epoch)
+                    if status == "preempted":
+                        return self.state
+                    if self._post_epoch(summary, eval_data_fn, epoch,
+                                        save_every) == "preempted":
+                        return self.state
         finally:
             self._pguard = None
             if self._profiling:  # stop gate never reached (short run)

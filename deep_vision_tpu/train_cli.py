@@ -606,45 +606,48 @@ def main(argv: Optional[List[str]] = None) -> int:
         # consensus at a deterministic cadence)
         from deep_vision_tpu.parallel.multihost import PreemptionGuard
 
-        guard = PreemptionGuard()
-        guard.__enter__()
-        for epoch in range(start_epoch, cfg.epochs):
-            # keep per-step metrics as device arrays; float() only at epoch
-            # end so the host never blocks async dispatch mid-epoch
-            collected: list = []
-            for batch in train_fn():
-                if guard.agreed():
+        with PreemptionGuard() as guard:
+            for epoch in range(start_epoch, cfg.epochs):
+                # keep per-step metrics as device arrays; float() only at epoch
+                # end so the host never blocks async dispatch mid-epoch
+                collected: list = []
+                interrupted = False
+                for batch in train_fn():
+                    if guard.agreed():
+                        interrupted = True
+                        break
+                    if cfg.task == "dcgan":
+                        metrics = trainer.train_step(batch["image"])
+                    else:
+                        half = len(batch["image"]) // 2 or 1
+                        metrics = trainer.train_step(
+                            batch["image"][:half], batch["image"][half:half * 2]
+                        )
+                    collected.append(metrics)
+                if collected and not interrupted:
+                    # (suppressed on preemption: a partial-epoch summary would
+                    # duplicate the re-run epoch's row, as in Trainer.fit)
+                    collected = _jax.device_get(collected)  # one host round-trip
+                    keys = sorted(collected[0])
+                    print(f"epoch {epoch}: " + " ".join(
+                        "{}={:.4f}".format(
+                            k, sum(float(m[k]) for m in collected) / len(collected)
+                        )
+                        for k in keys
+                    ))
+                if guard.agreed(force=True):
+                    # interrupted: mid-epoch states saved under the global
+                    # optimizer step, marked so resume re-runs this epoch; a
+                    # loop that ran to completion saves the epoch as complete
+                    done = epoch if not interrupted else epoch - 1
+                    saved = trainer.save(gan_ckpt, epoch, completed_epoch=done)
+                    gan_ckpt.wait()
+                    print(f"preempted in epoch {epoch}: "
+                          + ("checkpoint written" if saved
+                             else "checkpoint DECLINED (nothing new to save)"))
                     break
-                if cfg.task == "dcgan":
-                    metrics = trainer.train_step(batch["image"])
-                else:
-                    half = len(batch["image"]) // 2 or 1
-                    metrics = trainer.train_step(
-                        batch["image"][:half], batch["image"][half:half * 2]
-                    )
-                collected.append(metrics)
-            if collected:
-                collected = _jax.device_get(collected)  # one host round-trip
-                keys = sorted(collected[0])
-                print(f"epoch {epoch}: " + " ".join(
-                    "{}={:.4f}".format(
-                        k, sum(float(m[k]) for m in collected) / len(collected)
-                    )
-                    for k in keys
-                ))
-            if guard.agreed(force=True):
-                # epoch incomplete: mid-epoch states saved under the global
-                # optimizer step, marked so resume re-runs this epoch
-                saved = trainer.save(gan_ckpt, epoch,
-                                     completed_epoch=epoch - 1)
-                gan_ckpt.wait()
-                print(f"preempted in epoch {epoch}: "
-                      + ("checkpoint written" if saved
-                         else "checkpoint DECLINED (nothing new to save)"))
-                break
-            if (epoch + 1) % gan_save_every == 0:
-                trainer.save(gan_ckpt, epoch)
-        guard.__exit__(None, None, None)
+                if (epoch + 1) % gan_save_every == 0:
+                    trainer.save(gan_ckpt, epoch)
         gan_ckpt.wait()
         _maybe_upload(args, ckpt_dir)
         return 0

@@ -32,6 +32,15 @@ def test_infer_classification_s2d_stem(jpg, capsys):
     assert "class" in capsys.readouterr().out
 
 
+def test_infer_vit(jpg, capsys):
+    """The attention family rides the same classification infer path."""
+    from deep_vision_tpu.tools.infer import main
+
+    rc = main(["-m", "vit_s16", jpg])
+    assert rc == 0
+    assert "class" in capsys.readouterr().out
+
+
 def test_infer_detection_writes_sidecar(jpg, tmp_path, capsys):
     from deep_vision_tpu.tools.infer import main
 

@@ -96,6 +96,30 @@ def test_moemlp_matches_moe_ffn_dense():
     )
 
 
+def test_remat_is_exact_and_checkpoint_compatible():
+    """remat=True recomputes activations in backward: identical outputs AND
+    gradients from the SAME param tree (variable paths pinned, so
+    checkpoints move freely between the two memory modes)."""
+    x = jnp.asarray(np.random.RandomState(0).rand(2, 32, 32, 3), jnp.float32)
+    a = _tiny()
+    b = ViT(depth=2, dim=32, num_heads=2, patch=8, num_classes=10,
+            remat=True)
+    va = a.init(jax.random.PRNGKey(0), x, train=False)
+
+    def loss(model, p):
+        return jnp.sum(model.apply({"params": p}, x, train=False) ** 2)
+
+    np.testing.assert_array_equal(
+        np.asarray(a.apply(va, x, train=False)),
+        np.asarray(b.apply(va, x, train=False)),
+    )
+    ga = jax.grad(lambda p: loss(a, p))(va["params"])
+    gb = jax.grad(lambda p: loss(b, p))(va["params"])
+    for u, v in zip(jax.tree_util.tree_leaves(ga),
+                    jax.tree_util.tree_leaves(gb)):
+        np.testing.assert_array_equal(np.asarray(u), np.asarray(v))
+
+
 def test_vit_registry_and_config():
     from deep_vision_tpu.configs import get_config
 
