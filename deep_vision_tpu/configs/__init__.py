@@ -38,6 +38,21 @@ class ExperimentConfig:
     eval_crop: int = 224
     train_resize: int = 256
 
+    def __post_init__(self):
+        # One LR policy per recipe, as in every reference config
+        # (ResNet/pytorch/train.py:26-215 picks either a torch scheduler OR
+        # plateau, never both). Allowing both would be a silent no-op:
+        # inject_hyperparams re-evaluates a scheduled LR every step,
+        # overwriting whatever absolute value the plateau wrote between
+        # epochs (train/trainer.py _set_lr).
+        if self.schedule is not None and self.plateau is not None:
+            raise ValueError(
+                f"config '{self.name}' sets both 'schedule' and 'plateau': "
+                "a scheduled learning rate is re-evaluated inside the jitted "
+                "step and would silently override plateau scaling — pick one "
+                "LR policy"
+            )
+
 
 CONFIG_REGISTRY: Dict[str, ExperimentConfig] = {}
 

@@ -163,11 +163,17 @@ def mpii_schema(feats: Dict[str, list]) -> dict:
     x = np.asarray(feats["image/person/keypoints/x"], np.float32)
     y = np.asarray(feats["image/person/keypoints/y"], np.float32)
     v = np.asarray(feats["image/person/keypoints/visibility"], np.float32)
-    return {
+    out = {
         "image": decode_image(feats["image/encoded"][0]),
         "keypoints": np.stack([x, y], axis=-1),
         "visibility": v,
     }
+    # MPII body height / 200, for CropRoi. ALWAYS present (0.0 = unknown,
+    # CropRoi falls back to the keypoint extent): a per-record key would
+    # break collate(), which stacks the first sample's keys across the batch
+    scale = feats.get("image/person/scale")
+    out["scale"] = float(scale[0]) if scale else 0.0
+    return out
 
 
 def image_only_schema(feats: Dict[str, list]) -> dict:

@@ -112,13 +112,30 @@ class CenterCrop:
         return sample
 
 
+# MPII joint order (0=r-ankle .. 9=head-top, 10=r-wrist .. 15=l-wrist):
+# pairs whose identities exchange under a horizontal flip. The reference
+# wrote a keypoint flip but disabled it with the comment "doesn't work with
+# human pose estimation because it's orientation sensitive"
+# (Hourglass/tensorflow/preprocess.py:31-40) — because it forgot exactly
+# this swap: mirroring moves the LEFT ankle to where the RIGHT ankle's
+# heatmap channel expects it. Swapping channel identities fixes that.
+MPII_FLIP_PAIRS = ((0, 5), (1, 4), (2, 3), (10, 15), (11, 14), (12, 13))
+
+
 class RandomHorizontalFlip:
     """p=0.5 flip (ResNet/pytorch/data_load.py:104-113). Flips normalized
     [x1,y1,x2,y2] 'boxes' too (random_flip_image_and_label,
-    YOLO/tensorflow/preprocess.py:37-50)."""
+    YOLO/tensorflow/preprocess.py:37-50).
 
-    def __init__(self, p: float = 0.5):
+    `keypoint_swap_pairs` (e.g. MPII_FLIP_PAIRS) additionally exchanges
+    left/right joint identities — required for pose: without it a flip
+    teaches every lateral channel the wrong side (the bug that made the
+    reference disable its flip, preprocess.py:31-33)."""
+
+    def __init__(self, p: float = 0.5,
+                 keypoint_swap_pairs: Optional[Sequence] = None):
         self.p = p
+        self.swap_pairs = keypoint_swap_pairs
 
     def __call__(self, sample: dict, rng: np.random.Generator) -> dict:
         if rng.random() >= self.p:
@@ -134,7 +151,77 @@ class RandomHorizontalFlip:
         if "keypoints" in sample and len(sample["keypoints"]):
             k = np.array(sample["keypoints"], np.float32)
             k[:, 0] = 1.0 - k[:, 0]
+            if self.swap_pairs is not None:
+                perm = np.arange(len(k))
+                for a, b_ in self.swap_pairs:
+                    perm[a], perm[b_] = b_, a
+                k = k[perm]
+                if "visibility" in sample:
+                    sample["visibility"] = np.asarray(
+                        sample["visibility"], np.float32
+                    )[perm]
             sample["keypoints"] = k
+        return sample
+
+
+class CropRoi:
+    """Keypoint-driven person crop for pose training
+    (crop_roi, Hourglass/tensorflow/preprocess.py:43-88).
+
+    The visible-keypoint extent, padded by `margin x body height`, is cut
+    out before the square resize — so the person fills the frame instead of
+    being a small figure in a wide shot. Body height comes from the MPII
+    person 'scale' annotation (scale x 200 px, the MPII convention) when
+    the sample carries it, else from the visible keypoint extent itself.
+
+    `margin` may be a float (eval: the reference's fixed 0.2) or a (lo, hi)
+    range sampled per image (train: the reference's U(0.1, 0.3) — its scale
+    augmentation). Keypoints are remapped to crop-relative normalized
+    coordinates, invisible (-1) joints ride along and land outside [0, 1],
+    where the heatmap scatter already drops them (data/labels.py).
+    """
+
+    def __init__(self, margin=0.2):
+        self.margin = margin
+
+    def __call__(self, sample: dict, rng: np.random.Generator) -> dict:
+        image = sample["image"]
+        h, w = image.shape[:2]
+        kp = np.asarray(sample["keypoints"], np.float32)  # (J, 2) normalized
+        vis = np.asarray(
+            sample.get("visibility", np.ones((len(kp),))), np.float32
+        )
+        kx, ky = kp[:, 0] * w, kp[:, 1] * h
+        visible = vis > 0
+        if not visible.any():
+            return sample  # nothing to anchor the crop on
+        if isinstance(self.margin, (tuple, list)):
+            margin = float(rng.uniform(self.margin[0], self.margin[1]))
+        else:
+            margin = float(self.margin)
+        xmin, xmax = kx[visible].min(), kx[visible].max()
+        ymin, ymax = ky[visible].min(), ky[visible].max()
+        if sample.get("scale", 0) and float(sample["scale"]) > 0:
+            body_h = float(sample["scale"]) * 200.0  # MPII scale convention
+        else:  # scale 0.0 = unknown (older preprocessed jsons)
+            body_h = max(ymax - ymin, 1.0)
+        pad = margin * body_h
+        # clamp the top-left INSIDE the image: keypoints may sit outside the
+        # frame (unclamped annotations), and an x1 >= w would make the
+        # x2 = x1+1 fixup produce an empty slice that kills Resize downstream
+        x1 = min(max(int(xmin - pad), 0), w - 1)
+        y1 = min(max(int(ymin - pad), 0), h - 1)
+        x2 = min(int(xmax + pad), w)
+        y2 = min(int(ymax + pad), h)
+        x2, y2 = max(x2, x1 + 1), max(y2, y1 + 1)
+        sample["image"] = image[y1:y2, x1:x2]
+        nh, nw = y2 - y1, x2 - x1
+        out = kp.copy()
+        out[:, 0] = (kx - x1) / nw
+        out[:, 1] = (ky - y1) / nh
+        # a visible joint cropped out (tight margin) must not scatter a
+        # wrong-position gaussian: the [0,1] range check downstream drops it
+        sample["keypoints"] = out
         return sample
 
 

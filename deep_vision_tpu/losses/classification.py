@@ -60,6 +60,14 @@ def classification_loss_fn(
             continue
         if isinstance(aux, dict):
             for name, value in aux.items():
+                # reserved keys are written below and would silently
+                # swallow the penalty's metric (the penalty itself would
+                # still be added to the loss — a confusing half-effect)
+                if name in ("loss", "top1", "top5"):
+                    raise ValueError(
+                        f"aux penalty name {name!r} collides with a reserved "
+                        "metric key; rename it (e.g. 'aux_" + name + "')"
+                    )
                 loss = loss + penalty_weight * value
                 metrics[name] = value
         else:

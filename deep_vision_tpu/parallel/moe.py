@@ -63,8 +63,18 @@ def _moe_local(router_w, expert_params, x, *, axis_name: str, capacity: int,
     """Per-device body (under shard_map). x: (T_loc, D) local tokens."""
     n = jax.lax.psum(1, axis_name)
     e_loc = n_experts // n
-    gates = jax.nn.softmax(x @ router_w)  # (T_loc, E) — router replicated
+    # route in f32 regardless of activation dtype (matching models/vit.py
+    # MoeMlp): softmax + argmax over logits are precision-sensitive, and a
+    # bf16 near-tie argmaxing to a different expert here than in the
+    # in-model path would break checkpoint-deploy equivalence. The f32
+    # gates feed dispatch (argmax inside); the resulting one-hot tensors
+    # are cast back so expert compute stays in the activation dtype.
+    gates = jax.nn.softmax(
+        x.astype(jnp.float32) @ router_w.astype(jnp.float32)
+    )  # (T_loc, E) — router replicated
     dispatch, combine = _top1_dispatch(gates, capacity)
+    dispatch = dispatch.astype(x.dtype)
+    combine = combine.astype(x.dtype)
     # pack: (E, C, D) expert inputs from the local tokens
     expert_in = jnp.einsum("tec,td->ecd", dispatch, x)
     # all_to_all #1: split the global-expert dim across devices, concat the
@@ -165,9 +175,11 @@ def moe_ffn_dense(router_w, expert_params, x, *,
     No capacity limit — equals `moe_ffn` exactly when capacity >= the
     busiest expert's per-device load.
     """
-    gates = jax.nn.softmax(x @ router_w)  # (T, E)
+    gates = jax.nn.softmax(
+        x.astype(jnp.float32) @ router_w.astype(jnp.float32)
+    )  # (T, E) — f32 routing + argmax, as _moe_local / MoeMlp
     choice = jnp.argmax(gates, axis=-1)
-    prob = jnp.take_along_axis(gates, choice[:, None], axis=-1)
+    prob = jnp.take_along_axis(gates, choice[:, None], axis=-1).astype(x.dtype)
     all_out = jax.vmap(expert_fn, in_axes=(0, None))(expert_params, x)
     # (E, T, D) -> pick each token's expert
     picked = jnp.take_along_axis(

@@ -114,11 +114,20 @@ class PreemptionGuard:
     way to act on the flag in multi-host runs: hosts receive SIGTERM at
     different instants, and a host acting on its local flag alone would
     enter a checkpoint collective while another enters the next step's
-    all-reduce — distributed deadlock. `agreed()` polls a cross-host OR
-    (`agree_flag`) every `poll_every` calls — a deterministic cadence, so
-    every host rendezvouses at the same call boundary — and always when
-    `force=True` (epoch/eval boundaries). The agreed answer is sticky.
-    Single-process: returns the local flag directly, no collectives.
+    all-reduce — distributed deadlock. `agreed(step=...)` polls a
+    cross-host OR (`agree_flag`) when `step % poll_every == 0` — the
+    optimizer step is globally consistent (it advances in the SPMD train
+    step every host runs), so hosts rendezvous at the same boundary even if
+    they make different numbers of agreed() calls overall (uneven data
+    shards, an eval iterator ending early on one host). It also polls
+    whenever `force=True` (epoch/eval boundaries). The agreed answer is
+    sticky. Single-process: returns the local flag directly, no collectives.
+
+    Callers that cannot supply a step may omit it, falling back to a local
+    call counter — that cadence is only deadlock-free if EVERY host makes
+    the same number of agreed() calls, which the caller must then guarantee
+    (one call per jitted step, identical batch counts via drop_remainder
+    sharded loading).
 
     `poll_every` trades detection latency for hot-loop sync: SIGTERM gives
     ~30s of grace, so polling every 10 steps costs nothing in practice
@@ -154,14 +163,18 @@ class PreemptionGuard:
             self._prev_handler = None
         return False
 
-    def agreed(self, force: bool = False) -> bool:
+    def agreed(self, step: Optional[int] = None, *, force: bool = False) -> bool:
         if self._agreed:
             return True
         if jax.process_count() == 1:
             self._agreed = self.requested
             return self._agreed
-        self._calls += 1
-        if force or self._calls % self.poll_every == 0:
+        if step is not None:
+            due = int(step) % self.poll_every == 0
+        else:
+            self._calls += 1
+            due = self._calls % self.poll_every == 0
+        if force or due:
             self._agreed = agree_flag(self.requested)
         return self._agreed
 

@@ -222,6 +222,11 @@ def mpii_annotations(json_path: str, images_dir: str) -> List[dict]:
                 "filepath": os.path.join(images_dir, p["image"]),
                 "joints": p["joints"],  # [[x, y] * 16] absolute
                 "joints_vis": p["joints_vis"],
+                # MPII person center/scale (scale x 200 px = body height),
+                # consumed by the CropRoi transform; optional in older
+                # preprocessed jsons
+                "center": p.get("center"),
+                "scale": p.get("scale"),
             }
         )
     return annos
@@ -238,7 +243,7 @@ def mpii_example(anno: dict) -> Optional[dict]:
     xs = [float(j[0]) / w for j in anno["joints"]]
     ys = [float(j[1]) / h for j in anno["joints"]]
     vis = [int(v) for v in anno["joints_vis"]]
-    return {
+    ex = {
         "image/height": [h],
         "image/width": [w],
         "image/person/keypoints/x": xs,
@@ -247,6 +252,17 @@ def mpii_example(anno: dict) -> Optional[dict]:
         "image/encoded": [content],
         "image/filename": [anno["filename"].encode()],
     }
+    # person scale (image/object/scale at Datasets/MPII/tfrecords_mpii.py):
+    # drives the CropRoi body-height pad (scale x 200 px). center is written
+    # for record-schema parity with the reference only — its crop_roi reads
+    # but never uses it (preprocess.py:52-53), and neither does CropRoi.
+    if anno.get("scale") is not None:
+        ex["image/person/scale"] = [float(anno["scale"])]
+    if anno.get("center") is not None:
+        cx, cy = anno["center"]
+        ex["image/person/center/x"] = [float(cx) / w]
+        ex["image/person/center/y"] = [float(cy) / h]
+    return ex
 
 
 # -- ImageNet ----------------------------------------------------------------

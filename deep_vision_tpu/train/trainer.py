@@ -116,6 +116,25 @@ class Trainer:
             self._base_lr = float(state.opt_state.hyperparams["learning_rate"])
         except (AttributeError, KeyError, TypeError):
             self._base_lr = None
+        if self.plateau is not None:
+            # a scheduled LR (inject_hyperparams re-evaluates it every step)
+            # would silently overwrite the plateau's absolute writes — refuse
+            # the combination here too, for trainers built without the config
+            # registry's validation
+            hp_states = getattr(state.opt_state, "hyperparams_states", None)
+            if hp_states and "learning_rate" in hp_states:
+                raise ValueError(
+                    "plateau scaling requires a constant base learning rate: "
+                    "the optimizer's learning_rate is a schedule, which is "
+                    "re-evaluated inside the jitted step and would override "
+                    "plateau writes — use one LR policy"
+                )
+            if self._base_lr is None:
+                raise ValueError(
+                    "plateau scaling requires opt_state.hyperparams"
+                    "['learning_rate'] (build the optimizer via "
+                    "train.optimizers.build_optimizer)"
+                )
 
         # Sanitizer mode (SURVEY §2.7: the functional-runtime analog of race
         # detectors/ASAN the reference never had): jax.experimental.checkify
@@ -235,8 +254,11 @@ class Trainer:
         step = 0
         for batch in eval_data:
             # consensus (not the local flag): in multi-host runs every host
-            # must leave the eval collectives at the same batch boundary
-            if self._pguard is not None and self._pguard.agreed():
+            # must leave the eval collectives at the same batch boundary.
+            # Keyed on the eval-batch index, which is host-identical because
+            # the SPMD eval_step itself already requires every host to make
+            # the same sequence of calls.
+            if self._pguard is not None and self._pguard.agreed(step):
                 break  # caller re-checks with force=True and checkpoints
             n = np.asarray(batch[self.input_key]).shape[0]
             metrics = self.eval_step(batch)
@@ -335,11 +357,14 @@ class Trainer:
         for batch in train_data_fn():
             n = np.asarray(batch[self.input_key]).shape[0]
             metrics = self.train_step(batch)
+            opt_step = int(self.state.step)
             self.logger.log_step(
-                int(self.state.step), metrics, batch_size=n, epoch=epoch,
+                opt_step, metrics, batch_size=n, epoch=epoch,
                 lr=self.current_lr,
             )
-            if self._pguard is not None and self._pguard.agreed():
+            # poll keyed to the optimizer step — globally consistent across
+            # hosts, immune to unequal agreed() call counts elsewhere
+            if self._pguard is not None and self._pguard.agreed(opt_step):
                 # no end_epoch: a partial-epoch summary would pollute the
                 # history/TensorBoard rows the re-run epoch writes again.
                 # epoch-1: this epoch is incomplete, resume re-runs it

@@ -233,9 +233,17 @@ def build_dataloaders(cfg: ExperimentConfig, data_dir: str, fake: bool,
                            T.Resize(size), T.ToFloat(), T.PadBoxes(100)]
             eval_chain = [T.Resize(size), T.ToFloat(), T.PadBoxes(100)]
         elif cfg.task == "pose":
-            train_chain = [T.Resize(size), T.ToFloat(),
+            # keypoint-driven person crop + the reference's scale
+            # augmentation (random margin, preprocess.py:18-20) + the
+            # CORRECTED left/right-swapping flip its disabled version lacked
+            train_chain = [T.CropRoi(margin=(0.1, 0.3)),
+                           T.RandomHorizontalFlip(
+                               keypoint_swap_pairs=T.MPII_FLIP_PAIRS),
+                           T.Resize(size), T.ToFloat(),
                            MakePoseHeatmaps(num_joints=cfg.num_classes)]
-            eval_chain = train_chain
+            eval_chain = [T.CropRoi(margin=0.2),  # fixed margin, as eval
+                          T.Resize(size), T.ToFloat(),
+                          MakePoseHeatmaps(num_joints=cfg.num_classes)]
         elif cfg.task == "centernet":
             targets = MakeCenternetTargets(size // 4, cfg.num_classes)
             train_chain = [T.RandomHorizontalFlip(), T.Resize(size),
@@ -612,8 +620,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                 # end so the host never blocks async dispatch mid-epoch
                 collected: list = []
                 interrupted = False
-                for batch in train_fn():
-                    if guard.agreed():
+                # poll keyed to the batch index — host-identical (sharded
+                # drop_remainder loaders yield equal counts), so every host
+                # rendezvouses at the same boundary
+                for batch_i, batch in enumerate(train_fn()):
+                    if guard.agreed(batch_i):
                         interrupted = True
                         break
                     if cfg.task == "dcgan":

@@ -7,6 +7,8 @@ from deep_vision_tpu.configs import CONFIG_REGISTRY, get_config
 from deep_vision_tpu.models import get_model
 from deep_vision_tpu.train_cli import build_dataloaders, build_trainer, main
 
+pytestmark = pytest.mark.slow  # jit-heavy: excluded from the fast tier (`-m "not slow"`)
+
 
 def test_every_config_resolves_to_a_model():
     # parity check: the registry covers the union of the reference's

@@ -1,6 +1,8 @@
 """Artifact-upload hook (the Hourglass GCS cloud-run analog), local backend."""
 import os
 
+import pytest
+
 from deep_vision_tpu.tools.cloud import upload_artifact
 
 
@@ -24,6 +26,7 @@ def test_upload_directory_recursive(tmp_path):
     assert os.path.exists(os.path.join(uri, "00000010", "state.msgpack"))
 
 
+@pytest.mark.slow
 def test_cli_upload_after_training(tmp_path, capsys):
     from deep_vision_tpu.train_cli import main
 

@@ -100,6 +100,8 @@ def test_mpii_convert_roundtrip(tmp_path):
         "image": "p.jpg",
         "joints": [[10 * j, 2 * j] for j in range(16)],
         "joints_vis": [1] * 8 + [0] * 8,
+        "center": [50, 25],
+        "scale": 1.25,
     }]
     jpath = tmp_path / "train.json"
     jpath.write_text(json.dumps(people))
@@ -112,6 +114,8 @@ def test_mpii_convert_roundtrip(tmp_path):
     assert s["keypoints"].shape == (16, 2)
     np.testing.assert_allclose(s["keypoints"][2], [20 / 100, 4 / 50], atol=1e-6)
     assert s["visibility"].tolist() == [1.0] * 8 + [0.0] * 8
+    # person scale survives the round trip (feeds CropRoi's body-height pad)
+    assert abs(s["scale"] - 1.25) < 1e-6
 
 
 def test_imagenet_convert_roundtrip(tmp_path):

@@ -419,6 +419,7 @@ class TestProcessLoader:
         seen = [s["label"] for p in parts for s in p]
         assert sorted(seen) == sorted(s["label"] for s in full)
 
+    @pytest.mark.slow
     def test_num_procs_loader_yields_everything(self, tmp_path):
         from deep_vision_tpu.data import DataLoader, RecordDataset
 
@@ -446,3 +447,78 @@ def _label_schema(feats):
 def _add_one(sample, rng):
     sample["label"] = sample["label"] + 1
     return sample
+
+
+class TestCropRoi:
+    """Golden tests vs hand-computed crops (crop_roi parity,
+    Hourglass/tensorflow/preprocess.py:43-88)."""
+
+    def _sample(self, h=100, w=200):
+        # two visible joints at px (50, 20) and (150, 80); one invisible
+        kp = np.array([[50 / 200, 20 / 100],
+                       [150 / 200, 80 / 100],
+                       [-1 / 200, -1 / 100]], np.float32)
+        vis = np.array([1.0, 1.0, 0.0], np.float32)
+        img = np.arange(h * w * 3, dtype=np.uint8).reshape(h, w, 3)
+        return {"image": img, "keypoints": kp, "visibility": vis}
+
+    def test_hand_computed_crop_with_scale(self):
+        s = self._sample()
+        s["scale"] = 0.5  # body height = 100 px -> pad = 0.2 * 100 = 20 px
+        out = T.CropRoi(margin=0.2)(s, np.random.default_rng(0))
+        # extent x:[50,150] y:[20,80]; padded x:[30,170] y:[0,100]
+        assert out["image"].shape == (100, 140, 3)
+        # keypoint 0 remaps to ((50-30)/140, (20-0)/100)
+        np.testing.assert_allclose(
+            out["keypoints"][0], [20 / 140, 20 / 100], atol=1e-6)
+        np.testing.assert_allclose(
+            out["keypoints"][1], [120 / 140, 80 / 100], atol=1e-6)
+        # invisible joint rides along, lands outside [0,1]
+        assert out["keypoints"][2, 0] < 0
+
+    def test_extent_fallback_without_scale(self):
+        s = self._sample()
+        out = T.CropRoi(margin=0.2)(s, np.random.default_rng(0))
+        # body height = ymax - ymin = 60 -> pad 12: x:[38,162] y:[8,92]
+        assert out["image"].shape == (84, 124, 3)
+
+    def test_margin_range_is_sampled(self):
+        shapes = set()
+        for seed in range(8):
+            s = self._sample()
+            s["scale"] = 0.5
+            out = T.CropRoi(margin=(0.1, 0.3))(s, np.random.default_rng(seed))
+            shapes.add(out["image"].shape)
+        assert len(shapes) > 1  # random margin really varies the crop
+
+    def test_no_visible_joints_is_noop(self):
+        s = self._sample()
+        s["visibility"] = np.zeros((3,), np.float32)
+        out = T.CropRoi(margin=0.2)(s, np.random.default_rng(0))
+        assert out["image"].shape == (100, 200, 3)
+
+    def test_crop_pixels_match_slice(self):
+        s = self._sample()
+        s["scale"] = 0.5
+        orig = s["image"].copy()
+        out = T.CropRoi(margin=0.2)(s, np.random.default_rng(0))
+        np.testing.assert_array_equal(out["image"], orig[0:100, 30:170])
+
+
+def test_pose_flip_swaps_left_right_identities():
+    """Mirroring moves the left ankle to the right ankle's position; the
+    channel identities must swap with it (the bug that made the reference
+    disable its flip, preprocess.py:31-40)."""
+    kp = np.zeros((16, 2), np.float32)
+    kp[0] = [0.2, 0.9]   # r ankle
+    kp[5] = [0.8, 0.9]   # l ankle
+    vis = np.zeros((16,), np.float32)
+    vis[0], vis[5] = 1.0, 2.0
+    s = {"image": np.zeros((8, 8, 3), np.uint8), "keypoints": kp,
+         "visibility": vis}
+    out = T.RandomHorizontalFlip(p=1.0, keypoint_swap_pairs=T.MPII_FLIP_PAIRS)(
+        s, np.random.default_rng(0))
+    # old l-ankle (0.8 -> flipped 0.2) is now channel 0 (r ankle)
+    np.testing.assert_allclose(out["keypoints"][0], [0.2, 0.9], atol=1e-6)
+    np.testing.assert_allclose(out["keypoints"][5], [0.8, 0.9], atol=1e-6)
+    assert out["visibility"][0] == 2.0 and out["visibility"][5] == 1.0

@@ -8,6 +8,9 @@ from deep_vision_tpu.losses import classification_loss_fn
 from deep_vision_tpu.models import get_model
 from deep_vision_tpu.train import Trainer, build_optimizer
 from deep_vision_tpu.train.ema import EmaParams
+import pytest
+
+pytestmark = pytest.mark.slow  # jit-heavy: excluded from the fast tier (`-m "not slow"`)
 
 
 def test_ema_math_matches_reference():

@@ -8,6 +8,8 @@ from deep_vision_tpu.models.cyclegan import CycleGanGenerator, PatchGanDiscrimin
 from deep_vision_tpu.train.gan import CycleGanTrainer, DcganTrainer, ImagePool
 from deep_vision_tpu.train.optimizers import build_optimizer
 
+pytestmark = pytest.mark.slow  # jit-heavy: excluded from the fast tier (`-m "not slow"`)
+
 
 def test_image_pool_semantics():
     pool = ImagePool(size=4, seed=0)

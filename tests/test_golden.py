@@ -16,6 +16,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # jit-heavy: excluded from the fast tier (`-m "not slow"`)
+
 # First-step losses recorded on the 8-device virtual CPU mesh (jax 0.9.0,
 # f32). XLA-CPU convolution reductions are thread-order nondeterministic
 # (~5e-3 relative), and SGD chaos amplifies that over steps, so the golden is

@@ -5,6 +5,8 @@ import cv2
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # jit-heavy: excluded from the fast tier (`-m "not slow"`)
+
 
 @pytest.fixture()
 def jpg(tmp_path):

@@ -8,7 +8,10 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # jit-heavy: excluded from the fast tier (`-m "not slow"`)
+
 from deep_vision_tpu.core.detection_metrics import (
+
     DetectionEvaluator,
     pck,
     pckh,

@@ -134,3 +134,12 @@ def test_centernet_loss_fn_complete():
     assert np.isfinite(float(loss))
     assert metrics["wh_loss"] == pytest.approx(5.0)  # |2|+|3| over 1 object
     assert metrics["offset_loss"] == pytest.approx(1.0)  # 0.3+0.7
+
+
+def test_aux_penalty_name_collision_raises():
+    """Reserved metric keys would silently swallow an aux penalty's metric
+    while still adding it to the loss (ADVICE r2) — refuse loudly."""
+    logits = jnp.zeros((4, 8))
+    batch = {"label": np.zeros((4,), np.int32)}
+    with pytest.raises(ValueError, match="reserved"):
+        classification_loss_fn((logits, {"loss": jnp.float32(1.0)}), batch)

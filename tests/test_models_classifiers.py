@@ -5,6 +5,8 @@ import pytest
 
 from deep_vision_tpu.models import get_model
 
+pytestmark = pytest.mark.slow  # jit-heavy: excluded from the fast tier (`-m "not slow"`)
+
 RNG = jax.random.PRNGKey(0)
 
 

@@ -9,6 +9,8 @@ from deep_vision_tpu.ops.pallas.flash_attention import (
     flash_attention,
 )
 
+pytestmark = pytest.mark.slow  # jit-heavy: excluded from the fast tier (`-m "not slow"`)
+
 
 def _qkv(b=2, t=64, h=2, d=32, seed=0, tk=None):
     rng = np.random.RandomState(seed)

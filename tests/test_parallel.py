@@ -11,6 +11,8 @@ from deep_vision_tpu.parallel.ring_attention import (
 )
 from deep_vision_tpu.parallel import multihost
 
+pytestmark = pytest.mark.slow  # jit-heavy: excluded from the fast tier (`-m "not slow"`)
+
 
 def _qkv(b=2, t=32, h=4, d=16, seed=0):
     rng = np.random.RandomState(seed)

@@ -5,12 +5,15 @@ import numpy as np
 import pytest
 
 from deep_vision_tpu.parallel.mesh import create_mesh
+
+pytestmark = pytest.mark.slow  # jit-heavy: excluded from the fast tier (`-m "not slow"`)
 from deep_vision_tpu.parallel.moe import (
     expert_param_sharding,
     moe_ffn,
     moe_ffn_dense,
 )
 from deep_vision_tpu.parallel.pipeline import (
+
     pipeline_apply,
     pipeline_param_sharding,
     stack_pipeline_params,
@@ -168,3 +171,21 @@ class TestMoe:
         router_w, ep, x = _moe_fixture(e=6, seed=3)
         with pytest.raises(ValueError, match="divisible"):
             moe_ffn(router_w, ep, x, mesh8, capacity=4)
+
+    def test_bf16_routing_matches_f32_expert_choice(self, mesh8):
+        """Router runs in f32 even for bf16 activations (ADVICE r2): the
+        expert-parallel path and the dense in-model path must pick the SAME
+        experts, or a vmoe checkpoint deploys differently via moe_ffn."""
+        router_w, ep, x = _moe_fixture(seed=7)
+        xb = x.astype(jnp.bfloat16)
+        ep_b = jax.tree_util.tree_map(lambda p: p.astype(jnp.bfloat16), ep)
+        ep_sh = jax.device_put(ep_b, expert_param_sharding(mesh8, ep_b))
+        out = moe_ffn(router_w, ep_sh, xb, mesh8, capacity=32)
+        ref = moe_ffn_dense(router_w, ep_b, xb)
+        assert out.dtype == jnp.bfloat16
+        # identical expert selection => differences are bf16 rounding only;
+        # a routing mismatch would swap whole expert outputs (O(1) error)
+        np.testing.assert_allclose(
+            np.asarray(out, np.float32), np.asarray(ref, np.float32),
+            rtol=0.1, atol=0.05,
+        )

@@ -98,6 +98,7 @@ def test_partial_batch_padded_and_masked(lenet_trainer):
     assert float(part["top1"]) == pytest.approx(1.0)
 
 
+@pytest.mark.slow
 def test_fit_with_plateau_and_eval(mesh8, tmp_path):
     model = get_model("lenet5", num_classes=4)
     tx = build_optimizer("sgd", 0.05, momentum=0.9)
@@ -119,6 +120,7 @@ def test_fit_with_plateau_and_eval(mesh8, tmp_path):
     assert len(trainer.eval_logger.history["top1"]) == 3  # eval_first + 2 epochs
 
 
+@pytest.mark.slow
 def test_fit_raises_on_diverged_loss(mesh8):
     """Failure detection: a NaN epoch must stop the run loudly (SURVEY §5)."""
     import jax.numpy as jnp
@@ -135,6 +137,7 @@ def test_fit_raises_on_diverged_loss(mesh8):
         trainer.fit(lambda: batches(images, labels, 32), epochs=3)
 
 
+@pytest.mark.slow
 def test_checkify_mode_locates_nan_in_step(mesh8):
     """Sanitizer mode (SURVEY §2.7): checkify raises a located error on the
     first poisoned op inside the jitted step, instead of finishing the epoch
@@ -159,6 +162,7 @@ def test_checkify_mode_locates_nan_in_step(mesh8):
         trainer.train_step({"image": bad, "label": labels[:32]})
 
 
+@pytest.mark.slow
 def test_preemption_checkpoints_and_resumes(mesh8, tmp_path):
     """Elastic recovery (SURVEY §2.7 upstream: 'recovery = manual resume'):
     SIGTERM mid-epoch finishes the in-flight step, writes a checkpoint, and
@@ -200,6 +204,7 @@ def test_preemption_checkpoints_and_resumes(mesh8, tmp_path):
     assert int(trainer2.state.step) == saved_step + 2 * 8
 
 
+@pytest.mark.slow
 def test_preemption_during_eval_saves_completed_epoch(mesh8, tmp_path):
     """SIGTERM mid-eval: eval bails early, the finished training epoch is
     checkpointed as complete, and resume continues at the NEXT epoch."""
@@ -232,3 +237,52 @@ def test_preemption_during_eval_saves_completed_epoch(mesh8, tmp_path):
     trainer2 = make()
     assert trainer2.resume() == 1  # epoch 0 is complete; eval is re-runnable
     assert int(trainer2.state.step) == 8
+
+
+def test_schedule_plus_plateau_rejected(mesh8):
+    """One LR policy per recipe (VERDICT r2 weak #6): a scheduled LR is
+    re-evaluated inside the jitted step and silently overrides plateau
+    writes, so the combination is refused at construction."""
+    from deep_vision_tpu.configs import ExperimentConfig
+    from deep_vision_tpu.train.optimizers import make_schedule
+
+    with pytest.raises(ValueError, match="schedule.*plateau|plateau"):
+        ExperimentConfig(
+            name="bad", task="classification", model="lenet5",
+            schedule={"kind": "step", "step_size_epochs": 10},
+            plateau={"factor": 0.1},
+        )
+
+    model = get_model("lenet5", num_classes=4)
+    tx = build_optimizer(
+        "sgd", make_schedule("step", 0.1, step_size=10), momentum=0.9
+    )
+    with pytest.raises(ValueError, match="schedule"):
+        Trainer(
+            model, tx, classification_loss_fn,
+            sample_input=jnp.zeros((8, 32, 32, 1)),
+            mesh=mesh8, plateau=ReduceLROnPlateau(),
+        )
+
+
+@pytest.mark.slow
+def test_current_lr_tracks_schedule(mesh8):
+    """The logged LR must be the schedule's current value, not NaN
+    (VERDICT r2 weak #6): inject_hyperparams re-evaluates scheduled
+    hyperparams each step and current_lr reads the live value."""
+    from deep_vision_tpu.train.optimizers import make_schedule
+
+    model = get_model("lenet5", num_classes=4)
+    sched = make_schedule("step", 0.1, step_size=2, gamma=0.5)
+    tx = build_optimizer("sgd", sched, momentum=0.9)
+    tr = Trainer(
+        model, tx, classification_loss_fn,
+        sample_input=jnp.zeros((8, 32, 32, 1)), mesh=mesh8,
+    )
+    assert np.isclose(tr.current_lr, 0.1)
+    images, labels = synthetic_mnist(n=64)
+    for batch in batches(images, labels, 16):
+        tr.train_step(batch)
+    # 4 steps at gamma=0.5, step_size=2: steps 0-1 ran at 0.1, steps 2-3 at
+    # 0.05; current_lr reads the LR the LAST applied update used
+    assert np.isclose(tr.current_lr, 0.05), tr.current_lr

@@ -6,6 +6,9 @@ import numpy as np
 from deep_vision_tpu.losses.classification import classification_loss_fn
 from deep_vision_tpu.models import get_model
 from deep_vision_tpu.models.vit import ViT
+import pytest
+
+pytestmark = pytest.mark.slow  # jit-heavy: excluded from the fast tier (`-m "not slow"`)
 
 
 def _tiny(num_experts=0):
