@@ -259,10 +259,15 @@ def _recover_backend(attempt: int) -> None:
             _log(f"clear_caches failed ({type(e).__name__}: {e})")
 
 
-def _cost_analysis(step, multistep: int, batch_size: int):
-    """(flops_per_step, bytes_per_step, source) from the compiled step's
-    cost analysis; analytic fallback for flops, None for bytes, if
-    unsupported. `step` is the AOT-compiled executable from build_bench."""
+def _cost_analysis(step, multistep: int, batch_per_chip: int):
+    """(flops_per_step_per_chip, bytes_per_step_per_chip, source).
+
+    XLA's compiled cost analysis reports PER-DEVICE numbers under SPMD
+    (verified: an 8-way sharded matmul reports 1/8 of the global flops), so
+    everything here is per chip; divide by `batch_per_chip` — NOT the
+    global batch — for per-image figures. Analytic fallback for flops,
+    None for bytes, if unsupported. `step` is the AOT-compiled executable
+    from build_bench."""
     try:
         ca = step.cost_analysis()
         if isinstance(ca, (list, tuple)):  # older jax returns [dict]
@@ -275,7 +280,7 @@ def _cost_analysis(step, multistep: int, batch_size: int):
     except Exception as e:
         _log(f"cost analysis unavailable ({type(e).__name__}: {e}); "
              "using analytic flops")
-    return RESNET50_TRAIN_FLOPS_PER_IMAGE * batch_size, None, "analytic"
+    return RESNET50_TRAIN_FLOPS_PER_IMAGE * batch_per_chip, None, "analytic"
 
 
 def _peak_flops(device_kind: str) -> float:
@@ -374,22 +379,26 @@ def main(args) -> None:
         result["vs_baseline"] = round(wall_per_chip / TARGET_PER_CHIP, 3)
 
         # MFU / HBM traffic from XLA's post-fusion cost analysis (falls back
-        # to analytic ResNet-50 flops). Bytes accessed post-fusion ~= HBM
-        # traffic; v5e HBM bw is 819 GB/s.
+        # to analytic ResNet-50 flops). All per-chip: cost analysis is
+        # per-device under SPMD and wall_per_chip is the per-chip rate.
+        # Bytes accessed post-fusion ~= HBM traffic; v5e HBM bw is 819 GB/s.
+        batch_per_chip = batch_size // n_chips
         flops_per_step, bytes_per_step, src = _cost_analysis(
-            step, args.multistep, batch_size
+            step, args.multistep, batch_per_chip
         )
         peak = _peak_flops(devices[0].device_kind)
-        flops_per_image = flops_per_step / batch_size
+        flops_per_image = flops_per_step / batch_per_chip
         result["model_flops_per_image"] = round(flops_per_image / 1e9, 2)
         result["flops_source"] = src
         result["mfu_wall_pct"] = round(
             100 * wall_per_chip * flops_per_image / peak, 1
         )
         if bytes_per_step is not None:
-            result["hbm_gbytes_per_step"] = round(bytes_per_step / 1e9, 2)
-            result["hbm_gbytes_per_sec"] = round(
-                bytes_per_step / 1e9 * wall_per_chip * n_chips / batch_size, 1
+            result["hbm_gbytes_per_step_per_chip"] = round(
+                bytes_per_step / 1e9, 2
+            )
+            result["hbm_gbytes_per_sec_per_chip"] = round(
+                bytes_per_step / 1e9 * wall_per_chip / batch_per_chip, 1
             )
 
         # Device step time from a profiler trace: on this rig the chip is

@@ -75,7 +75,9 @@ class CheckpointManager:
         saved = self._mgr.save(
             step, args=ocp.args.StandardSave(state_arrays(state))
         )
-        if saved and host_state is not None:
+        # multi-host: orbax coordinates the array save across processes;
+        # the JSON sidecar is host-side state, written once by the primary
+        if saved and host_state is not None and jax.process_index() == 0:
             with open(self._sidecar_path(step), "w") as f:
                 json.dump(host_state, f)
         return saved
@@ -103,7 +105,7 @@ class CheckpointManager:
         tf.train.Checkpoint(generator.., discriminator..) analog at
         CycleGAN/tensorflow/train.py:133-148)."""
         saved = self._mgr.save(step, args=ocp.args.StandardSave(tree))
-        if saved and host_state is not None:
+        if saved and host_state is not None and jax.process_index() == 0:
             with open(self._sidecar_path(step), "w") as f:
                 json.dump(host_state, f)
         return saved

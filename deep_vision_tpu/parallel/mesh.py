@@ -97,6 +97,11 @@ def shard_batch(mesh: Mesh, batch):
     """
 
     def _place(x):
+        if isinstance(x, jax.Array) and len(x.sharding.device_set) > 1:
+            # already a globally-sharded array (multi-host callers build
+            # batches with multihost.form_global_array — this host cannot
+            # re-place an array whose shards live on other hosts)
+            return x
         x = np.asarray(x)
         return jax.device_put(x, data_sharding(mesh, x.ndim))
 

@@ -42,6 +42,19 @@ def main(argv=None) -> int:
     imagenet.add_argument("--prefix", default="train")
     # build_imagenet_tfrecord.py:104-160: 1024 train / 128 val shards
     imagenet.add_argument("--num-shards", type=int, default=1024)
+    imagenet.add_argument("--bbox-csv", default=None,
+                          help="CSV from `imagenet_bboxes`; attaches "
+                               "image/object/bbox/* fields per filename")
+
+    inbb = sub.add_parser(
+        "imagenet_bboxes",
+        help="ImageNet bbox XMLs -> relative-coords CSV "
+             "(process_bounding_boxes.py analog)",
+    )
+    inbb.add_argument("--xml-dir", required=True)
+    inbb.add_argument("--out-csv", required=True)
+    inbb.add_argument("--synsets", default=None,
+                      help="restrict to challenge synsets (one id per line)")
 
     cyc = sub.add_parser("cyclegan", help="image folder -> one record file")
     cyc.add_argument("--images-dir", required=True)
@@ -77,9 +90,19 @@ def main(argv=None) -> int:
         C.build_shards(annos, C.mpii_example, args.out_dir, args.prefix,
                        args.num_shards, **common)
     elif args.dataset == "imagenet":
-        annos = C.imagenet_annotations(args.root, args.synsets)
+        annos = C.imagenet_annotations(args.root, args.synsets,
+                                       bbox_csv=args.bbox_csv)
         C.build_shards(annos, C.imagenet_example, args.out_dir, args.prefix,
                        args.num_shards, **common)
+    elif args.dataset == "imagenet_bboxes":
+        stats = C.imagenet_bbox_csv(args.xml_dir, args.out_csv, args.synsets)
+        print(f"Finished processing {stats['files']} XML files.\n"
+              f"Skipped {stats['skipped_files']} XML files not in ImageNet "
+              f"Challenge.\n"
+              f"Skipped {stats['skipped_boxes']} bounding boxes not in "
+              f"ImageNet Challenge.\n"
+              f"Wrote {stats['boxes']} bounding boxes from "
+              f"{stats['files'] - stats['skipped_files']} annotated images.")
     elif args.dataset == "cyclegan":
         annos = C.cyclegan_examples(args.images_dir)
         C.build_shards(annos, C.image_only_example, args.out_dir, args.prefix,

@@ -267,12 +267,120 @@ def mpii_example(anno: dict) -> Optional[dict]:
 
 # -- ImageNet ----------------------------------------------------------------
 
-def imagenet_annotations(root: str, synsets_path: str) -> List[dict]:
+def imagenet_bbox_csv(xml_dir: str, out_csv: str,
+                      synsets_path: Optional[str] = None) -> dict:
+    """ImageNet bbox XMLs -> one CSV line per box: `file,xmin,ymin,xmax,ymax`.
+
+    The process_bounding_boxes.py analog
+    (Datasets/ILSVRC2012/process_bounding_boxes.py:1-264): walks
+    `<xml_dir>/nXXXXXXXX/nXXXXXXXX_YYYY.xml` (or a flat dir of XMLs), reads
+    each PASCAL-style annotation, normalizes pixel boxes by the annotator's
+    displayed <size> (which differs from the downloadable image's size — the
+    reason the CSV stores RELATIVE coords), clamps to [0, 1], swaps
+    inverted min/max (both fixups human annotations need), and optionally
+    filters to the challenge synsets. Returns counters matching the
+    reference's stderr summary.
+    """
+    import csv
+    import glob as _glob
+    import xml.etree.ElementTree as ET
+
+    keep = None
+    if synsets_path:
+        with open(synsets_path) as f:
+            keep = {line.strip().split()[0] for line in f if line.strip()}
+    xmls = sorted(
+        _glob.glob(os.path.join(xml_dir, "*", "*.xml"))
+        + _glob.glob(os.path.join(xml_dir, "*.xml"))
+    )
+    n_files = n_boxes = n_skipped_files = n_skipped_boxes = 0
+    n_malformed = 0
+    os.makedirs(os.path.dirname(os.path.abspath(out_csv)), exist_ok=True)
+    with open(out_csv, "w", newline="") as out:
+        w = csv.writer(out)
+        for path in xmls:
+            n_files += 1
+            synset = os.path.basename(path).split("_")[0]
+            if keep is not None and synset not in keep:
+                n_skipped_files += 1
+                continue
+            # a handful of the ~500k human annotations are malformed
+            # (missing <size>, zero dims, non-numeric fields): count and
+            # continue, as the reference tool does — one bad XML must not
+            # kill the whole build
+            try:
+                root = ET.parse(path).getroot()
+                size = root.find("size")
+                width = float(size.findtext("width"))
+                height = float(size.findtext("height"))
+                if width <= 0 or height <= 0:
+                    raise ValueError(f"degenerate size {width}x{height}")
+                fname = root.findtext("filename")
+                if fname and not fname.lower().endswith((".jpeg", ".jpg")):
+                    fname += ".JPEG"
+                rows = []
+                for obj in root.iter("object"):
+                    name = obj.findtext("name")
+                    if keep is not None and name not in keep:
+                        n_skipped_boxes += 1
+                        continue
+                    bb = obj.find("bndbox")
+                    x1 = min(max(float(bb.findtext("xmin")) / width, 0.0), 1.0)
+                    y1 = min(max(float(bb.findtext("ymin")) / height, 0.0), 1.0)
+                    x2 = min(max(float(bb.findtext("xmax")) / width, 0.0), 1.0)
+                    y2 = min(max(float(bb.findtext("ymax")) / height, 0.0), 1.0)
+                    if x1 > x2:  # inverted human annotation
+                        x1, x2 = x2, x1
+                    if y1 > y2:
+                        y1, y2 = y2, y1
+                    rows.append([fname, f"{x1:.4f}", f"{y1:.4f}",
+                                 f"{x2:.4f}", f"{y2:.4f}"])
+            except Exception as e:
+                n_malformed += 1
+                print(f"imagenet_bbox_csv: skipping malformed {path}: "
+                      f"{type(e).__name__}: {e}")
+                continue
+            for row in rows:
+                w.writerow(row)
+                n_boxes += 1
+    return {
+        "files": n_files,
+        "boxes": n_boxes,
+        "skipped_files": n_skipped_files,
+        "skipped_boxes": n_skipped_boxes,
+        "malformed_files": n_malformed,
+    }
+
+
+def load_bbox_csv(csv_path: str) -> dict:
+    """CSV from `imagenet_bbox_csv` -> {filename stem: [[x1,y1,x2,y2], ...]}.
+
+    Keyed on the extensionless stem: the CSV stamps '.JPEG' (the reference's
+    convention) while datasets on disk may use .jpg/.png — an extension
+    mismatch must not silently drop every box."""
+    import csv
+    from collections import defaultdict
+
+    boxes = defaultdict(list)
+    with open(csv_path, newline="") as f:
+        for row in csv.reader(f):
+            if len(row) != 5:
+                continue
+            stem = os.path.splitext(row[0])[0]
+            boxes[stem].append([float(v) for v in row[1:]])
+    return dict(boxes)
+
+
+def imagenet_annotations(root: str, synsets_path: str,
+                         bbox_csv: Optional[str] = None) -> List[dict]:
     """Flattened `nXXXXXXXX_*.JPEG` folder -> annotations with 1-based labels
-    (0 reserved for background, build_imagenet_tfrecord.py convention)."""
+    (0 reserved for background, build_imagenet_tfrecord.py convention).
+    With `bbox_csv` (from imagenet_bbox_csv), boxes attach per filename and
+    land in the Example's image/object/bbox/* fields."""
     with open(synsets_path) as f:
         synsets = [line.strip().split()[0] for line in f if line.strip()]
     label_of = {s: i + 1 for i, s in enumerate(synsets)}
+    boxes_of = load_bbox_csv(bbox_csv) if bbox_csv else {}
     annos = []
     for name in sorted(os.listdir(root)):
         if not name.lower().endswith((".jpeg", ".jpg", ".png")):
@@ -284,6 +392,8 @@ def imagenet_annotations(root: str, synsets_path: str) -> List[dict]:
                 "filepath": os.path.join(root, name),
                 "synset": synset,
                 "label": label_of[synset],
+                # stem-keyed: .jpg/.png datasets still match the CSV's .JPEG
+                "bboxes": boxes_of.get(os.path.splitext(name)[0], []),
             }
         )
     return annos
@@ -305,7 +415,7 @@ def imagenet_example(anno: dict) -> Optional[dict]:
         buf = io.BytesIO()
         img.convert("RGB").save(buf, format="JPEG", quality=95)
         content = buf.getvalue()
-    return {
+    ex = {
         "image/colorspace": [b"RGB"],
         "image/channels": [3],
         "image/class/label": [anno["label"]],
@@ -314,6 +424,19 @@ def imagenet_example(anno: dict) -> Optional[dict]:
         "image/filename": [anno["filename"].encode()],
         "image/encoded": [content],
     }
+    # bbox fields (build_imagenet_tfrecord.py:184-254): parallel min/max
+    # float lists + one label per box (all boxes carry the image label).
+    # Written only when the run attached a bbox CSV — like the reference,
+    # the classifier READ path ignores them; they exist to inform
+    # Inception-style distorted-bbox crops and for tooling parity.
+    if anno.get("bboxes"):
+        bbs = anno["bboxes"]
+        ex["image/object/bbox/xmin"] = [float(b[0]) for b in bbs]
+        ex["image/object/bbox/ymin"] = [float(b[1]) for b in bbs]
+        ex["image/object/bbox/xmax"] = [float(b[2]) for b in bbs]
+        ex["image/object/bbox/ymax"] = [float(b[3]) for b in bbs]
+        ex["image/object/bbox/label"] = [anno["label"]] * len(bbs)
+    return ex
 
 
 # -- CycleGAN ----------------------------------------------------------------

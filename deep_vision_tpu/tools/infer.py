@@ -51,6 +51,72 @@ def _load_image(path: str, size: int, normalize: str, rescale: int = 0):
     return sample["image"]
 
 
+# MPII skeleton: limb edges drawn between joint indices (r-leg, l-leg,
+# spine/head, r-arm, l-arm) — the demo overlay of
+# demo_hourglass_pose.ipynb as data
+POSE_SKELETON = ((0, 1), (1, 2), (2, 6), (3, 6), (3, 4), (4, 5), (6, 7),
+                 (7, 8), (8, 9), (10, 11), (11, 12), (12, 7), (13, 7),
+                 (13, 14), (14, 15))
+_PALETTE = ((255, 99, 71), (60, 179, 113), (65, 105, 225), (255, 215, 0),
+            (186, 85, 211), (0, 206, 209), (255, 140, 0), (154, 205, 50))
+
+
+def _reload_rgb(path: str, size: int) -> np.ndarray:
+    """The display copy: decoded + resized, NOT normalized."""
+    from deep_vision_tpu.data.datasets import decode_image
+    from deep_vision_tpu.data import transforms as T
+
+    with open(path, "rb") as f:
+        img = decode_image(f.read())
+    s = T.Resize(size)({"image": img}, np.random.default_rng(0))
+    return np.ascontiguousarray(s["image"][..., :3])
+
+
+def draw_detections(image: np.ndarray, boxes, scores, classes,
+                    class_names=None) -> np.ndarray:
+    """Box + label overlay on an RGB uint8 image; normalized [x1,y1,x2,y2]
+    boxes (the rendered-output parity of demo_mscoco.ipynb)."""
+    import cv2
+
+    out = image.copy()
+    h, w = out.shape[:2]
+    for b, s, c in zip(boxes, scores, classes):
+        color = _PALETTE[int(c) % len(_PALETTE)]
+        x1, y1 = int(b[0] * w), int(b[1] * h)
+        x2, y2 = int(b[2] * w), int(b[3] * h)
+        cv2.rectangle(out, (x1, y1), (x2, y2), color, 2)
+        name = (class_names[int(c)] if class_names
+                and 0 <= int(c) < len(class_names) else f"class {int(c)}")
+        label = f"{name} {float(s):.2f}"
+        (tw, th), _ = cv2.getTextSize(label, cv2.FONT_HERSHEY_SIMPLEX, 0.5, 1)
+        ty = y1 - 4 if y1 - th - 8 >= 0 else y2 + th + 4
+        cv2.rectangle(out, (x1, ty - th - 4), (x1 + tw + 2, ty + 2), color, -1)
+        cv2.putText(out, label, (x1 + 1, ty - 2), cv2.FONT_HERSHEY_SIMPLEX,
+                    0.5, (255, 255, 255), 1, cv2.LINE_AA)
+    return out
+
+
+def draw_pose(image: np.ndarray, kpts, score_threshold: float = 0.1,
+              skeleton=POSE_SKELETON) -> np.ndarray:
+    """Joint dots + skeleton limbs; kpts (J, 3) = normalized x, y, score
+    (the rendered-output parity of demo_hourglass_pose.ipynb)."""
+    import cv2
+
+    out = image.copy()
+    h, w = out.shape[:2]
+    pts = [(int(x * w), int(y * h)) if s >= score_threshold else None
+           for x, y, s in np.asarray(kpts, np.float32)]
+    for e, (a, b) in enumerate(skeleton):
+        if a < len(pts) and b < len(pts) and pts[a] and pts[b]:
+            cv2.line(out, pts[a], pts[b], _PALETTE[e % len(_PALETTE)], 2,
+                     cv2.LINE_AA)
+    for p in pts:
+        if p:
+            cv2.circle(out, p, 3, (255, 255, 255), -1, cv2.LINE_AA)
+            cv2.circle(out, p, 3, (30, 30, 30), 1, cv2.LINE_AA)
+    return out
+
+
 def _restore_variables(model, sample, ckpt_dir: Optional[str]):
     import jax
     import jax.numpy as jnp
@@ -154,6 +220,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         )
         out = {k: np.asarray(v) for k, v in
                detect(variables, jnp.asarray(batch)).items()}
+        import cv2
+
         for i, f in enumerate(args.images):
             n = int(out["num"][i])
             print(f"{f}: {n} detections")
@@ -167,6 +235,14 @@ def main(argv: Optional[List[str]] = None) -> int:
                 lines.append(line.strip())
             with open(outpath(f, "_boxes.txt"), "w") as fh:
                 fh.write("\n".join(lines) + "\n")
+            # rendered overlay beside the sidecar (demo_mscoco.ipynb parity)
+            drawn = draw_detections(
+                _reload_rgb(f, size), out["boxes"][i, :n],
+                out["scores"][i, :n], out["classes"][i, :n],
+            )
+            dst = outpath(f, "_detected.jpg")
+            cv2.imwrite(dst, drawn[..., ::-1])  # RGB -> BGR
+            print(f"  -> {dst}")
         return 0
 
     if cfg.task == "pose":
@@ -179,10 +255,17 @@ def main(argv: Optional[List[str]] = None) -> int:
         variables = _restore_variables(model, batch[:1], args.checkpoint)
         estimate = make_pose_estimator(model)
         kpts = np.asarray(estimate(variables, jnp.asarray(batch)))
+        import cv2
+
         for f, kp in zip(args.images, kpts):
             print(f"{f}:")
             for j, (x, y, s) in enumerate(kp):
                 print(f"  joint {j}: x={x:.3f} y={y:.3f} score={s:.3f}")
+            # skeleton overlay (demo_hourglass_pose.ipynb parity)
+            drawn = draw_pose(_reload_rgb(f, size), kp)
+            dst = outpath(f, "_pose.jpg")
+            cv2.imwrite(dst, drawn[..., ::-1])
+            print(f"  -> {dst}")
         return 0
 
     if cfg.task in ("dcgan", "cyclegan"):

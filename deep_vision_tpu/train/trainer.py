@@ -199,6 +199,12 @@ class Trainer:
         a '_mask' row-validity array consumed by mask-aware losses/metrics
         (TPU static shapes; the reference just let torch/TF handle ragged
         last batches, ResNet/pytorch/train.py:431-485)."""
+        if isinstance(batch[self.input_key], jax.Array) and len(
+                batch[self.input_key].sharding.device_set) > 1:
+            # multi-host: the batch is already a globally-sharded array
+            # (form_global_array) — this host holds only its shards, so
+            # padding must happen BEFORE assembly; callers feed full batches
+            return dict(batch)
         n_data = self.mesh.shape[DATA_AXIS]
         batch, n_valid = pad_batch_to(dict(batch), n_data)
         n_total = np.asarray(batch[self.input_key]).shape[0]
@@ -260,7 +266,7 @@ class Trainer:
             # the same sequence of calls.
             if self._pguard is not None and self._pguard.agreed(step):
                 break  # caller re-checks with force=True and checkpoints
-            n = np.asarray(batch[self.input_key]).shape[0]
+            n = np.shape(batch[self.input_key])[0]
             metrics = self.eval_step(batch)
             self.eval_logger.log_step(step, metrics, batch_size=n, epoch=epoch)
             step += 1
@@ -275,6 +281,7 @@ class Trainer:
         eval_first: bool = False,  # epoch-0 sanity pass (ResNet/pytorch/train.py:390)
         save_every: int = 1,
         handle_preemption: bool = True,
+        preemption_poll_every: int = 10,
     ):
         """Epoch driver. With `handle_preemption` (default), SIGTERM — what a
         TPU VM gets ~30s before a maintenance event or spot reclaim — is
@@ -285,7 +292,10 @@ class Trainer:
         only on the main thread (signal module requirement)."""
         from deep_vision_tpu.parallel.multihost import PreemptionGuard
 
-        self._pguard = PreemptionGuard() if handle_preemption else None
+        self._pguard = (
+            PreemptionGuard(poll_every=preemption_poll_every)
+            if handle_preemption else None
+        )
         import contextlib
 
         ctx = self._pguard if self._pguard is not None else contextlib.nullcontext()
@@ -355,7 +365,7 @@ class Trainer:
         """One epoch of steps; returns ("preempted"|None, logger summary)."""
         self.logger.start_epoch()
         for batch in train_data_fn():
-            n = np.asarray(batch[self.input_key]).shape[0]
+            n = np.shape(batch[self.input_key])[0]
             metrics = self.train_step(batch)
             opt_step = int(self.state.step)
             self.logger.log_step(

@@ -170,3 +170,66 @@ def test_celeba_split(tmp_path):
     assert (n_a, n_b) == (2, 1)
     with pytest.raises(ValueError):
         C.celeba_split(str(attr), str(img_dir), str(out2), "NoSuchAttr")
+
+
+def test_imagenet_bbox_pipeline(tmp_path):
+    """process_bounding_boxes.py parity: XML -> relative CSV (clamped,
+    min/max-swapped, synset-filtered) -> bbox fields in the Example."""
+    xml_dir = tmp_path / "bbox_xml" / "n01440764"
+    os.makedirs(xml_dir)
+    xml = """<annotation>
+      <filename>n01440764_1</filename>
+      <size><width>200</width><height>100</height></size>
+      <object><name>n01440764</name>
+        <bndbox><xmin>20</xmin><ymin>10</ymin><xmax>100</xmax><ymax>90</ymax></bndbox>
+      </object>
+      <object><name>n01440764</name>
+        <bndbox><xmin>180</xmin><ymin>95</ymin><xmax>150</xmax><ymax>250</ymax></bndbox>
+      </object>
+    </annotation>"""
+    (xml_dir / "n01440764_1.xml").write_text(xml)
+    other = tmp_path / "bbox_xml" / "n99999999"
+    os.makedirs(other)
+    (other / "n99999999_5.xml").write_text(xml.replace("n01440764", "n99999999"))
+
+    synsets = tmp_path / "synsets.txt"
+    synsets.write_text("n01440764\n")
+    out_csv = tmp_path / "boxes.csv"
+
+    from deep_vision_tpu.tools.converters import (
+        imagenet_annotations,
+        imagenet_bbox_csv,
+        imagenet_example,
+        load_bbox_csv,
+    )
+
+    stats = imagenet_bbox_csv(str(tmp_path / "bbox_xml"), str(out_csv),
+                              str(synsets))
+    assert stats["boxes"] == 2
+    assert stats["skipped_files"] == 1  # the off-challenge synset dir
+
+    boxes = load_bbox_csv(str(out_csv))
+    # keyed by extensionless stem so .jpg/.png datasets still match
+    got = boxes["n01440764_1"]
+    # box 1: straight normalization by the displayed 200x100 size
+    np.testing.assert_allclose(got[0], [0.1, 0.1, 0.5, 0.9], atol=1e-4)
+    # box 2: inverted x pair swapped, y clamped to [0, 1]
+    np.testing.assert_allclose(got[1], [0.75, 0.95, 0.9, 1.0], atol=1e-4)
+
+    # end to end: the Example carries the reference's bbox field layout
+    root = tmp_path / "train_flatten"
+    os.makedirs(root)
+    _write_jpeg(root / "n01440764_1.JPEG")
+    annos = imagenet_annotations(str(root), str(synsets),
+                                 bbox_csv=str(out_csv))
+    ex = imagenet_example(annos[0])
+    np.testing.assert_allclose(ex["image/object/bbox/xmin"], [0.1, 0.75],
+                               atol=1e-4)
+    np.testing.assert_allclose(ex["image/object/bbox/ymax"], [0.9, 1.0],
+                               atol=1e-4)
+    assert ex["image/object/bbox/label"] == [1, 1]
+
+    # no-bbox run writes no bbox fields (field set matches the reference's
+    # plain classifier records)
+    ex2 = imagenet_example(imagenet_annotations(str(root), str(synsets))[0])
+    assert "image/object/bbox/xmin" not in ex2

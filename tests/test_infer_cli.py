@@ -51,6 +51,9 @@ def test_infer_detection_writes_sidecar(jpg, tmp_path, capsys):
     assert rc == 0
     assert "detections" in capsys.readouterr().out
     assert os.path.exists(tmp_path / "out" / "img_boxes.txt")
+    # rendered overlay (demo_mscoco.ipynb parity): a real decodable JPEG
+    drawn = cv2.imread(str(tmp_path / "out" / "img_detected.jpg"))
+    assert drawn is not None and drawn.shape[2] == 3
 
 
 def test_infer_pose(jpg, capsys):
@@ -59,6 +62,9 @@ def test_infer_pose(jpg, capsys):
     rc = main(["-m", "hourglass_mpii", jpg])
     assert rc == 0
     assert "joint 0:" in capsys.readouterr().out
+    # skeleton overlay written next to the input
+    drawn = cv2.imread(jpg.replace(".jpg", "_pose.jpg"))
+    assert drawn is not None and drawn.shape[2] == 3
 
 
 def test_infer_cyclegan_saves_image(jpg, tmp_path, capsys):
