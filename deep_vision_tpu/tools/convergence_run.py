@@ -57,6 +57,74 @@ def procedural_gratings(n: int, classes: int = 16, size: int = 112,
     return images, labels.astype(np.int32)
 
 
+def _build_recipe(model_name: str, classes: int, sgd_lr: float,
+                  adamw_lr: float):
+    """(state, recipe string, prep fn): the shared model/optimizer setup.
+
+    `prep` maps host float images (N, 112, 112, 3) to the model's input
+    layout (the s2d stem's host half for resnet50, identity otherwise).
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from deep_vision_tpu.core.train_state import create_train_state
+    from deep_vision_tpu.data.transforms import space_to_depth
+    from deep_vision_tpu.models import get_model
+    from deep_vision_tpu.train.optimizers import build_optimizer
+
+    if model_name == "resnet50":
+        model = get_model("resnet50", num_classes=classes, dtype=jnp.bfloat16,
+                          stem="s2d")
+        tx = build_optimizer("sgd", sgd_lr, momentum=0.9, weight_decay=1e-4)
+        sample = jnp.ones((8, 56, 56, 12), jnp.float32)
+        recipe = f"resnet50 (bf16, s2d stem, SGD {sgd_lr}/0.9/1e-4)"
+        prep = lambda a: np.stack([space_to_depth(i) for i in a])
+    else:  # the attention family: AdamW recipe on raw 112px inputs
+        model = get_model(model_name, num_classes=classes, dtype=jnp.bfloat16)
+        tx = build_optimizer("adamw", adamw_lr, weight_decay=1e-4)
+        sample = jnp.ones((8, 112, 112, 3), jnp.float32)
+        recipe = f"{model_name} (bf16, AdamW {adamw_lr}/1e-4)"
+        prep = lambda a: a
+    state = create_train_state(model, tx, sample, jax.random.PRNGKey(0))
+    return state, recipe, prep
+
+
+def _train_step(state, batch):
+    """One classification train step (shared by run / run_holdout)."""
+    import jax
+
+    from deep_vision_tpu.losses.classification import classification_loss_fn
+
+    def loss_fn(params):
+        variables = {"params": params}
+        # NB mutable=False, not []: flax returns (y, vars) for ANY list
+        mutable = False
+        if state.batch_stats:
+            variables["batch_stats"] = state.batch_stats
+            mutable = ["batch_stats"]
+        out = state.apply_fn(
+            variables, batch["image"], train=True,
+            rngs={"dropout": jax.random.fold_in(state.rng, state.step)},
+            mutable=mutable)
+        out, nms = out if mutable else (out, {})
+        loss, _ = classification_loss_fn(out, batch)
+        return loss, nms.get("batch_stats", {})
+
+    (loss, bs), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+        state.params)
+    new_state = state.apply_gradients(grads)
+    if state.batch_stats:
+        new_state = new_state.replace(batch_stats=bs)
+    return new_state, loss
+
+
+def _write_artifact(out_path: str, result: dict) -> None:
+    os.makedirs(os.path.dirname(out_path) or ".", exist_ok=True)
+    with open(out_path, "w") as f:
+        json.dump(result, f, indent=1)
+
+
 def run(steps: int = 200, batch: int = 64, classes: int = 64,
         model_name: str = "resnet50", out_path: Optional[str] = None) -> dict:
     out_path = out_path or f"artifacts/{model_name}_tpu_convergence.json"
@@ -64,64 +132,19 @@ def run(steps: int = 200, batch: int = 64, classes: int = 64,
     import jax.numpy as jnp
     import numpy as np
 
-    from deep_vision_tpu.core.train_state import create_train_state
-    from deep_vision_tpu.data.transforms import space_to_depth
-    from deep_vision_tpu.losses.classification import classification_loss_fn
-    from deep_vision_tpu.models import get_model
-    from deep_vision_tpu.train.optimizers import build_optimizer
-
     # fixed fixture: `batch` images / `classes` labels, memorizable in O(100)
     # steps — real-data ImageNet is not present in this environment, so the
     # evidence is "the full recipe optimizes on hardware", not accuracy parity
     rng = np.random.RandomState(0)
     imgs = rng.rand(batch, 112, 112, 3).astype(np.float32)
-    if model_name == "resnet50":
-        model = get_model("resnet50", num_classes=classes, dtype=jnp.bfloat16,
-                          stem="s2d")
-        tx = build_optimizer("sgd", 0.05, momentum=0.9, weight_decay=1e-4)
-        sample = jnp.ones((8, 56, 56, 12), jnp.float32)
-        recipe = "resnet50 (bf16, s2d stem, SGD 0.05/0.9/1e-4)"
-        images = jnp.asarray(
-            np.stack([space_to_depth(i) for i in imgs]), jnp.bfloat16
-        )
-    else:  # the attention family: AdamW recipe on raw 112px inputs
-        model = get_model(model_name, num_classes=classes,
-                          dtype=jnp.bfloat16)
-        tx = build_optimizer("adamw", 1e-3, weight_decay=1e-4)
-        sample = jnp.ones((8, 112, 112, 3), jnp.float32)
-        recipe = f"{model_name} (bf16, AdamW 1e-3/1e-4)"
-        images = jnp.asarray(imgs, jnp.bfloat16)
-    state = create_train_state(model, tx, sample, jax.random.PRNGKey(0))
-
+    state, recipe, prep = _build_recipe(model_name, classes,
+                                        sgd_lr=0.05, adamw_lr=1e-3)
     batch_d = {
-        "image": images,
+        "image": jnp.asarray(prep(imgs), jnp.bfloat16),
         "label": jnp.asarray(np.arange(batch) % classes, jnp.int32),
     }
 
-    def train_step(state, batch):
-        def loss_fn(params):
-            variables = {"params": params}
-            # NB mutable=False, not []: flax returns (y, vars) for ANY list
-            mutable = False
-            if state.batch_stats:
-                variables["batch_stats"] = state.batch_stats
-                mutable = ["batch_stats"]
-            out = state.apply_fn(
-                variables, batch["image"], train=True,
-                rngs={"dropout": jax.random.fold_in(state.rng, state.step)},
-                mutable=mutable)
-            out, nms = out if mutable else (out, {})
-            loss, _ = classification_loss_fn(out, batch)
-            return loss, nms.get("batch_stats", {})
-
-        (loss, bs), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-            state.params)
-        new_state = state.apply_gradients(grads)
-        if state.batch_stats:
-            new_state = new_state.replace(batch_stats=bs)
-        return new_state, loss
-
-    step = jax.jit(train_step, donate_argnums=0)
+    step = jax.jit(_train_step, donate_argnums=0)
     losses = []
     t0 = time.time()
     for i in range(steps):
@@ -142,9 +165,7 @@ def run(steps: int = 200, batch: int = 64, classes: int = 64,
         "first_loss": round(losses[0][1], 4),
         "final_loss": round(losses[-1][1], 4),
     }
-    os.makedirs(os.path.dirname(out_path), exist_ok=True)
-    with open(out_path, "w") as f:
-        json.dump(result, f, indent=1)
+    _write_artifact(out_path, result)
     return result
 
 
@@ -162,53 +183,14 @@ def run_holdout(steps: int = 300, batch: int = 64, classes: int = 16,
     import numpy as np
 
     from deep_vision_tpu.core.metrics import topk_accuracy
-    from deep_vision_tpu.core.train_state import create_train_state
-    from deep_vision_tpu.data.transforms import space_to_depth
-    from deep_vision_tpu.losses.classification import classification_loss_fn
-    from deep_vision_tpu.models import get_model
-    from deep_vision_tpu.train.optimizers import build_optimizer
 
     tr_x, tr_y = procedural_gratings(n_train, classes, seed=0)
     va_x, va_y = procedural_gratings(n_val, classes, seed=1)
-
-    if model_name == "resnet50":
-        model = get_model("resnet50", num_classes=classes,
-                          dtype=jnp.bfloat16, stem="s2d")
-        tx = build_optimizer("sgd", 0.02, momentum=0.9, weight_decay=1e-4)
-        sample = jnp.ones((8, 56, 56, 12), jnp.float32)
-        recipe = "resnet50 (bf16, s2d stem, SGD 0.02/0.9/1e-4)"
-        prep = lambda a: np.stack([space_to_depth(i) for i in a])
-    else:
-        model = get_model(model_name, num_classes=classes,
-                          dtype=jnp.bfloat16)
-        tx = build_optimizer("adamw", 3e-4, weight_decay=1e-4)
-        sample = jnp.ones((8, 112, 112, 3), jnp.float32)
-        recipe = f"{model_name} (bf16, AdamW 3e-4/1e-4)"
-        prep = lambda a: a
+    # lower LRs than run(): generalizing a split is harder than memorizing
+    # one fixed batch
+    state, recipe, prep = _build_recipe(model_name, classes,
+                                        sgd_lr=0.02, adamw_lr=3e-4)
     tr_x, va_x = prep(tr_x), prep(va_x)
-    state = create_train_state(model, tx, sample, jax.random.PRNGKey(0))
-
-    def train_step(state, batch):
-        def loss_fn(params):
-            variables = {"params": params}
-            mutable = False
-            if state.batch_stats:
-                variables["batch_stats"] = state.batch_stats
-                mutable = ["batch_stats"]
-            out = state.apply_fn(
-                variables, batch["image"], train=True,
-                rngs={"dropout": jax.random.fold_in(state.rng, state.step)},
-                mutable=mutable)
-            out, nms = out if mutable else (out, {})
-            loss, _ = classification_loss_fn(out, batch)
-            return loss, nms.get("batch_stats", {})
-
-        (loss, bs), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-            state.params)
-        new_state = state.apply_gradients(grads)
-        if state.batch_stats:
-            new_state = new_state.replace(batch_stats=bs)
-        return new_state, loss
 
     def eval_logits(state, images):
         variables = {"params": state.params}
@@ -220,8 +202,8 @@ def run_holdout(steps: int = 300, batch: int = 64, classes: int = 16,
     # device-resident dataset, indexed inside jit: through this rig's relay
     # a per-step host->device image transfer costs more than the step itself
     def sampled_step(state, data_x, data_y, idx):
-        return train_step(state, {"image": jnp.take(data_x, idx, axis=0),
-                                  "label": jnp.take(data_y, idx, axis=0)})
+        return _train_step(state, {"image": jnp.take(data_x, idx, axis=0),
+                                   "label": jnp.take(data_y, idx, axis=0)})
 
     step = jax.jit(sampled_step, donate_argnums=0)
     eval_fn = jax.jit(eval_logits)
@@ -239,11 +221,15 @@ def run_holdout(steps: int = 300, batch: int = 64, classes: int = 16,
     wall = time.time() - t0
 
     def split_top1(x, y):
+        # eval batch clamped to the split size: --batch larger than n_val
+        # must not produce zero batches (mean of [] = NaN); the sub-batch
+        # tail is dropped, n reports rows actually scored
+        eb = min(batch, len(x))
         accs, n = [], 0
-        for s in range(0, len(x) - batch + 1, batch):
-            logits = eval_fn(state, jnp.asarray(x[s:s + batch], jnp.bfloat16))
-            accs.append(topk_accuracy(logits, jnp.asarray(y[s:s + batch])))
-            n += batch
+        for s in range(0, len(x) - eb + 1, eb):
+            logits = eval_fn(state, jnp.asarray(x[s:s + eb], jnp.bfloat16))
+            accs.append(topk_accuracy(logits, jnp.asarray(y[s:s + eb])))
+            n += eb
         return (float(np.mean([float(a["top1"]) for a in accs])),
                 float(np.mean([float(a["top5"]) for a in accs])), n)
 
@@ -271,9 +257,7 @@ def run_holdout(steps: int = 300, batch: int = 64, classes: int = 16,
         "val_top1": round(val_top1, 4),
         "val_top5": round(val_top5, 4),
     }
-    os.makedirs(os.path.dirname(out_path), exist_ok=True)
-    with open(out_path, "w") as f:
-        json.dump(result, f, indent=1)
+    _write_artifact(out_path, result)
     return result
 
 

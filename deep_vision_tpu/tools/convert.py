@@ -96,13 +96,16 @@ def main(argv=None) -> int:
                        args.num_shards, **common)
     elif args.dataset == "imagenet_bboxes":
         stats = C.imagenet_bbox_csv(args.xml_dir, args.out_csv, args.synsets)
+        annotated = (stats["files"] - stats["skipped_files"]
+                     - stats["malformed_files"])
         print(f"Finished processing {stats['files']} XML files.\n"
               f"Skipped {stats['skipped_files']} XML files not in ImageNet "
               f"Challenge.\n"
               f"Skipped {stats['skipped_boxes']} bounding boxes not in "
               f"ImageNet Challenge.\n"
+              f"Skipped {stats['malformed_files']} malformed XML files.\n"
               f"Wrote {stats['boxes']} bounding boxes from "
-              f"{stats['files'] - stats['skipped_files']} annotated images.")
+              f"{annotated} annotated images.")
     elif args.dataset == "cyclegan":
         annos = C.cyclegan_examples(args.images_dir)
         C.build_shards(annos, C.image_only_example, args.out_dir, args.prefix,

@@ -315,8 +315,11 @@ def imagenet_bbox_csv(xml_dir: str, out_csv: str,
                 height = float(size.findtext("height"))
                 if width <= 0 or height <= 0:
                     raise ValueError(f"degenerate size {width}x{height}")
-                fname = root.findtext("filename")
-                if fname and not fname.lower().endswith((".jpeg", ".jpg")):
+                # some annotation XMLs lack <filename>: fall back to the
+                # XML's own basename (which mirrors the image name)
+                fname = (root.findtext("filename")
+                         or os.path.splitext(os.path.basename(path))[0])
+                if not fname.lower().endswith((".jpeg", ".jpg")):
                     fname += ".JPEG"
                 rows = []
                 for obj in root.iter("object"):

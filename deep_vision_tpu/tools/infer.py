@@ -220,8 +220,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         )
         out = {k: np.asarray(v) for k, v in
                detect(variables, jnp.asarray(batch)).items()}
-        import cv2
-
+        try:  # overlay rendering needs cv2, which is optional everywhere
+            import cv2
+        except Exception:
+            cv2 = None
+            print("note: opencv not installed; skipping _detected.jpg "
+                  "overlays (text sidecars still written)")
         for i, f in enumerate(args.images):
             n = int(out["num"][i])
             print(f"{f}: {n} detections")
@@ -235,14 +239,16 @@ def main(argv: Optional[List[str]] = None) -> int:
                 lines.append(line.strip())
             with open(outpath(f, "_boxes.txt"), "w") as fh:
                 fh.write("\n".join(lines) + "\n")
-            # rendered overlay beside the sidecar (demo_mscoco.ipynb parity)
-            drawn = draw_detections(
-                _reload_rgb(f, size), out["boxes"][i, :n],
-                out["scores"][i, :n], out["classes"][i, :n],
-            )
-            dst = outpath(f, "_detected.jpg")
-            cv2.imwrite(dst, drawn[..., ::-1])  # RGB -> BGR
-            print(f"  -> {dst}")
+            if cv2 is not None:
+                # rendered overlay beside the sidecar (demo_mscoco.ipynb
+                # parity)
+                drawn = draw_detections(
+                    _reload_rgb(f, size), out["boxes"][i, :n],
+                    out["scores"][i, :n], out["classes"][i, :n],
+                )
+                dst = outpath(f, "_detected.jpg")
+                cv2.imwrite(dst, drawn[..., ::-1])  # RGB -> BGR
+                print(f"  -> {dst}")
         return 0
 
     if cfg.task == "pose":
@@ -255,17 +261,21 @@ def main(argv: Optional[List[str]] = None) -> int:
         variables = _restore_variables(model, batch[:1], args.checkpoint)
         estimate = make_pose_estimator(model)
         kpts = np.asarray(estimate(variables, jnp.asarray(batch)))
-        import cv2
-
+        try:
+            import cv2
+        except Exception:
+            cv2 = None
+            print("note: opencv not installed; skipping _pose.jpg overlays")
         for f, kp in zip(args.images, kpts):
             print(f"{f}:")
             for j, (x, y, s) in enumerate(kp):
                 print(f"  joint {j}: x={x:.3f} y={y:.3f} score={s:.3f}")
-            # skeleton overlay (demo_hourglass_pose.ipynb parity)
-            drawn = draw_pose(_reload_rgb(f, size), kp)
-            dst = outpath(f, "_pose.jpg")
-            cv2.imwrite(dst, drawn[..., ::-1])
-            print(f"  -> {dst}")
+            if cv2 is not None:
+                # skeleton overlay (demo_hourglass_pose.ipynb parity)
+                drawn = draw_pose(_reload_rgb(f, size), kp)
+                dst = outpath(f, "_pose.jpg")
+                cv2.imwrite(dst, drawn[..., ::-1])
+                print(f"  -> {dst}")
         return 0
 
     if cfg.task in ("dcgan", "cyclegan"):

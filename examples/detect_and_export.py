@@ -40,7 +40,7 @@ def main():
     # image batch -> decoded, class-aware-NMS'd boxes, all jitted
     detect = make_yolo_detector(model, score_threshold=0.1)
     det = detect(variables, x)
-    n = int(det["num_detections"][0])
+    n = int(det["num"][0])
     print(f"detections: {n} boxes "
           f"(scores {np.asarray(det['scores'][0, :max(n, 1)]).round(3)})")
 
