@@ -61,6 +61,19 @@ _PALETTE = ((255, 99, 71), (60, 179, 113), (65, 105, 225), (255, 215, 0),
             (186, 85, 211), (0, 206, 209), (255, 140, 0), (154, 205, 50))
 
 
+def _write_jpeg(dst: str, rgb_u8: np.ndarray) -> None:
+    """RGB uint8 -> JPEG on disk; cv2 when present, PIL otherwise (cv2 is
+    optional everywhere in this package)."""
+    try:
+        import cv2
+
+        cv2.imwrite(dst, rgb_u8[..., ::-1])  # RGB -> BGR for cv2
+    except ImportError:
+        from PIL import Image
+
+        Image.fromarray(rgb_u8).save(dst, quality=95)
+
+
 def _reload_rgb(path: str, size: int) -> np.ndarray:
     """The display copy: decoded + resized, NOT normalized."""
     from deep_vision_tpu.data.datasets import decode_image
@@ -279,8 +292,6 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 0
 
     if cfg.task in ("dcgan", "cyclegan"):
-        import cv2
-
         if cfg.task == "dcgan":
             model = get_model("dcgan_generator")
             z = np.random.RandomState(0).randn(len(args.images), 100)
@@ -302,7 +313,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         for f, im in zip(args.images, imgs):
             u8 = np.clip((im + 1.0) * 127.5, 0, 255).astype(np.uint8)
             dst = outpath(f, "_generated.jpg")
-            cv2.imwrite(dst, u8[..., ::-1])  # RGB -> BGR for cv2
+            _write_jpeg(dst, u8)
             print(f"{f} -> {dst}")
         return 0
 

@@ -22,18 +22,20 @@ import time
 import numpy as np
 
 
-def _median_ms(fn, *args, steps=10, windows=3):
-    import jax
-
-    for _ in range(2):
-        out = fn(*args)
-    jax.block_until_ready(out)
+def _median_ms(call, steps=10, windows=3):
+    """Median wall ms per `call()`. `call` must return a DEVICE SCALAR:
+    timing is closed by a float() fetch — on this rig's relay backend,
+    block_until_ready() can return before execution completes, silently
+    measuring enqueue time (a 70 ms step once "measured" 3 ms that way)."""
+    for _ in range(3):
+        out = call()
+    float(out)
     dts = []
     for _ in range(windows):
         t0 = time.perf_counter()
         for _ in range(steps):
-            out = fn(*args)
-        jax.block_until_ready(out)
+            out = call()
+        float(out)
         dts.append((time.perf_counter() - t0) / steps)
     return float(np.median(dts)) * 1e3
 
@@ -46,7 +48,6 @@ def bench_yolo(batch: int = 16, size: int = 416, classes: int = 80) -> dict:
     from deep_vision_tpu.core.train_state import create_train_state
     from deep_vision_tpu.losses.yolo import yolo_train_loss_fn
     from deep_vision_tpu.models import get_model
-    from deep_vision_tpu.ops.anchors import assign_anchors_to_grid  # noqa: F401
     from deep_vision_tpu.train.optimizers import build_optimizer
 
     model = get_model("yolov3", num_classes=classes, dtype=jnp.bfloat16)
@@ -86,19 +87,13 @@ def bench_yolo(batch: int = 16, size: int = 416, classes: int = 80) -> dict:
 
     step = jax.jit(train_step, donate_argnums=0)
 
-    # warmup+windows with explicit state threading (donation)
-    s = state
-    for _ in range(3):
-        s, loss = step(s, batch_d)
-    float(loss)
-    dts = []
-    for _ in range(3):
-        t0 = time.perf_counter()
-        for _ in range(10):
-            s, loss = step(s, batch_d)
-        float(loss)
-        dts.append((time.perf_counter() - t0) / 10)
-    ms = float(np.median(dts)) * 1e3
+    box = {"state": state}  # donation: thread the live state through calls
+
+    def call():
+        box["state"], loss = step(box["state"], batch_d)
+        return loss
+
+    ms = _median_ms(call)
     return {
         "what": f"yolov3-{size} train step (fwd + 3-scale loss + bwd + sgd), "
                 f"bf16, batch {batch}, {classes} classes, 100 padded boxes",
@@ -125,27 +120,27 @@ def bench_flash(b=4, t=4096, h=8, d=64) -> dict:
         for _ in range(3)
     )
 
-    @jax.jit
-    def flash_fwd_bwd(q, k, v):
-        return jax.grad(
-            lambda q, k, v: jnp.sum(
-                flash_attention(q, k, v, causal=True).astype(jnp.float32)
-            ),
-            argnums=(0, 1, 2),
-        )(q, k, v)
+    def _scalarized(attn):
+        # grads still fully computed; reduced to one scalar so _median_ms
+        # can close timing with a float() fetch
+        @jax.jit
+        def fwd_bwd(q, k, v):
+            grads = jax.grad(
+                lambda q, k, v: jnp.sum(attn(q, k, v).astype(jnp.float32)),
+                argnums=(0, 1, 2),
+            )(q, k, v)
+            return sum(jnp.sum(g.astype(jnp.float32)) for g in grads)
 
-    @jax.jit
-    def dense_fwd_bwd(q, k, v):
-        return jax.grad(
-            lambda q, k, v: jnp.sum(
-                _dense_reference(q, k, v, True, d ** -0.5)
-                .astype(jnp.float32)
-            ),
-            argnums=(0, 1, 2),
-        )(q, k, v)
+        return fwd_bwd
 
-    flash_ms = _median_ms(flash_fwd_bwd, q, k, v)
-    dense_ms = _median_ms(dense_fwd_bwd, q, k, v)
+    flash_fn = _scalarized(
+        lambda q, k, v: flash_attention(q, k, v, causal=True)
+    )
+    dense_fn = _scalarized(
+        lambda q, k, v: _dense_reference(q, k, v, True, d ** -0.5)
+    )
+    flash_ms = _median_ms(lambda: flash_fn(q, k, v))
+    dense_ms = _median_ms(lambda: dense_fn(q, k, v))
     return {
         "what": f"attention fwd+bwd, causal bf16, B{b} T{t} H{h} D{d}",
         "pallas_flash_ms": round(flash_ms, 1),
