@@ -294,13 +294,18 @@ def _timed_windows(batch_per_chip: int, multistep: int):
     """Run warmup + WINDOWS timed windows with transient-failure retry.
 
     Returns (per-step wall seconds list, step, state, batch, batch_size,
-    n_chips, devices, errors). Windows that complete before a failure are
-    kept; the failed window is replayed on the rebuilt step.
+    n_chips, devices, errors). On a transient failure ALL windows are
+    replayed on the rebuilt step: windows timed before the failure may have
+    run on a degraded-but-not-yet-dead tunnel, and mixing them into the
+    median would skew the headline (r3 advisor finding). Only if the retry
+    budget exhausts with zero healthy-session windows do the pre-failure
+    windows feed the median, flagged in `errors` as degraded.
     """
     dispatches = max(1, math.ceil(TIMED_STEPS / multistep))
     steps_per_window = dispatches * multistep
     errors = []
     window_dts = []
+    stale_dts = []  # pre-failure windows: degraded fallback only
     built = None
     last_good = None  # survives rebuild failures: completed windows stay
                       # attributed to a real (step, ..., devices) tuple
@@ -341,11 +346,18 @@ def _timed_windows(batch_per_chip: int, multistep: int):
             attempt += 1
             errors.append(f"{type(e).__name__}: {e}")
             _log(f"transient failure #{attempt} ({errors[-1][:200]})")
+            if window_dts:
+                stale_dts = window_dts
+                window_dts = []  # discard pre-failure windows: one healthy
+                                 # session only feeds the median
             if attempt > MAX_RETRIES:
                 _log("retry budget exhausted")
                 break
             built = None  # rebuild: donated/invalid buffers are gone
             _recover_backend(attempt)
+    if not window_dts and stale_dts:
+        window_dts = stale_dts
+        errors.append("degraded: median from pre-failure windows")
     if last_good is None:
         return window_dts, None, None, None, 0, 0, [], errors
     step, state, batch, batch_size, n_chips, devices = last_good
@@ -368,7 +380,7 @@ def main(args) -> None:
          errors) = _timed_windows(args.batch, args.multistep)
         if errors:
             result["errors"] = errors[-3:]
-            result["windows_completed"] = len(window_dts)
+        result["windows_completed"] = len(window_dts)
         if not window_dts:
             return  # degraded emission from finally
         _log(f"{n_chips}x {devices[0].device_kind} | resnet50 bf16 "

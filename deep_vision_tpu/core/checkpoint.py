@@ -76,7 +76,11 @@ class CheckpointManager:
             step, args=ocp.args.StandardSave(state_arrays(state))
         )
         # multi-host: orbax coordinates the array save across processes;
-        # the JSON sidecar is host-side state, written once by the primary
+        # the JSON sidecar is host-side state, written once by the primary.
+        # REQUIRES a shared checkpoint filesystem (the standard orbax
+        # multi-host setup): non-primary hosts read the same sidecar on
+        # restore. With per-host local directories they would see
+        # host_state=None and resume with divergent plateau/LR state.
         if saved and host_state is not None and jax.process_index() == 0:
             with open(self._sidecar_path(step), "w") as f:
                 json.dump(host_state, f)

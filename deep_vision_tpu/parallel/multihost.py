@@ -163,7 +163,7 @@ class PreemptionGuard:
             self._prev_handler = None
         return False
 
-    def agreed(self, step: Optional[int] = None, *, force: bool = False) -> bool:
+    def agreed(self, *, step: Optional[int] = None, force: bool = False) -> bool:
         if self._agreed:
             return True
         if jax.process_count() == 1:

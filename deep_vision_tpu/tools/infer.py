@@ -67,8 +67,9 @@ def _write_jpeg(dst: str, rgb_u8: np.ndarray) -> None:
     try:
         import cv2
 
-        cv2.imwrite(dst, rgb_u8[..., ::-1])  # RGB -> BGR for cv2
-    except ImportError:
+        if not cv2.imwrite(dst, rgb_u8[..., ::-1]):  # RGB -> BGR for cv2
+            raise IOError(f"cv2.imwrite returned False for {dst}")
+    except Exception:  # cv2 may fail at load time with OSError, not ImportError
         from PIL import Image
 
         Image.fromarray(rgb_u8).save(dst, quality=95)

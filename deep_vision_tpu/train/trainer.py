@@ -264,7 +264,7 @@ class Trainer:
             # Keyed on the eval-batch index, which is host-identical because
             # the SPMD eval_step itself already requires every host to make
             # the same sequence of calls.
-            if self._pguard is not None and self._pguard.agreed(step):
+            if self._pguard is not None and self._pguard.agreed(step=step):
                 break  # caller re-checks with force=True and checkpoints
             n = np.shape(batch[self.input_key])[0]
             metrics = self.eval_step(batch)
@@ -374,7 +374,7 @@ class Trainer:
             )
             # poll keyed to the optimizer step — globally consistent across
             # hosts, immune to unequal agreed() call counts elsewhere
-            if self._pguard is not None and self._pguard.agreed(opt_step):
+            if self._pguard is not None and self._pguard.agreed(step=opt_step):
                 # no end_epoch: a partial-epoch summary would pollute the
                 # history/TensorBoard rows the re-run epoch writes again.
                 # epoch-1: this epoch is incomplete, resume re-runs it

@@ -624,7 +624,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                 # drop_remainder loaders yield equal counts), so every host
                 # rendezvouses at the same boundary
                 for batch_i, batch in enumerate(train_fn()):
-                    if guard.agreed(batch_i):
+                    if guard.agreed(step=batch_i):
                         interrupted = True
                         break
                     if cfg.task == "dcgan":

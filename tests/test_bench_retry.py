@@ -64,6 +64,11 @@ def test_transient_failure_mid_window_rebuilds_and_completes(monkeypatch):
     assert len(dts) == bench.WINDOWS
     assert len(builds) == 2
     assert len(errors) == 1 and "remote_compile" in errors[0]
+    # r3 advisor: pre-failure windows must NOT feed the median — every
+    # window replays on the rebuilt (healthy) step
+    assert builds[1].calls == bench.WARMUP_STEPS + (
+        bench.WINDOWS * bench.TIMED_STEPS
+    )
 
 
 def test_retry_exhaustion_keeps_completed_windows(monkeypatch, capsys):
