@@ -60,6 +60,19 @@ def classification_loss_fn(
             continue
         if isinstance(aux, dict):
             for name, value in aux.items():
+                # '_'-prefixed names are DIAGNOSTIC metrics, surfaced but
+                # never added to the loss (e.g. the MoE router entropy /
+                # expert-load telemetry from models/vit.py). The reserved-
+                # key guard applies to the SURFACED name: '_loss' would be
+                # silently clobbered by the real loss below.
+                if name.startswith("_"):
+                    if name[1:] in ("loss", "top1", "top5"):
+                        raise ValueError(
+                            f"aux metric name {name!r} collides with a "
+                            "reserved metric key; rename it"
+                        )
+                    metrics[name[1:]] = value
+                    continue
                 # reserved keys are written below and would silently
                 # swallow the penalty's metric (the penalty itself would
                 # still be added to the loss — a confusing half-effect)

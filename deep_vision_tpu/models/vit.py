@@ -227,7 +227,23 @@ class ViT(nn.Module):
             aux = jnp.mean(
                 jnp.stack([load_balancing_loss(g) for g in all_gates])
             )
-            return logits, {"moe_aux": aux}
+            # router telemetry ('_'-prefixed = metrics-only, never added to
+            # the loss): mean per-token gate entropy in nats (ln E = uniform
+            # routing, 0 = hard routing) and the max fraction of tokens any
+            # one expert receives (1/E = balanced, 1.0 = collapse) — the
+            # instruments for diagnosing router cold-start stalls
+            gates = jnp.stack(all_gates)  # (L, T, E)
+            ent = -jnp.sum(gates * jnp.log(gates + 1e-9), axis=-1)
+            top1 = jax.nn.one_hot(
+                jnp.argmax(gates, axis=-1), gates.shape[-1],
+                dtype=jnp.float32,
+            )
+            load_max = jnp.max(jnp.mean(top1, axis=1))
+            return logits, {
+                "moe_aux": aux,
+                "_router_entropy": jnp.mean(ent),
+                "_expert_load_max": load_max,
+            }
         return logits
 
 

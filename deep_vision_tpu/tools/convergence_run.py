@@ -28,37 +28,45 @@ from typing import Optional
 
 
 def procedural_gratings(n: int, classes: int = 16, size: int = 112,
-                        seed: int = 0):
+                        seed: int = 0, noise: float = 0.15,
+                        amp_range=(0.35, 0.5)):
     """(images, labels): class = (orientation, spatial frequency) pair.
 
     Per-sample random phase, center offset, amplitude and pixel noise make
-    every image unique; the class-defining structure (angle in {0,45,90,135}
-    deg x frequency in 4 steps) is all that separates classes.
+    every image unique; the class-defining structure (angle x frequency) is
+    all that separates classes. `classes` factors as n_orientations x
+    n_frequencies with n_orientations = min(8, classes // 4 * 2) steps —
+    16 classes = 4 angles x 4 freqs (the r1-r3 task); 32 = 8 x 4.
+    `noise`/`amp_range` set the difficulty: r3's task saturated at val
+    top-1 = 1.0, so the r4 evidence runs raise noise until accuracy lands
+    strictly between chance and 1.0 (VERDICT r3 task 5).
     """
     import numpy as np
 
+    n_orient = 4 if classes <= 16 else 8
+    n_freq = max(1, classes // n_orient)
     rng = np.random.RandomState(seed)
     labels = rng.randint(0, classes, size=n)
     ys, xs = np.mgrid[0:size, 0:size].astype(np.float32) / size
     images = np.empty((n, size, size, 3), np.float32)
     for i, c in enumerate(labels):
-        theta = (c % 4) * np.pi / 4
-        freq = 4.0 + 3.0 * (c // 4)  # cycles per image: 4, 7, 10, 13
+        theta = (c % n_orient) * np.pi / n_orient
+        freq = 4.0 + (9.0 / max(1, n_freq - 1)) * (c // n_orient)
         phase = rng.uniform(0, 2 * np.pi)
         dx, dy = rng.uniform(-0.2, 0.2, size=2)
-        amp = rng.uniform(0.35, 0.5)
+        amp = rng.uniform(*amp_range)
         wave = np.sin(
             2 * np.pi * freq * ((xs - dx) * np.cos(theta)
                                 + (ys - dy) * np.sin(theta)) + phase
         )
         img = 0.5 + amp * wave[..., None]
-        img = img + rng.randn(size, size, 3).astype(np.float32) * 0.15
+        img = img + rng.randn(size, size, 3).astype(np.float32) * noise
         images[i] = np.clip(img, 0.0, 1.0)
     return images, labels.astype(np.int32)
 
 
 def _build_recipe(model_name: str, classes: int, sgd_lr: float,
-                  adamw_lr: float):
+                  adamw_lr: float, warmup: int = 0):
     """(state, recipe string, prep fn): the shared model/optimizer setup.
 
     `prep` maps host float images (N, 112, 112, 3) to the model's input
@@ -81,17 +89,28 @@ def _build_recipe(model_name: str, classes: int, sgd_lr: float,
         recipe = f"resnet50 (bf16, s2d stem, SGD {sgd_lr}/0.9/1e-4)"
         prep = lambda a: np.stack([space_to_depth(i) for i in a])
     else:  # the attention family: AdamW recipe on raw 112px inputs
+        import optax
+
         model = get_model(model_name, num_classes=classes, dtype=jnp.bfloat16)
-        tx = build_optimizer("adamw", adamw_lr, weight_decay=1e-4)
+        lr = (optax.linear_schedule(0.0, adamw_lr, warmup) if warmup
+              else adamw_lr)
+        tx = build_optimizer("adamw", lr, weight_decay=1e-4)
         sample = jnp.ones((8, 112, 112, 3), jnp.float32)
-        recipe = f"{model_name} (bf16, AdamW {adamw_lr}/1e-4)"
+        recipe = (f"{model_name} (bf16, AdamW {adamw_lr}/1e-4"
+                  + (f", warmup {warmup}" if warmup else "") + ")")
         prep = lambda a: a
     state = create_train_state(model, tx, sample, jax.random.PRNGKey(0))
     return state, recipe, prep
 
 
-def _train_step(state, batch):
-    """One classification train step (shared by run / run_holdout)."""
+def _train_step(state, batch, aux_weight: float = 0.01):
+    """One classification train step (shared by run / run_holdout).
+
+    Returns (new_state, metrics): metrics always carries 'loss' and, for
+    MoE models, the router telemetry ('router_entropy',
+    'expert_load_max', 'moe_aux' — see models/vit.py) used to diagnose
+    the round-3 V-MoE cold-start stall.
+    """
     import jax
 
     from deep_vision_tpu.losses.classification import classification_loss_fn
@@ -108,15 +127,19 @@ def _train_step(state, batch):
             rngs={"dropout": jax.random.fold_in(state.rng, state.step)},
             mutable=mutable)
         out, nms = out if mutable else (out, {})
-        loss, _ = classification_loss_fn(out, batch)
-        return loss, nms.get("batch_stats", {})
+        loss, metrics = classification_loss_fn(
+            out, batch, penalty_weight=aux_weight)
+        return loss, (nms.get("batch_stats", {}), metrics)
 
-    (loss, bs), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-        state.params)
+    (loss, (bs, metrics)), grads = jax.value_and_grad(
+        loss_fn, has_aux=True)(state.params)
     new_state = state.apply_gradients(grads)
     if state.batch_stats:
         new_state = new_state.replace(batch_stats=bs)
-    return new_state, loss
+    metrics = {k: v for k, v in metrics.items()
+               if k not in ("top1", "top5")}
+    metrics["loss"] = loss
+    return new_state, metrics
 
 
 def _write_artifact(out_path: str, result: dict) -> None:
@@ -126,8 +149,11 @@ def _write_artifact(out_path: str, result: dict) -> None:
 
 
 def run(steps: int = 200, batch: int = 64, classes: int = 64,
-        model_name: str = "resnet50", out_path: Optional[str] = None) -> dict:
+        model_name: str = "resnet50", out_path: Optional[str] = None,
+        warmup: int = 0, aux_weight: float = 0.01) -> dict:
     out_path = out_path or f"artifacts/{model_name}_tpu_convergence.json"
+    import functools
+
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -138,21 +164,30 @@ def run(steps: int = 200, batch: int = 64, classes: int = 64,
     rng = np.random.RandomState(0)
     imgs = rng.rand(batch, 112, 112, 3).astype(np.float32)
     state, recipe, prep = _build_recipe(model_name, classes,
-                                        sgd_lr=0.05, adamw_lr=1e-3)
+                                        sgd_lr=0.05, adamw_lr=1e-3,
+                                        warmup=warmup)
     batch_d = {
         "image": jnp.asarray(prep(imgs), jnp.bfloat16),
         "label": jnp.asarray(np.arange(batch) % classes, jnp.int32),
     }
 
-    step = jax.jit(_train_step, donate_argnums=0)
-    losses = []
+    step = jax.jit(
+        functools.partial(_train_step, aux_weight=aux_weight),
+        donate_argnums=0,
+    )
+    curves = {}  # name -> [(step, value)]
     t0 = time.time()
     for i in range(steps):
-        state, loss = step(state, batch_d)
+        state, metrics = step(state, batch_d)
         if i % 10 == 0 or i == steps - 1:
-            losses.append((i, float(loss)))
+            # one device->host fetch for ALL scalars: per-scalar float()
+            # pays one ~118 ms relay sync EACH on this rig (bench.py)
+            host = jax.device_get(metrics)
+            for k, v in host.items():
+                curves.setdefault(k, []).append((i, float(v)))
     wall = time.time() - t0
 
+    losses = curves["loss"]
     dev = jax.devices()[0]
     result = {
         "model": recipe,
@@ -160,18 +195,26 @@ def run(steps: int = 200, batch: int = 64, classes: int = 64,
         "steps": steps,
         "batch": batch,
         "classes": classes,
+        "aux_weight": aux_weight,
+        "warmup": warmup,
         "wall_seconds": round(wall, 1),
         "loss_curve": [[i, round(l, 4)] for i, l in losses],
         "first_loss": round(losses[0][1], 4),
         "final_loss": round(losses[-1][1], 4),
     }
+    # router telemetry curves (MoE models): entropy in nats (ln E =
+    # uniform), max expert load fraction (1/E = balanced)
+    for k in ("router_entropy", "expert_load_max", "moe_aux"):
+        if k in curves:
+            result[f"{k}_curve"] = [[i, round(v, 4)] for i, v in curves[k]]
     _write_artifact(out_path, result)
     return result
 
 
 def run_holdout(steps: int = 300, batch: int = 64, classes: int = 16,
                 model_name: str = "resnet50", out_path: Optional[str] = None,
-                n_train: int = 512, n_val: int = 256) -> dict:
+                n_train: int = 512, n_val: int = 256,
+                noise: float = 0.15) -> dict:
     """Train on a procedural split, score the HELD-OUT split.
 
     Evidence of generalization, not memorization: val images are freshly
@@ -184,8 +227,8 @@ def run_holdout(steps: int = 300, batch: int = 64, classes: int = 16,
 
     from deep_vision_tpu.core.metrics import topk_accuracy
 
-    tr_x, tr_y = procedural_gratings(n_train, classes, seed=0)
-    va_x, va_y = procedural_gratings(n_val, classes, seed=1)
+    tr_x, tr_y = procedural_gratings(n_train, classes, seed=0, noise=noise)
+    va_x, va_y = procedural_gratings(n_val, classes, seed=1, noise=noise)
     # lower LRs than run(): generalizing a split is harder than memorizing
     # one fixed batch
     state, recipe, prep = _build_recipe(model_name, classes,
@@ -215,9 +258,9 @@ def run_holdout(steps: int = 300, batch: int = 64, classes: int = 16,
     t0 = time.time()
     for i in range(steps):
         idx = jnp.asarray(rng.randint(0, n_train, size=batch))
-        state, loss = step(state, data_x, data_y, idx)
+        state, metrics = step(state, data_x, data_y, idx)
         if i % 10 == 0 or i == steps - 1:
-            losses.append((i, float(loss)))
+            losses.append((i, float(metrics["loss"])))
     wall = time.time() - t0
 
     def split_top1(x, y):
@@ -242,6 +285,7 @@ def run_holdout(steps: int = 300, batch: int = 64, classes: int = 16,
         "dataset": "procedural gratings: class = orientation x frequency, "
                    "per-sample phase/offset/noise jitter; val resampled "
                    "with a different seed",
+        "noise": noise,
         "device": f"{dev.platform}:{dev.device_kind}",
         "steps": steps,
         "batch": batch,
@@ -261,6 +305,305 @@ def run_holdout(steps: int = 300, batch: int = 64, classes: int = 16,
     return result
 
 
+def procedural_shapes(n: int, size: int = 192, max_boxes: int = 3,
+                      seed: int = 0, noise: float = 0.15):
+    """Detection analog of procedural_gratings: class = shape kind.
+
+    Each image carries 1..max_boxes non-degenerate shapes (0=disc, 1=square
+    outline, 2=cross) with random size/position/brightness on a noisy
+    background. Returns (images (N,S,S,3) f32, boxes (N,M,4) xyxy
+    normalized 0-padded, classes (N,M) int32 -1-padded) — exactly the
+    padded-GT layout losses/yolo.yolo_train_loss_fn consumes.
+    """
+    import numpy as np
+
+    rng = np.random.RandomState(seed)
+    images = rng.rand(n, size, size, 3).astype(np.float32) * noise
+    boxes = np.zeros((n, max_boxes, 4), np.float32)
+    classes = np.full((n, max_boxes), -1, np.int32)
+    ys, xs = np.mgrid[0:size, 0:size]
+    for i in range(n):
+        k = rng.randint(1, max_boxes + 1)
+        for j in range(k):
+            r = rng.randint(size // 16, size // 6)  # half-extent in px
+            cy = rng.randint(r + 1, size - r - 1)
+            cx = rng.randint(r + 1, size - r - 1)
+            cls = rng.randint(0, 3)
+            amp = rng.uniform(0.55, 0.95)
+            ch = rng.randint(0, 3)
+            if cls == 0:  # filled disc
+                mask = (ys - cy) ** 2 + (xs - cx) ** 2 <= r * r
+            elif cls == 1:  # square outline
+                inside = (abs(ys - cy) <= r) & (abs(xs - cx) <= r)
+                inner = (abs(ys - cy) <= r - 3) & (abs(xs - cx) <= r - 3)
+                mask = inside & ~inner
+            else:  # cross
+                mask = ((abs(ys - cy) <= 2) | (abs(xs - cx) <= 2)) & \
+                       (abs(ys - cy) <= r) & (abs(xs - cx) <= r)
+            images[i, ..., ch][mask] = amp
+            boxes[i, j] = [(cx - r) / size, (cy - r) / size,
+                           (cx + r) / size, (cy + r) / size]
+            classes[i, j] = cls
+    return images, boxes, classes
+
+
+def run_holdout_detection(steps: int = 400, batch: int = 16,
+                          size: int = 192, out_path: Optional[str] = None,
+                          n_train: int = 256, n_val: int = 64,
+                          lr: float = 1e-3) -> dict:
+    """Train YOLOv3 on procedural shapes ON-CHIP, score HELD-OUT mAP via
+    the real decode -> NMS -> VOC-matching eval path (inference.py +
+    core/detection_metrics.py) — the detection analog of run_holdout
+    (VERDICT r3 task 5; evidence shape of `--eval-only` mAP).
+    """
+    out_path = out_path or "artifacts/yolov3_holdout.json"
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from deep_vision_tpu.core.detection_metrics import DetectionEvaluator
+    from deep_vision_tpu.inference import make_yolo_detector
+    from deep_vision_tpu.losses.yolo import yolo_train_loss_fn
+    from deep_vision_tpu.models import get_model
+    from deep_vision_tpu.train.optimizers import build_optimizer
+    from deep_vision_tpu.core.train_state import create_train_state
+
+    tr_x, tr_b, tr_c = procedural_shapes(n_train, size, seed=0)
+    va_x, va_b, va_c = procedural_shapes(n_val, size, seed=1)
+
+    model = get_model("yolov3", num_classes=3)
+    tx = build_optimizer("adam", lr, grad_clip_norm=10.0)
+    sample = jnp.ones((2, size, size, 3), jnp.float32)
+    state = create_train_state(model, tx, sample, jax.random.PRNGKey(0))
+    loss_fn = functools.partial(
+        yolo_train_loss_fn,
+        grid_sizes=(size // 32, size // 16, size // 8), num_classes=3,
+    )
+
+    def train_step(state, data, idx):
+        batch_d = {k: jnp.take(v, idx, axis=0) for k, v in data.items()}
+
+        def lf(params):
+            outputs = state.apply_fn(
+                {"params": params}, batch_d["image"], train=True,
+                rngs={"dropout": jax.random.fold_in(state.rng, state.step)},
+            )
+            loss, metrics = loss_fn(outputs, batch_d)
+            return loss, metrics
+
+        (loss, metrics), grads = jax.value_and_grad(lf, has_aux=True)(
+            state.params)
+        return state.apply_gradients(grads), metrics
+
+    # device-resident dataset (per-step host->device transfers through the
+    # relay dwarf the step itself; see round-3 memory)
+    data = {
+        "image": jnp.asarray(tr_x, jnp.float32),
+        "boxes": jnp.asarray(tr_b),
+        "classes": jnp.asarray(tr_c),
+    }
+    step = jax.jit(train_step, donate_argnums=0)
+    rng = np.random.RandomState(7)
+    losses = []
+    t0 = time.time()
+    for i in range(steps):
+        idx = jnp.asarray(rng.randint(0, n_train, size=batch))
+        state, metrics = step(state, data, idx)
+        if i % 20 == 0 or i == steps - 1:
+            losses.append((i, float(metrics["loss"])))
+    wall = time.time() - t0
+
+    # held-out eval through the REAL inference path (decode -> class-aware
+    # NMS -> greedy VOC matching), the `--eval-only` machinery
+    detect = make_yolo_detector(model, score_threshold=0.1)
+    ev = DetectionEvaluator(num_classes=3)
+    variables = {"params": state.params}
+    for s in range(0, n_val, batch):
+        imgs = jnp.asarray(va_x[s:s + batch], jnp.float32)
+        det = detect(variables, imgs)
+        for j in range(imgs.shape[0]):
+            n = int(det["num"][j])
+            gt = va_b[s + j][va_c[s + j] >= 0]
+            gc = va_c[s + j][va_c[s + j] >= 0]
+            ev.add(np.asarray(det["boxes"][j][:n]),
+                   np.asarray(det["scores"][j][:n]),
+                   np.asarray(det["classes"][j][:n]), gt, gc)
+    res = ev.compute(iou_threshold=0.5)
+
+    dev = jax.devices()[0]
+    result = {
+        "model": f"yolov3-{size} (adam {lr}, grad-clip 10)",
+        "dataset": "procedural shapes: disc / square outline / cross, "
+                   "1-3 per image, random size/position/channel; val "
+                   "resampled with a different seed",
+        "device": f"{dev.platform}:{dev.device_kind}",
+        "steps": steps, "batch": batch, "n_train": n_train, "n_val": n_val,
+        "wall_seconds": round(wall, 1),
+        "loss_curve": [[i, round(l, 4)] for i, l in losses],
+        "val_map50": round(float(res["mAP"]), 4),
+        "val_ap_per_class": {str(k): round(float(v), 4)
+                             for k, v in res.get("ap_per_class", {}).items()},
+    }
+    _write_artifact(out_path, result)
+    return result
+
+
+def procedural_figures(n: int, size: int = 128, seed: int = 0,
+                       noise: float = 0.2):
+    """Pose analog: a 5-keypoint stick figure (head, 2 hands, 2 feet).
+
+    Figures vary in center, scale, limb angles and brightness over a noisy
+    background; the head is a disc whose diameter is the PCKh norm. Returns
+    (images (N,S,S,3) f32, kpts (N,5,2) normalized xy, head_sizes (N,)
+    normalized).
+    """
+    import numpy as np
+
+    rng = np.random.RandomState(seed)
+    images = rng.rand(n, size, size, 3).astype(np.float32) * noise
+    kpts = np.zeros((n, 5, 2), np.float32)
+    heads = np.zeros((n,), np.float32)
+    ys, xs = np.mgrid[0:size, 0:size]
+    for i in range(n):
+        s = rng.uniform(0.22, 0.32) * size          # torso length px
+        cx = rng.uniform(0.35, 0.65) * size
+        cy = rng.uniform(0.35, 0.6) * size
+        amp = rng.uniform(0.6, 0.95)
+        hr = s * 0.28                               # head radius
+        head = (cx + rng.uniform(-4, 4), cy - s * 0.55)
+        pts = [head]
+        for base in (-0.45, 0.45):                  # hands
+            a = base * np.pi + rng.uniform(-0.5, 0.5)
+            pts.append((cx + np.sin(a) * s * 0.9,
+                        cy - s * 0.1 + np.cos(a) * s * 0.35))
+        for base in (-0.2, 0.2):                    # feet
+            a = base * np.pi + rng.uniform(-0.25, 0.25)
+            pts.append((cx + np.sin(a) * s * 0.8,
+                        cy + s * 0.55 + abs(np.cos(a)) * s * 0.45))
+        # draw: head disc + limbs as thick lines from the torso center
+        mask = (ys - head[1]) ** 2 + (xs - head[0]) ** 2 <= hr * hr
+        ch = rng.randint(0, 3)
+        images[i, ..., ch][mask] = amp
+        for px, py in pts[1:]:
+            t = np.linspace(0, 1, 64)[:, None]
+            lx = cx + (px - cx) * t
+            ly = cy + (py - cy) * t
+            for lxx, lyy in zip(lx[:, 0], ly[:, 0]):
+                d2 = (ys - lyy) ** 2 + (xs - lxx) ** 2
+                images[i, ..., ch][d2 <= 4.0] = amp
+        kpts[i] = np.asarray(pts, np.float32) / size
+        heads[i] = 2 * hr / size
+    np.clip(images, 0.0, 1.0, out=images)
+    return images, kpts, heads
+
+
+def run_holdout_pose(steps: int = 300, batch: int = 16, size: int = 128,
+                     out_path: Optional[str] = None, n_train: int = 256,
+                     n_val: int = 64, lr: float = 2.5e-4) -> dict:
+    """Train a 2-stack hourglass on procedural figures ON-CHIP, score
+    HELD-OUT PCKh@0.5 via the real heatmap-peak decode
+    (inference.heatmaps_to_keypoints + detection_metrics.pckh) — the pose
+    analog of run_holdout (VERDICT r3 task 5).
+    """
+    out_path = out_path or "artifacts/hourglass_holdout.json"
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from deep_vision_tpu.core.detection_metrics import pckh
+    from deep_vision_tpu.core.train_state import create_train_state
+    from deep_vision_tpu.inference import heatmaps_to_keypoints
+    from deep_vision_tpu.losses.heatmap import hourglass_loss_fn
+    from deep_vision_tpu.models import get_model
+    from deep_vision_tpu.ops.heatmaps import gaussian_heatmaps
+    from deep_vision_tpu.train.optimizers import build_optimizer
+
+    tr_x, tr_k, tr_h = procedural_figures(n_train, size, seed=0)
+    va_x, va_k, va_h = procedural_figures(n_val, size, seed=1)
+
+    model = get_model("hourglass", num_stack=2, num_heatmap=5)
+    tx = build_optimizer("adam", lr)
+    sample = jnp.ones((2, size, size, 3), jnp.float32)
+    state = create_train_state(model, tx, sample, jax.random.PRNGKey(0))
+    hm_size = size // 4  # stem downsamples /4 (models/hourglass.py)
+
+    # GT heatmaps at output resolution, once, device-resident
+    def to_heatmaps(kpts):
+        pts = jnp.asarray(kpts) * hm_size
+        return jax.vmap(
+            lambda p: gaussian_heatmaps(p, hm_size, hm_size, sigma=1.5)
+        )(pts)
+
+    data = {
+        "image": jnp.asarray(tr_x, jnp.float32),
+        "heatmap": jnp.asarray(to_heatmaps(tr_k), jnp.float32),
+    }
+
+    def train_step(state, data, idx):
+        batch_d = {k: jnp.take(v, idx, axis=0) for k, v in data.items()}
+
+        def lf(params):
+            outputs = state.apply_fn(
+                {"params": params, "batch_stats": state.batch_stats},
+                batch_d["image"], train=True, mutable=["batch_stats"],
+            )
+            outputs, nms = outputs
+            loss, metrics = hourglass_loss_fn(outputs, batch_d)
+            return loss, (nms["batch_stats"], metrics)
+
+        (loss, (bs, metrics)), grads = jax.value_and_grad(
+            lf, has_aux=True)(state.params)
+        return (state.apply_gradients(grads).replace(batch_stats=bs),
+                metrics)
+
+    step = jax.jit(train_step, donate_argnums=0)
+    rng = np.random.RandomState(7)
+    losses = []
+    t0 = time.time()
+    for i in range(steps):
+        idx = jnp.asarray(rng.randint(0, n_train, size=batch))
+        state, metrics = step(state, data, idx)
+        if i % 20 == 0 or i == steps - 1:
+            losses.append((i, float(metrics["loss"])))
+    wall = time.time() - t0
+
+    # held-out PCKh through the real decode path
+    @jax.jit
+    def predict(state, images):
+        outputs = state.apply_fn(
+            {"params": state.params, "batch_stats": state.batch_stats},
+            images, train=False,
+        )
+        return heatmaps_to_keypoints(outputs[-1])
+
+    preds = []
+    for s in range(0, n_val, batch):
+        kp = predict(state, jnp.asarray(va_x[s:s + batch], jnp.float32))
+        preds.append(np.asarray(kp))
+    preds = np.concatenate(preds)[..., :2]
+    vis = np.ones(va_k.shape[:2], bool)
+    res = pckh(preds, va_k, vis, va_h, alpha=0.5)
+
+    dev = jax.devices()[0]
+    result = {
+        "model": f"hourglass-2stack-{size} (adam {lr})",
+        "dataset": "procedural 5-keypoint stick figures (head disc + "
+                   "hands/feet), random scale/pose/channel; val resampled "
+                   "with a different seed",
+        "device": f"{dev.platform}:{dev.device_kind}",
+        "steps": steps, "batch": batch, "n_train": n_train, "n_val": n_val,
+        "wall_seconds": round(wall, 1),
+        "loss_curve": [[i, round(l, 5)] for i, l in losses],
+        "val_pckh50": round(float(res["PCKh@0.5"]), 4),
+        "val_pck_per_joint": [round(float(v), 4)
+                              for v in res.get("per_joint", [])],
+    }
+    _write_artifact(out_path, result)
+    return result
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("--steps", type=int, default=None,
@@ -270,6 +613,10 @@ def main(argv=None) -> int:
                    help="resnet50 | vit_s16 | vmoe_s16")
     p.add_argument("--holdout", action="store_true",
                    help="procedural train/val split; report held-out top-1")
+    p.add_argument("--warmup", type=int, default=0,
+                   help="linear LR warmup steps (attention family only)")
+    p.add_argument("--aux-weight", type=float, default=0.01,
+                   help="MoE load-balance penalty weight")
     p.add_argument("--out", default=None)
     args = p.parse_args(argv)
     if args.holdout:
@@ -284,7 +631,8 @@ def main(argv=None) -> int:
         print("GENERALIZED" if ok else "DID NOT GENERALIZE")
         return 0 if ok else 1
     out = args.out or f"artifacts/{args.model}_tpu_convergence.json"
-    r = run(args.steps or 200, args.batch, model_name=args.model, out_path=out)
+    r = run(args.steps or 200, args.batch, model_name=args.model,
+            out_path=out, warmup=args.warmup, aux_weight=args.aux_weight)
     print(f"device={r['device']} first={r['first_loss']} "
           f"final={r['final_loss']} wall={r['wall_seconds']}s -> {out}")
     ok = r["final_loss"] < 0.5 * r["first_loss"]

@@ -46,6 +46,24 @@ def main(argv=None) -> int:
                           help="CSV from `imagenet_bboxes`; attaches "
                                "image/object/bbox/* fields per filename")
 
+    prep = sub.add_parser(
+        "prepare-imagenet",
+        help="raw ILSVRC2012 download -> flattened train/val layout "
+             "(untar-script.sh + flatten-script.sh + flatten-val-script.sh "
+             "analog)",
+    )
+    prep.add_argument("--out-dir", required=True)
+    prep.add_argument("--train-tars", default=None,
+                      help="dir of per-synset nXXXXXXXX.tar files")
+    prep.add_argument("--train-dir", default=None,
+                      help="already-untarred per-synset tree")
+    prep.add_argument("--val-dir", default=None,
+                      help="flat ILSVRC2012_val_*.JPEG folder")
+    prep.add_argument("--val-synsets", default=None,
+                      help="imagenet_2012_validation_synset_labels.txt")
+    prep.add_argument("--move", action="store_true",
+                      help="move instead of hardlink/copy")
+
     inbb = sub.add_parser(
         "imagenet_bboxes",
         help="ImageNet bbox XMLs -> relative-coords CSV "
@@ -94,6 +112,15 @@ def main(argv=None) -> int:
                                        bbox_csv=args.bbox_csv)
         C.build_shards(annos, C.imagenet_example, args.out_dir, args.prefix,
                        args.num_shards, **common)
+    elif args.dataset == "prepare-imagenet":
+        stats = C.prepare_imagenet(
+            args.out_dir, train_tars=args.train_tars,
+            train_dir=args.train_dir, val_dir=args.val_dir,
+            val_synsets=args.val_synsets, move=args.move,
+        )
+        print(f"prepare-imagenet: {stats['train']} train -> "
+              f"{args.out_dir}/train_flatten, {stats['val']} val -> "
+              f"{args.out_dir}/val_flatten")
     elif args.dataset == "imagenet_bboxes":
         stats = C.imagenet_bbox_csv(args.xml_dir, args.out_csv, args.synsets)
         annotated = (stats["files"] - stats["skipped_files"]

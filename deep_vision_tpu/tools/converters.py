@@ -374,6 +374,99 @@ def load_bbox_csv(csv_path: str) -> dict:
     return dict(boxes)
 
 
+def _place(src: str, dst: str, move: bool) -> None:
+    """Hardlink (same filesystem, zero extra disk) -> move -> copy."""
+    if move:
+        shutil.move(src, dst)
+        return
+    try:
+        os.link(src, dst)
+    except OSError:
+        shutil.copy2(src, dst)
+
+
+def prepare_imagenet(out_dir: str,
+                     train_tars: Optional[str] = None,
+                     train_dir: Optional[str] = None,
+                     val_dir: Optional[str] = None,
+                     val_synsets: Optional[str] = None,
+                     move: bool = False) -> Dict[str, int]:
+    """Raw ILSVRC2012 download -> the flattened layout the converter eats.
+
+    The analog of the reference's three shell scripts
+    (Datasets/ILSVRC2012/untar-script.sh, flatten-script.sh,
+    flatten-val-script.sh), minus their double disk copy:
+
+    - `train_tars`: directory of per-synset `nXXXXXXXX.tar` files (the
+      inner tars of ILSVRC2012_img_train.tar). Members are already named
+      `nXXXXXXXX_*.JPEG`, so they extract STRAIGHT into
+      `<out_dir>/train_flatten/` — untar + flatten in one pass.
+    - `train_dir`: alternatively, an already-untarred tree with per-synset
+      subdirectories; files are hardlinked (or moved with `move=True`)
+      into `train_flatten/` (flatten-script.sh).
+    - `val_dir` + `val_synsets`: the flat `ILSVRC2012_val_*.JPEG` folder
+      plus imagenet_2012_validation_synset_labels.txt (line i = synset of
+      val image i, sorted order). Files land in `<out_dir>/val_flatten/`
+      renamed `<synset>_<origname>` so the converter's synset-prefix
+      convention (imagenet_annotations) applies — what
+      flatten-val-script.sh achieves by prefixing directory names.
+
+    Returns counts per split. Idempotent: existing destinations are kept.
+    """
+    import tarfile
+
+    stats = {"train": 0, "val": 0}
+    if train_tars or train_dir:
+        tdst = os.path.join(out_dir, "train_flatten")
+        os.makedirs(tdst, exist_ok=True)
+    if train_tars:
+        tars = sorted(t for t in os.listdir(train_tars)
+                      if t.endswith(".tar"))
+        for t in tars:
+            with tarfile.open(os.path.join(train_tars, t)) as tf:
+                for m in tf.getmembers():
+                    if not m.isfile():
+                        continue
+                    name = os.path.basename(m.name)
+                    dst = os.path.join(tdst, name)
+                    if not os.path.exists(dst):
+                        with tf.extractfile(m) as src, open(dst, "wb") as f:
+                            shutil.copyfileobj(src, f)
+                    stats["train"] += 1
+    if train_dir:
+        for synset in sorted(os.listdir(train_dir)):
+            sdir = os.path.join(train_dir, synset)
+            if not os.path.isdir(sdir):
+                continue
+            for name in sorted(os.listdir(sdir)):
+                dst = os.path.join(tdst, name)
+                if not os.path.exists(dst):
+                    _place(os.path.join(sdir, name), dst, move)
+                stats["train"] += 1
+    if val_dir:
+        if not val_synsets:
+            raise ValueError(
+                "val_dir requires val_synsets "
+                "(imagenet_2012_validation_synset_labels.txt)"
+            )
+        with open(val_synsets) as f:
+            labels = [line.strip() for line in f if line.strip()]
+        vdst = os.path.join(out_dir, "val_flatten")
+        os.makedirs(vdst, exist_ok=True)
+        names = sorted(n for n in os.listdir(val_dir)
+                       if n.lower().endswith((".jpeg", ".jpg", ".png")))
+        if len(names) != len(labels):
+            raise ValueError(
+                f"{len(names)} val images but {len(labels)} synset labels"
+            )
+        for name, synset in zip(names, labels):
+            dst = os.path.join(vdst, f"{synset}_{name}")
+            if not os.path.exists(dst):
+                _place(os.path.join(val_dir, name), dst, move)
+            stats["val"] += 1
+    return stats
+
+
 def imagenet_annotations(root: str, synsets_path: str,
                          bbox_csv: Optional[str] = None) -> List[dict]:
     """Flattened `nXXXXXXXX_*.JPEG` folder -> annotations with 1-based labels

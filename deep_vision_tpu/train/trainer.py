@@ -36,6 +36,10 @@ from deep_vision_tpu.parallel.mesh import (
     shard_batch,
 )
 
+# one shared jitted sum: evaluate() calls it per masked multi-host batch,
+# and a fresh jax.jit wrapper there would retrace every time
+_global_sum = jax.jit(jnp.sum)
+
 
 def _set_lr(opt_state, lr: float):
     """Set the injected learning_rate hyperparam to an absolute value."""
@@ -266,7 +270,21 @@ class Trainer:
             # the same sequence of calls.
             if self._pguard is not None and self._pguard.agreed(step=step):
                 break  # caller re-checks with force=True and checkpoints
-            n = np.shape(batch[self.input_key])[0]
+            # metrics are masked MEANS over valid rows; weight the epoch
+            # aggregate by VALID rows. Multi-host callers pre-pad the final
+            # global batch (see _pad_and_mask) and ship '_mask' with it —
+            # counting padded rows here would skew every epoch average the
+            # padding's share.
+            if "_mask" in batch:
+                m = batch["_mask"]
+                if isinstance(m, jax.Array) and not m.is_fully_addressable:
+                    # multi-host global array: shards live on other hosts;
+                    # reduce under SPMD, fetch the replicated scalar
+                    n = int(_global_sum(m))
+                else:
+                    n = int(np.sum(np.asarray(m)))
+            else:
+                n = np.shape(batch[self.input_key])[0]
             metrics = self.eval_step(batch)
             self.eval_logger.log_step(step, metrics, batch_size=n, epoch=epoch)
             step += 1

@@ -233,3 +233,53 @@ def test_imagenet_bbox_pipeline(tmp_path):
     # plain classifier records)
     ex2 = imagenet_example(imagenet_annotations(str(root), str(synsets))[0])
     assert "image/object/bbox/xmin" not in ex2
+
+
+def test_prepare_imagenet(tmp_path):
+    """untar-script.sh + flatten-script.sh + flatten-val-script.sh analog:
+    per-synset tars AND an untarred tree flatten into train_flatten/, val
+    images get synset-prefixed names from the labels file, and the result
+    feeds imagenet_annotations directly."""
+    import tarfile
+
+    # raw layout: one synset tar, one untarred synset dir, two val images
+    tars = tmp_path / "tars"
+    os.makedirs(tars)
+    img_src = tmp_path / "n01440764_10.JPEG"
+    _write_jpeg(img_src)
+    with tarfile.open(tars / "n01440764.tar", "w") as tf:
+        tf.add(img_src, arcname="n01440764_10.JPEG")
+    tree = tmp_path / "train_tree" / "n02119789"
+    os.makedirs(tree)
+    _write_jpeg(tree / "n02119789_7.JPEG")
+    val = tmp_path / "val"
+    os.makedirs(val)
+    _write_jpeg(val / "ILSVRC2012_val_00000001.JPEG")
+    _write_jpeg(val / "ILSVRC2012_val_00000002.JPEG")
+    val_labels = tmp_path / "val_synsets.txt"
+    val_labels.write_text("n02119789\nn01440764\n")
+
+    out = tmp_path / "prepared"
+    convert_main([
+        "prepare-imagenet", "--out-dir", str(out),
+        "--train-tars", str(tars), "--train-dir", str(tmp_path / "train_tree"),
+        "--val-dir", str(val), "--val-synsets", str(val_labels),
+    ])
+    assert sorted(os.listdir(out / "train_flatten")) == [
+        "n01440764_10.JPEG", "n02119789_7.JPEG"
+    ]
+    assert sorted(os.listdir(out / "val_flatten")) == [
+        "n01440764_ILSVRC2012_val_00000002.JPEG",
+        "n02119789_ILSVRC2012_val_00000001.JPEG",
+    ]
+    # idempotent re-run: no duplicates, no crash
+    C.prepare_imagenet(str(out), train_tars=str(tars))
+    assert len(os.listdir(out / "train_flatten")) == 2
+
+    # the flattened output is exactly what the converter consumes
+    synsets = tmp_path / "synsets.txt"
+    synsets.write_text("n01440764\nn02119789\n")
+    annos = C.imagenet_annotations(str(out / "train_flatten"), str(synsets))
+    assert [a["label"] for a in annos] == [1, 2]
+    vannos = C.imagenet_annotations(str(out / "val_flatten"), str(synsets))
+    assert sorted(a["label"] for a in vannos) == [1, 2]

@@ -1,0 +1,211 @@
+"""Name the wall-vs-device gap mechanism on the tunneled chip (round 4).
+
+Round 3 measured a ~5 ms/step wall-minus-device gap that an 8-step
+`lax.scan` superstep did NOT remove (artifacts/dispatch_r03.json), which
+contradicted the "per-dispatch relay turnaround" story. But the same rows
+hide a cleaner pattern: gap_per_step x steps_per_window is ~constant
+(108.6 / 110.2 / 114.2 / 112.1 ms across all four configs) — i.e. the
+overhead looks *per host synchronization* (the `float(loss)` fetch that
+closes each timed window), not per step and not per dispatch.
+
+This probe decides it:
+
+1. **Window-length sweep**: wall time of windows of N in {5,10,20,50,100,200}
+   steps (one fetch per window), interleaved round-robin across reps to beat
+   the rig's +-4% session drift. Least-squares fit wall(N) = a + b*N:
+   - a ~= per-sync overhead (ms), b ~= true per-step time (ms).
+   - Per-sync hypothesis: a ~ 110, b ~ device step time (97.9).
+   - Per-step-overhead hypothesis: a ~ 0, b ~ 103.3.
+2. **Per-enqueue timing**: perf_counter around every step() call in a
+   window — proves dispatches are async (fast enqueue, cost concentrated in
+   the closing fetch) or sync (each call blocks ~one step).
+3. **Pure sync RTT**: float() fetch of a trivial jitted computation —
+   the floor any synchronization pays through the relay.
+4. **Device timeline**: module-event START timestamps from a profiler trace
+   of one 20-dispatch window — inter-module idle gaps on the device tell
+   whether the chip itself ever waits between dispatches.
+
+Writes artifacts/dispatch_r04.json. Run solo (no concurrent host load:
+a CPU-heavy cotenant inflated a 74 ms step to 174 ms in round 3).
+"""
+from __future__ import annotations
+
+import glob
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import bench  # noqa: E402
+
+WINDOW_SIZES = [5, 10, 20, 50, 100, 200]
+REPS = 3
+
+
+def _log(msg):
+    print(f"probe: {msg}", file=sys.stderr, flush=True)
+
+
+def pure_sync_rtt_ms(n=5):
+    """Dispatch + scalar-fetch round trip for a trivial kernel."""
+    import jax
+    import jax.numpy as jnp
+
+    f = jax.jit(lambda x: x + 1.0)
+    x = jnp.float32(0.0)
+    float(f(x))  # compile
+    dts = []
+    for _ in range(n):
+        t0 = time.perf_counter()
+        float(f(x))
+        dts.append((time.perf_counter() - t0) * 1e3)
+    return dts
+
+
+def device_timeline(step, state, batch, dispatches=20):
+    """(module_durations_ms, inter_module_gaps_ms) from one traced window."""
+    import jax
+
+    os.environ.setdefault("PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION", "python")
+    tmpdir = tempfile.mkdtemp(prefix="dv_probe_trace_")
+    try:
+        jax.profiler.start_trace(tmpdir)
+        for _ in range(dispatches):
+            state, loss = step(state, batch)
+        float(loss)
+        jax.profiler.stop_trace()
+        from tensorflow.tsl.profiler.protobuf import xplane_pb2
+
+        path = glob.glob(os.path.join(tmpdir, "**", "*.xplane.pb"),
+                         recursive=True)[0]
+        xs = xplane_pb2.XSpace()
+        with open(path, "rb") as f:
+            xs.ParseFromString(f.read())
+        events = []
+        for plane in xs.planes:
+            if not plane.name.startswith("/device:TPU"):
+                continue
+            for line in plane.lines:
+                if line.name != "XLA Modules":
+                    continue
+                for ev in line.events:
+                    start_ps = line.timestamp_ns * 1000 + ev.offset_ps
+                    events.append((start_ps, ev.duration_ps))
+        events.sort()
+        # ps -> ms (1 ms = 1e9 ps)
+        durs_ms = [d / 1e9 for _, d in events]
+        gaps_ms = [
+            (events[i + 1][0] - (events[i][0] + events[i][1])) / 1e9
+            for i in range(len(events) - 1)
+        ]
+        return durs_ms, gaps_ms, state
+    finally:
+        shutil.rmtree(tmpdir, ignore_errors=True)
+
+
+def main(out_path="artifacts/dispatch_r04.json"):
+    art = {"what": __doc__.split("\n")[0],
+           "window_sizes": WINDOW_SIZES, "reps": REPS}
+
+    _log("building step (batch 256, k=1)")
+    step, state, batch, batch_size, n_chips, devices = bench.build_bench(
+        256, 1
+    )
+    art["device_kind"] = devices[0].device_kind
+    # warmup
+    t0 = time.perf_counter()
+    for _ in range(5):
+        state, loss = step(state, batch)
+    float(loss)
+    _log(f"warmup {time.perf_counter() - t0:.1f}s")
+
+    # 3. pure sync RTT (cheap, do first on the warm session)
+    art["pure_sync_rtt_ms"] = [round(v, 2) for v in pure_sync_rtt_ms()]
+    _log(f"pure sync RTT ms: {art['pure_sync_rtt_ms']}")
+
+    # 2. per-enqueue timing: one 20-step window, clock every call
+    enq = []
+    t0 = time.perf_counter()
+    for _ in range(20):
+        t1 = time.perf_counter()
+        state, loss = step(state, batch)
+        enq.append((time.perf_counter() - t1) * 1e3)
+    t2 = time.perf_counter()
+    float(loss)
+    fetch_ms = (time.perf_counter() - t2) * 1e3
+    wall_ms = (time.perf_counter() - t0) * 1e3
+    art["per_enqueue"] = {
+        "enqueue_ms": [round(v, 2) for v in enq],
+        "closing_fetch_ms": round(fetch_ms, 1),
+        "window_wall_ms": round(wall_ms, 1),
+        "note": "async dispatch = small enqueues, cost in the fetch; "
+                "sync dispatch = each enqueue ~ one step",
+    }
+    _log(f"enqueue ms: med {np.median(enq):.2f} max {max(enq):.1f}; "
+         f"closing fetch {fetch_ms:.0f} of {wall_ms:.0f} wall")
+
+    # 1. window-length sweep, interleaved
+    walls = {n: [] for n in WINDOW_SIZES}
+    for rep in range(REPS):
+        for n in WINDOW_SIZES:
+            t0 = time.perf_counter()
+            for _ in range(n):
+                state, loss = step(state, batch)
+            float(loss)
+            dt = (time.perf_counter() - t0) * 1e3
+            walls[n].append(dt)
+            _log(f"rep {rep} N={n}: {dt:.0f} ms ({dt / n:.2f} ms/step)")
+    med = {n: float(np.median(v)) for n, v in walls.items()}
+    ns = np.array(WINDOW_SIZES, dtype=np.float64)
+    ws = np.array([med[n] for n in WINDOW_SIZES])
+    b, a = np.polyfit(ns, ws, 1)  # wall = a + b*N
+    resid = ws - (a + b * ns)
+    art["window_sweep"] = {
+        "wall_ms_per_window": {str(n): [round(v, 1) for v in walls[n]]
+                               for n in WINDOW_SIZES},
+        "median_wall_ms": {str(n): round(med[n], 1) for n in WINDOW_SIZES},
+        "fit_per_sync_overhead_ms": round(float(a), 1),
+        "fit_per_step_ms": round(float(b), 3),
+        "fit_max_residual_ms": round(float(np.abs(resid).max()), 1),
+    }
+    _log(f"fit: wall = {a:.1f} + {b:.2f}*N ms "
+         f"(max residual {np.abs(resid).max():.1f} ms)")
+
+    # 4. device timeline
+    try:
+        durs, gaps, state = device_timeline(step, state, batch)
+        art["device_timeline"] = {
+            "module_ms": [round(d, 2) for d in durs],
+            "inter_module_gap_us": [round(g * 1e3, 1) for g in gaps],
+            "median_module_ms": round(float(np.median(durs)), 2),
+            "median_gap_us": round(float(np.median(gaps)) * 1e3, 1)
+            if gaps else None,
+        }
+        _log(f"device: module med {np.median(durs):.2f} ms, "
+             f"gap med {np.median(gaps) * 1e3:.1f} us")
+    except Exception as e:
+        art["device_timeline"] = {"error": f"{type(e).__name__}: {e}"}
+        _log(f"device timeline failed: {e}")
+
+    # verdict, mechanically derived
+    per_step_gap_20 = med[20] / 20 - art["window_sweep"]["fit_per_step_ms"]
+    art["conclusion"] = {
+        "per_sync_overhead_ms": art["window_sweep"]["fit_per_sync_overhead_ms"],
+        "true_per_step_ms": art["window_sweep"]["fit_per_step_ms"],
+        "r03_20step_window_gap_explained_ms_per_step": round(
+            float(per_step_gap_20), 2
+        ),
+    }
+    os.makedirs(os.path.dirname(out_path) or ".", exist_ok=True)
+    with open(out_path, "w") as f:
+        json.dump(art, f, indent=2)
+    _log(f"wrote {out_path}")
+
+
+if __name__ == "__main__":
+    main(sys.argv[1] if len(sys.argv) > 1 else "artifacts/dispatch_r04.json")
