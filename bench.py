@@ -412,7 +412,12 @@ def main(args) -> None:
         # MFU / HBM traffic from XLA's post-fusion cost analysis (falls back
         # to analytic ResNet-50 flops). All per-chip: cost analysis is
         # per-device under SPMD and wall_per_chip is the per-chip rate.
-        # Bytes accessed post-fusion ~= HBM traffic; v5e HBM bw is 819 GB/s.
+        # NB "bytes accessed" is an UPPER BOUND on real HBM traffic: reads
+        # served from VMEM-resident buffers still count, so the implied
+        # bandwidth can exceed the 819 GB/s pin limit (batch 128 implies
+        # ~946 GB/s — proof of the overcount; see
+        # artifacts/batch_scaling_r04.json and the round-3 roofline
+        # misread it caused).
         batch_per_chip = batch_size // n_chips
         flops_per_step, bytes_per_step, src = _cost_analysis(
             step, args.multistep, batch_per_chip

@@ -350,7 +350,8 @@ def procedural_shapes(n: int, size: int = 192, max_boxes: int = 3,
 def run_holdout_detection(steps: int = 400, batch: int = 16,
                           size: int = 192, out_path: Optional[str] = None,
                           n_train: int = 256, n_val: int = 64,
-                          lr: float = 1e-3) -> dict:
+                          lr: float = 1e-3,
+                          render_dir: Optional[str] = None) -> dict:
     """Train YOLOv3 on procedural shapes ON-CHIP, score HELD-OUT mAP via
     the real decode -> NMS -> VOC-matching eval path (inference.py +
     core/detection_metrics.py) — the detection analog of run_holdout
@@ -386,16 +387,18 @@ def run_holdout_detection(steps: int = 400, batch: int = 16,
         batch_d = {k: jnp.take(v, idx, axis=0) for k, v in data.items()}
 
         def lf(params):
-            outputs = state.apply_fn(
-                {"params": params}, batch_d["image"], train=True,
+            outputs, nms = state.apply_fn(
+                {"params": params, "batch_stats": state.batch_stats},
+                batch_d["image"], train=True, mutable=["batch_stats"],
                 rngs={"dropout": jax.random.fold_in(state.rng, state.step)},
             )
             loss, metrics = loss_fn(outputs, batch_d)
-            return loss, metrics
+            return loss, (nms["batch_stats"], metrics)
 
-        (loss, metrics), grads = jax.value_and_grad(lf, has_aux=True)(
-            state.params)
-        return state.apply_gradients(grads), metrics
+        (loss, (bs, metrics)), grads = jax.value_and_grad(
+            lf, has_aux=True)(state.params)
+        return (state.apply_gradients(grads).replace(batch_stats=bs),
+                metrics)
 
     # device-resident dataset (per-step host->device transfers through the
     # relay dwarf the step itself; see round-3 memory)
@@ -419,10 +422,13 @@ def run_holdout_detection(steps: int = 400, batch: int = 16,
     # NMS -> greedy VOC matching), the `--eval-only` machinery
     detect = make_yolo_detector(model, score_threshold=0.1)
     ev = DetectionEvaluator(num_classes=3)
-    variables = {"params": state.params}
+    variables = state.variables
+    first_det = None  # first batch's detections, reused by the render path
     for s in range(0, n_val, batch):
         imgs = jnp.asarray(va_x[s:s + batch], jnp.float32)
         det = detect(variables, imgs)
+        if first_det is None:
+            first_det = jax.device_get(det)
         for j in range(imgs.shape[0]):
             n = int(det["num"][j])
             gt = va_b[s + j][va_c[s + j] >= 0]
@@ -431,6 +437,36 @@ def run_holdout_detection(steps: int = 400, batch: int = 16,
                    np.asarray(det["scores"][j][:n]),
                    np.asarray(det["classes"][j][:n]), gt, gc)
     res = ev.compute(iou_threshold=0.5)
+
+    if render_dir and first_det is not None:
+        # rendered-overlay demo outputs (demo_mscoco.ipynb's role): the
+        # first val images with the model's boxes drawn by the real
+        # tools/infer.py overlay path. Reuses the eval loop's first-batch
+        # detections (a fresh batch-4 call would recompile the whole graph
+        # for the new shape — minutes on this rig). cv2 is optional
+        # package-wide: a missing cv2 skips the overlays with a warning
+        # instead of crashing after the training spend.
+        try:
+            from deep_vision_tpu.tools.infer import (
+                _write_jpeg,
+                draw_detections,
+            )
+
+            os.makedirs(render_dir, exist_ok=True)
+            for j in range(min(4, batch)):
+                n = int(first_det["num"][j])
+                img = (np.clip(va_x[j], 0, 1) * 255).astype(np.uint8)
+                over = draw_detections(
+                    img, first_det["boxes"][j][:n],
+                    first_det["scores"][j][:n],
+                    first_det["classes"][j][:n],
+                    class_names=("disc", "square", "cross"),
+                )
+                _write_jpeg(
+                    os.path.join(render_dir, f"demo_detect_{j}.jpg"), over
+                )
+        except Exception as e:  # cv2 missing/broken: evidence > overlays
+            print(f"render skipped ({type(e).__name__}: {e})")
 
     dev = jax.devices()[0]
     result = {
@@ -501,7 +537,8 @@ def procedural_figures(n: int, size: int = 128, seed: int = 0,
 
 def run_holdout_pose(steps: int = 300, batch: int = 16, size: int = 128,
                      out_path: Optional[str] = None, n_train: int = 256,
-                     n_val: int = 64, lr: float = 2.5e-4) -> dict:
+                     n_val: int = 64, lr: float = 2.5e-4,
+                     render_dir: Optional[str] = None) -> dict:
     """Train a 2-stack hourglass on procedural figures ON-CHIP, score
     HELD-OUT PCKh@0.5 via the real heatmap-peak decode
     (inference.heatmaps_to_keypoints + detection_metrics.pckh) — the pose
@@ -572,19 +609,36 @@ def run_holdout_pose(steps: int = 300, batch: int = 16, size: int = 128,
     # held-out PCKh through the real decode path
     @jax.jit
     def predict(state, images):
-        outputs = state.apply_fn(
-            {"params": state.params, "batch_stats": state.batch_stats},
-            images, train=False,
-        )
+        outputs = state.apply_fn(state.variables, images, train=False)
         return heatmaps_to_keypoints(outputs[-1])
 
     preds = []
     for s in range(0, n_val, batch):
         kp = predict(state, jnp.asarray(va_x[s:s + batch], jnp.float32))
         preds.append(np.asarray(kp))
-    preds = np.concatenate(preds)[..., :2]
+    full_preds = np.concatenate(preds)  # (N, J, 3): x, y, score
+    preds = full_preds[..., :2]
     vis = np.ones(va_k.shape[:2], bool)
     res = pckh(preds, va_k, vis, va_h, alpha=0.5)
+
+    if render_dir:
+        # rendered pose overlays (demo_hourglass_pose.ipynb's role); the
+        # 5-keypoint figure uses a star skeleton (all joints to the head).
+        # Reuses the eval predictions (scores included) — a fresh batch-4
+        # call would recompile the graph; missing cv2 skips overlays with
+        # a warning instead of crashing after the training spend.
+        try:
+            from deep_vision_tpu.tools.infer import _write_jpeg, draw_pose
+
+            os.makedirs(render_dir, exist_ok=True)
+            for j in range(4):
+                img = (np.clip(va_x[j], 0, 1) * 255).astype(np.uint8)
+                over = draw_pose(img, full_preds[j], score_threshold=0.05,
+                                 skeleton=((0, 1), (0, 2), (0, 3), (0, 4)))
+                _write_jpeg(os.path.join(render_dir, f"demo_pose_{j}.jpg"),
+                            over)
+        except Exception as e:
+            print(f"render skipped ({type(e).__name__}: {e})")
 
     dev = jax.devices()[0]
     result = {
@@ -608,7 +662,8 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("--steps", type=int, default=None,
                    help="default 200 (memorization) / 300 (--holdout)")
-    p.add_argument("--batch", type=int, default=64)
+    p.add_argument("--batch", type=int, default=None,
+                   help="default 64 (classification) / 16 (detection, pose)")
     p.add_argument("--model", default="resnet50",
                    help="resnet50 | vit_s16 | vmoe_s16")
     p.add_argument("--holdout", action="store_true",
@@ -617,12 +672,37 @@ def main(argv=None) -> int:
                    help="linear LR warmup steps (attention family only)")
     p.add_argument("--aux-weight", type=float, default=0.01,
                    help="MoE load-balance penalty weight")
+    p.add_argument("--noise", type=float, default=0.15,
+                   help="grating pixel-noise sigma (holdout difficulty)")
+    p.add_argument("--render-dir", default=None,
+                   help="write demo overlay JPEGs here (detection/pose "
+                        "holdouts)")
     p.add_argument("--out", default=None)
     args = p.parse_args(argv)
+    if args.holdout and args.model == "yolov3":
+        out = args.out or "artifacts/yolov3_holdout.json"
+        r = run_holdout_detection(args.steps or 400, args.batch or 16,
+                                  out_path=out, render_dir=args.render_dir)
+        print(f"device={r['device']} val_mAP50={r['val_map50']} "
+              f"per-class={r['val_ap_per_class']} "
+              f"wall={r['wall_seconds']}s -> {out}")
+        ok = r["val_map50"] >= 0.25
+        print("GENERALIZED" if ok else "DID NOT GENERALIZE")
+        return 0 if ok else 1
+    if args.holdout and args.model == "hourglass":
+        out = args.out or "artifacts/hourglass_holdout.json"
+        r = run_holdout_pose(args.steps or 300, args.batch or 16,
+                             out_path=out, render_dir=args.render_dir)
+        print(f"device={r['device']} val_PCKh@0.5={r['val_pckh50']} "
+              f"wall={r['wall_seconds']}s -> {out}")
+        ok = r["val_pckh50"] >= 0.25
+        print("GENERALIZED" if ok else "DID NOT GENERALIZE")
+        return 0 if ok else 1
     if args.holdout:
         out = args.out or f"artifacts/{args.model}_holdout.json"
-        r = run_holdout(args.steps or 300, args.batch,
-                        model_name=args.model, out_path=out)
+        r = run_holdout(args.steps or 300, args.batch or 64,
+                        model_name=args.model, out_path=out,
+                        noise=args.noise)
         chance = r["chance_top1"]
         print(f"device={r['device']} final_loss={r['final_loss']} "
               f"train_top1={r['train_top1']} val_top1={r['val_top1']} "
@@ -631,7 +711,7 @@ def main(argv=None) -> int:
         print("GENERALIZED" if ok else "DID NOT GENERALIZE")
         return 0 if ok else 1
     out = args.out or f"artifacts/{args.model}_tpu_convergence.json"
-    r = run(args.steps or 200, args.batch, model_name=args.model,
+    r = run(args.steps or 200, args.batch or 64, model_name=args.model,
             out_path=out, warmup=args.warmup, aux_weight=args.aux_weight)
     print(f"device={r['device']} first={r['first_loss']} "
           f"final={r['final_loss']} wall={r['wall_seconds']}s -> {out}")
