@@ -661,11 +661,13 @@ def run_holdout_pose(steps: int = 300, batch: int = 16, size: int = 128,
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("--steps", type=int, default=None,
-                   help="default 200 (memorization) / 300 (--holdout)")
+                   help="default 200 (memorization) / 300 (--holdout "
+                        "classification, pose) / 400 (--holdout yolov3)")
     p.add_argument("--batch", type=int, default=None,
                    help="default 64 (classification) / 16 (detection, pose)")
     p.add_argument("--model", default="resnet50",
-                   help="resnet50 | vit_s16 | vmoe_s16")
+                   help="resnet50 | vit_s16 | vmoe_s16 | yolov3 (--holdout "
+                        "only) | hourglass (--holdout only)")
     p.add_argument("--holdout", action="store_true",
                    help="procedural train/val split; report held-out top-1")
     p.add_argument("--warmup", type=int, default=0,
@@ -679,6 +681,9 @@ def main(argv=None) -> int:
                         "holdouts)")
     p.add_argument("--out", default=None)
     args = p.parse_args(argv)
+    if args.model in ("yolov3", "hourglass") and not args.holdout:
+        p.error(f"--model {args.model} is a --holdout-only runner "
+                "(detection mAP / pose PCKh evidence); add --holdout")
     if args.holdout and args.model == "yolov3":
         out = args.out or "artifacts/yolov3_holdout.json"
         r = run_holdout_detection(args.steps or 400, args.batch or 16,

@@ -1,0 +1,141 @@
+"""Host-side units of the round-4 perf/evidence tooling.
+
+The chip-facing halves of these tools are exercised by their committed
+artifacts; these tests pin the pure-python parts (HLO parsing, metric
+conventions, procedural dataset generators) that everything downstream
+trusts.
+"""
+import numpy as np
+import pytest
+
+from tools.hbm_breakdown import breakdown, parse_entry, shape_bytes
+
+
+HLO = """\
+HloModule jit_train_step
+
+%fused_computation.1 {
+  %p = bf16[8,8]{1,0} parameter(0)
+  ROOT %a = bf16[8,8]{1,0} add(%p, %p)
+}
+
+ENTRY %main (p0: bf16[256,56,56,64], p1: f32[64]) -> bf16[256,56,56,64] {
+  %p0 = bf16[256,56,56,64]{3,2,1,0:T(8,128)(2,1)} parameter(0)
+  %p1 = f32[64]{0:T(256)} parameter(1)
+  %copy.1 = bf16[256,56,56,64]{0,3,2,1:T(8,128)(2,1)} copy(%p0)
+  %fusion.1 = bf16[256,56,56,64]{0,3,2,1:T(8,128)(2,1)} fusion(%copy.1, %p1), kind=kLoop, calls=%fused_computation.1
+  ROOT %tuple.1 = (bf16[256,56,56,64]{0,3,2,1}) tuple(%fusion.1)
+}
+"""
+
+
+def test_shape_bytes():
+    assert shape_bytes("bf16[256,56,56,64]{3,2,1,0}") == 256 * 56 * 56 * 64 * 2
+    assert shape_bytes("f32[64]{0}") == 256
+    # tuple shapes sum their elements
+    assert shape_bytes("(f32[2,2], bf16[4])") == 16 + 8
+    assert shape_bytes("pred[8]") == 8
+    assert shape_bytes("token[]") == 0
+
+
+def test_parse_entry_only_entry_instructions():
+    rows = list(parse_entry(HLO))
+    names = [r[0] for r in rows]
+    # instructions inside %fused_computation.1 must NOT appear
+    assert "a" not in names and "p" not in names
+    assert {"p0", "p1", "copy.1", "fusion.1", "tuple.1"} <= set(names)
+    by_name = {r[0]: r for r in rows}
+    assert by_name["fusion.1"][2] == "fusion"
+    assert by_name["fusion.1"][3] == ["copy.1", "p1"]
+
+
+def test_breakdown_accounting():
+    big = 256 * 56 * 56 * 64 * 2  # one bf16 feature map
+    art = breakdown(HLO)
+    # copy: in big + out big; fusion: in (big + 256) + out big; parameters
+    # and the tuple are plumbing with no traffic of their own
+    est = art["total_estimated_gb"] * 1e3  # MB (artifact rounds to 10 MB)
+    want = (2 * big + (big + 256 + big)) / 1e6
+    assert est == pytest.approx(want, abs=10.0)
+    rows = {r["name"]: r for r in art["top_instructions"]}
+    assert rows["copy.1"]["total_mb"] == pytest.approx(2 * big / 1e6,
+                                                       rel=1e-3)
+    assert rows["fusion.1"]["in_mb"] == pytest.approx((big + 256) / 1e6,
+                                                      rel=1e-3)
+    classes = {c["class"] for c in art["by_class"]}
+    assert "copy/layout" in classes
+
+
+def test_aux_metric_prefix_convention():
+    """'_'-prefixed aux names surface as metrics WITHOUT touching the loss;
+    reserved surfaced names still raise (models/vit.py router telemetry)."""
+    import jax.numpy as jnp
+
+    from deep_vision_tpu.losses.classification import classification_loss_fn
+
+    logits = jnp.asarray([[4.0, 0.0], [0.0, 4.0]])
+    batch = {"label": jnp.asarray([0, 1])}
+    base, _ = classification_loss_fn(logits, batch)
+    loss, metrics = classification_loss_fn(
+        (logits, {"penalty": jnp.asarray(2.0),
+                  "_router_entropy": jnp.asarray(1.5)}),
+        batch, penalty_weight=0.01,
+    )
+    assert metrics["router_entropy"] == 1.5
+    # only the un-prefixed penalty moved the loss
+    assert float(loss) == pytest.approx(float(base) + 0.02, abs=1e-6)
+    with pytest.raises(ValueError):
+        classification_loss_fn(
+            (logits, {"_loss": jnp.asarray(1.0)}), batch
+        )
+
+
+def test_procedural_shapes_layout():
+    from deep_vision_tpu.tools.convergence_run import procedural_shapes
+
+    imgs, boxes, classes = procedural_shapes(8, size=96, seed=3)
+    assert imgs.shape == (8, 96, 96, 3) and imgs.dtype == np.float32
+    assert boxes.shape == (8, 3, 4) and classes.shape == (8, 3)
+    valid = classes >= 0
+    assert valid.any(axis=1).all()  # every image has >= 1 object
+    # valid boxes are normalized, non-degenerate, in-bounds
+    vb = boxes[valid]
+    assert (vb[:, 2] > vb[:, 0]).all() and (vb[:, 3] > vb[:, 1]).all()
+    assert (vb >= 0).all() and (vb <= 1).all()
+    # padded rows are zero boxes (the DetectionEvaluator drop convention)
+    assert not boxes[~valid].any()
+    # deterministic per seed
+    i2, b2, c2 = procedural_shapes(8, size=96, seed=3)
+    np.testing.assert_array_equal(boxes, b2)
+    np.testing.assert_array_equal(imgs, i2)
+
+
+def test_procedural_figures_layout():
+    from deep_vision_tpu.tools.convergence_run import procedural_figures
+
+    imgs, kpts, heads = procedural_figures(6, size=64, seed=1)
+    assert imgs.shape == (6, 64, 64, 3)
+    assert kpts.shape == (6, 5, 2) and heads.shape == (6,)
+    assert (kpts >= 0).all() and (kpts <= 1).all()
+    assert (heads > 0).all() and (heads < 0.5).all()
+    # the head keypoint sits inside the drawn head disc: the brightest
+    # region around kpt 0 must be far above the noise floor
+    for i in range(6):
+        x, y = (kpts[i, 0] * 64).astype(int)
+        patch = imgs[i, max(y - 2, 0):y + 3, max(x - 2, 0):x + 3]
+        assert patch.max() > 0.5
+
+
+def test_gratings_difficulty_knob():
+    from deep_vision_tpu.tools.convergence_run import procedural_gratings
+
+    easy, labels = procedural_gratings(4, classes=16, size=32, noise=0.05)
+    hard, _ = procedural_gratings(4, classes=16, size=32, noise=0.6)
+    # same class structure, different SNR: hard images have more extreme
+    # clipping mass at 0/1
+    clip_easy = ((easy <= 0.001) | (easy >= 0.999)).mean()
+    clip_hard = ((hard <= 0.001) | (hard >= 0.999)).mean()
+    assert clip_hard > clip_easy
+    # 32-class variant factors 8 orientations x 4 freqs and stays in range
+    imgs32, labels32 = procedural_gratings(8, classes=32, size=32)
+    assert labels32.max() < 32

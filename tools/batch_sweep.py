@@ -127,5 +127,8 @@ def main(out_path="artifacts/batch_scaling_r04.json"):
 
 
 if __name__ == "__main__":
+    # usage: batch_sweep.py [out.json] [b1,b2,...]
+    if len(sys.argv) > 2:
+        BATCHES = [int(b) for b in sys.argv[2].split(",")]
     main(sys.argv[1] if len(sys.argv) > 1 else
          "artifacts/batch_scaling_r04.json")

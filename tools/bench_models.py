@@ -22,11 +22,18 @@ import time
 import numpy as np
 
 
-def _median_ms(call, steps=10, windows=3):
+def _median_ms(call, steps=100, windows=3):
     """Median wall ms per `call()`. `call` must return a DEVICE SCALAR:
     timing is closed by a float() fetch — on this rig's relay backend,
     block_until_ready() can return before execution completes, silently
-    measuring enqueue time (a 70 ms step once "measured" 3 ms that way)."""
+    measuring enqueue time (a 70 ms step once "measured" 3 ms that way).
+
+    steps=100 per window: the window-closing fetch costs a constant
+    ~118 ms per synchronization for the ResNet train step
+    (artifacts/dispatch_r04.json), which predicts short windows inflate
+    per-call numbers by up to 118/steps ms. The r3 artifacts used
+    steps=10; the regenerated artifact quantifies how much of that
+    prediction this (smaller-output) call pattern actually paid."""
     for _ in range(3):
         out = call()
     float(out)
@@ -162,6 +169,10 @@ def main(argv=None) -> int:
     if not args.skip_yolo:
         result["yolov3"] = bench_yolo()
         print("yolo:", json.dumps(result["yolov3"]))
+        # per-chip batch optimum moved for ResNet-50 (batch_scaling_r04);
+        # check YOLO's curve one octave up too
+        result["yolov3_b32"] = bench_yolo(batch=32)
+        print("yolo b32:", json.dumps(result["yolov3_b32"]))
     if not args.skip_flash:
         result["flash_attention"] = bench_flash()
         print("flash:", json.dumps(result["flash_attention"]))
