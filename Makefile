@@ -37,6 +37,19 @@ test:
 bench:
 	python bench.py
 
+# perf-evidence suite: every README perf claim regenerates from these
+bench-evidence:
+	python tools/batch_sweep.py artifacts/batch_scaling_r04.json
+	python tools/bench_ablate.py
+	python tools/bench_models.py
+	python tools/dispatch_probe.py
+
+demo:
+	python -m deep_vision_tpu.tools.convergence_run --model yolov3 \
+	  --holdout --render-dir examples/output
+	python -m deep_vision_tpu.tools.convergence_run --model hourglass \
+	  --holdout --render-dir examples/output
+
 dryrun:
 	python __graft_entry__.py 8
 
@@ -49,4 +62,4 @@ ps:
 native:
 	$(MAKE) -C native
 
-.PHONY: train resume train-fg test bench dryrun tb ps native
+.PHONY: train resume train-fg test bench bench-evidence demo dryrun tb ps native

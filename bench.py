@@ -183,12 +183,14 @@ def _log(msg: str) -> None:
     print(f"bench: {msg}", file=sys.stderr, flush=True)
 
 
-def build_bench(batch_per_chip: int, multistep: int):
-    """(Re)build mesh, model state, synthetic batch and the jitted step.
+def make_train_parts(batch_per_chip: int, stem: str = "s2d"):
+    """(train_step_fn, state, batch, batch_size, n_chips, devices): the
+    UNJITTED flagship train step + freshly staged inputs.
 
-    Called once at start and again after any transient runtime failure —
-    everything device-resident is recreated from host-side seeds so a replay
-    is bit-equivalent to the original attempt.
+    Shared by build_bench and the perf probes (tools/layout_probe.py,
+    tools/bench_ablate.py) so every measurement times the same program.
+    Everything device-resident is created from host-side seeds so a rebuild
+    is bit-equivalent.
     """
     from deep_vision_tpu.core.train_state import create_train_state
     from deep_vision_tpu.losses.classification import classification_loss_fn
@@ -206,18 +208,21 @@ def build_bench(batch_per_chip: int, multistep: int):
     # to 7x7/s2 but MXU-efficient. Input staged in bf16, as the real
     # pipeline does (uint8 decode -> normalize -> bf16 cast on host).
     model = get_model("resnet50", num_classes=1000, dtype=jnp.bfloat16,
-                      stem="s2d")
+                      stem=stem)
     tx = build_optimizer("sgd", learning_rate=0.1, momentum=0.9,
                          weight_decay=1e-4)
-    sample = jnp.ones((8, IMAGE_SIZE // 2, IMAGE_SIZE // 2, 12), jnp.float32)
+    if stem == "s2d":
+        img_shape = (IMAGE_SIZE // 2, IMAGE_SIZE // 2, 12)
+    else:
+        img_shape = (IMAGE_SIZE, IMAGE_SIZE, 3)
+    sample = jnp.ones((8, *img_shape), jnp.float32)
     state = create_train_state(model, tx, sample)
     state = jax.device_put(state, replicated(mesh))
 
     rng = np.random.RandomState(0)
     batch = {
-        "image": rng.rand(
-            batch_size, IMAGE_SIZE // 2, IMAGE_SIZE // 2, 12
-        ).astype(np.float32).astype(jnp.bfloat16),
+        "image": rng.rand(batch_size, *img_shape)
+        .astype(np.float32).astype(jnp.bfloat16),
         "label": rng.randint(0, 1000, size=(batch_size,)).astype(np.int32),
     }
     batch = {
@@ -243,6 +248,18 @@ def build_bench(batch_per_chip: int, multistep: int):
             state.params
         )
         return state.apply_gradients(grads).replace(batch_stats=new_bs), loss
+
+    return train_step, state, batch, batch_size, n_chips, devices
+
+
+def build_bench(batch_per_chip: int, multistep: int):
+    """(Re)build mesh, model state, synthetic batch and the jitted step.
+
+    Called once at start and again after any transient runtime failure —
+    a replay is bit-equivalent to the original attempt (make_train_parts).
+    """
+    (train_step, state, batch, batch_size, n_chips,
+     devices) = make_train_parts(batch_per_chip)
 
     if multistep > 1:
         # K optimizer steps per dispatch: a lax.scan superstep. Quantifies
@@ -463,13 +480,16 @@ def main(args) -> None:
         print(json.dumps(result), flush=True)
 
 
-def _device_step_ms(step, state, batch, multistep: int = 1, n_steps: int = 10):
-    """Median on-device ms/step from a jax.profiler trace (None on failure).
+def _trace_module_events(step, state, batch, dispatches: int):
+    """[(start_ps, duration_ps)] of device "XLA Modules" events from one
+    traced window of `dispatches` executions, sorted by start time.
 
-    Parses the trace's "/device:TPU:0" plane, "XLA Modules" line: one event
-    per executed program, whose duration is the device-side execution time
-    of the whole jitted train step (matmuls, DMAs and stalls included —
-    everything but host/relay dispatch overhead).
+    The trace's "/device:TPU:0" plane holds one event per executed program
+    whose duration is the device-side execution time of the whole jitted
+    step (matmuls, DMAs and stalls included — everything but host/relay
+    dispatch overhead). Shared with tools/dispatch_probe.py, which also
+    needs the start timestamps for inter-module gap analysis. Raises on
+    trace failure; callers decide the fallback.
     """
     import glob
     import shutil
@@ -477,7 +497,6 @@ def _device_step_ms(step, state, batch, multistep: int = 1, n_steps: int = 10):
 
     tmpdir = tempfile.mkdtemp(prefix="dv_bench_trace_")
     try:
-        dispatches = max(1, math.ceil(n_steps / multistep))
         jax.profiler.start_trace(tmpdir)
         for _ in range(dispatches):
             state, loss = step(state, batch)
@@ -496,14 +515,28 @@ def _device_step_ms(step, state, batch, multistep: int = 1, n_steps: int = 10):
         xs = xplane_pb2.XSpace()
         with open(path, "rb") as f:
             xs.ParseFromString(f.read())
-        durs = []
+        events = []
         for plane in xs.planes:
             if not plane.name.startswith("/device:TPU"):
                 continue
             for line in plane.lines:
                 if line.name != "XLA Modules":
                     continue
-                durs += [ev.duration_ps / 1e9 for ev in line.events]
+                for ev in line.events:
+                    start_ps = line.timestamp_ns * 1000 + ev.offset_ps
+                    events.append((start_ps, ev.duration_ps))
+        events.sort()
+        return events
+    finally:
+        shutil.rmtree(tmpdir, ignore_errors=True)
+
+
+def _device_step_ms(step, state, batch, multistep: int = 1, n_steps: int = 10):
+    """Median on-device ms/step from a jax.profiler trace (None on failure)."""
+    dispatches = max(1, math.ceil(n_steps / multistep))
+    try:
+        events = _trace_module_events(step, state, batch, dispatches)
+        durs = [d / 1e9 for _, d in events]  # ps -> ms
         if len(durs) < dispatches // 2:
             return None
         return float(np.median(durs)) / multistep
@@ -511,8 +544,6 @@ def _device_step_ms(step, state, batch, multistep: int = 1, n_steps: int = 10):
         print(f"bench: no device trace ({type(e).__name__}: {e}); "
               "falling back to wall time", file=sys.stderr)
         return None
-    finally:
-        shutil.rmtree(tmpdir, ignore_errors=True)
 
 
 def sweep_main(out_path: str) -> None:

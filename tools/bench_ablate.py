@@ -68,56 +68,18 @@ def _swap_bn(unfused: bool):
 
 
 def make_step(*, stem="s2d", unfused_bn=False):
-    """The bench train step with the ablation knobs applied."""
-    import jax
-    import jax.numpy as jnp
+    """The bench train step with the ablation knobs applied.
 
-    from deep_vision_tpu.core.train_state import create_train_state
-    from deep_vision_tpu.losses.classification import classification_loss_fn
-    from deep_vision_tpu.models import get_model
-    from deep_vision_tpu.parallel.mesh import create_mesh, data_sharding, replicated
-    from deep_vision_tpu.train.optimizers import build_optimizer
+    bench.make_train_parts builds the exact flagship program (BATCH images
+    PER CHIP, like bench.py); the BN swap stays active through construction
+    AND the jit trace. All reported rates are per chip: XLA cost analysis
+    is per-device under SPMD and BATCH/time is the per-chip rate."""
+    import jax
 
     with _swap_bn(unfused_bn):
-        mesh = create_mesh()
-        model = get_model("resnet50", num_classes=1000, dtype=jnp.bfloat16,
-                          stem=stem)
-        tx = build_optimizer("sgd", learning_rate=0.1, momentum=0.9,
-                             weight_decay=1e-4)
-        if stem == "s2d":
-            sample = jnp.ones((8, 112, 112, 12), jnp.float32)
-            img_shape = (BATCH, 112, 112, 12)
-        else:
-            sample = jnp.ones((8, 224, 224, 3), jnp.float32)
-            img_shape = (BATCH, 224, 224, 3)
-        state = create_train_state(model, tx, sample)
-        state = jax.device_put(state, replicated(mesh))
-    rng = np.random.RandomState(0)
-    batch = {
-        "image": rng.rand(*img_shape).astype(np.float32).astype(jnp.bfloat16),
-        "label": rng.randint(0, 1000, size=(BATCH,)).astype(np.int32),
-    }
-    batch = {k: jax.device_put(v, data_sharding(mesh, v.ndim))
-             for k, v in batch.items()}
-
-    def train_step(state, batch):
-        step_rng = jax.random.fold_in(state.rng, state.step)
-
-        def loss_fn(params):
-            variables = {"params": params, "batch_stats": state.batch_stats}
-            outputs, new_model_state = state.apply_fn(
-                variables, batch["image"], train=True,
-                rngs={"dropout": step_rng}, mutable=["batch_stats"],
-            )
-            loss, _ = classification_loss_fn(outputs, batch)
-            return loss, new_model_state["batch_stats"]
-
-        (loss, new_bs), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-            state.params
+        train_step, state, batch, *_ = bench.make_train_parts(
+            BATCH, stem=stem
         )
-        return state.apply_gradients(grads).replace(batch_stats=new_bs), loss
-
-    with _swap_bn(unfused_bn):  # active during trace too
         step = jax.jit(train_step, donate_argnums=0).lower(
             state, batch
         ).compile()
@@ -200,18 +162,18 @@ def main(out_path="artifacts/ablate_r04.json", skip_flash=False):
             if dts:
                 wall = float(np.median(dts)) * 1e3
                 row["wall_ms_per_step"] = round(wall, 2)
-                row["wall_images_per_sec"] = round(BATCH / wall * 1e3, 1)
+                row["wall_images_per_sec_per_chip"] = round(BATCH / wall * 1e3, 1)
             rows.append(row)
             continue
         step, state, batch, row, dts = slot
         if dts:
             wall = float(np.median(dts)) * 1e3
             row["wall_ms_per_step"] = round(wall, 2)
-            row["wall_images_per_sec"] = round(BATCH / wall * 1e3, 1)
+            row["wall_images_per_sec_per_chip"] = round(BATCH / wall * 1e3, 1)
         dev = bench._device_step_ms(step, state, batch, 1)
         if dev:
             row["device_ms_per_step"] = round(dev, 2)
-            row["device_images_per_sec"] = round(BATCH / dev * 1e3, 1)
+            row["device_images_per_sec_per_chip"] = round(BATCH / dev * 1e3, 1)
         if name == "flagship_s2d_fused_bn":
             flagship = row
         rows.append(row)

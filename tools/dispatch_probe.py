@@ -30,12 +30,9 @@ a CPU-heavy cotenant inflated a 74 ms step to 174 ms in round 3).
 """
 from __future__ import annotations
 
-import glob
 import json
 import os
-import shutil
 import sys
-import tempfile
 import time
 
 import numpy as np
@@ -68,44 +65,19 @@ def pure_sync_rtt_ms(n=5):
 
 
 def device_timeline(step, state, batch, dispatches=20):
-    """(module_durations_ms, inter_module_gaps_ms) from one traced window."""
-    import jax
+    """(module_durations_ms, inter_module_gaps_ms) from one traced window
+    (bench._trace_module_events does the trace + xplane parse).
 
-    os.environ.setdefault("PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION", "python")
-    tmpdir = tempfile.mkdtemp(prefix="dv_probe_trace_")
-    try:
-        jax.profiler.start_trace(tmpdir)
-        for _ in range(dispatches):
-            state, loss = step(state, batch)
-        float(loss)
-        jax.profiler.stop_trace()
-        from tensorflow.tsl.profiler.protobuf import xplane_pb2
-
-        path = glob.glob(os.path.join(tmpdir, "**", "*.xplane.pb"),
-                         recursive=True)[0]
-        xs = xplane_pb2.XSpace()
-        with open(path, "rb") as f:
-            xs.ParseFromString(f.read())
-        events = []
-        for plane in xs.planes:
-            if not plane.name.startswith("/device:TPU"):
-                continue
-            for line in plane.lines:
-                if line.name != "XLA Modules":
-                    continue
-                for ev in line.events:
-                    start_ps = line.timestamp_ns * 1000 + ev.offset_ps
-                    events.append((start_ps, ev.duration_ps))
-        events.sort()
-        # ps -> ms (1 ms = 1e9 ps)
-        durs_ms = [d / 1e9 for _, d in events]
-        gaps_ms = [
-            (events[i + 1][0] - (events[i][0] + events[i][1])) / 1e9
-            for i in range(len(events) - 1)
-        ]
-        return durs_ms, gaps_ms, state
-    finally:
-        shutil.rmtree(tmpdir, ignore_errors=True)
+    CONSUMES `state`: the step donates its input, so the caller's handle is
+    dead after this returns — call it last."""
+    events = bench._trace_module_events(step, state, batch, dispatches)
+    # ps -> ms (1 ms = 1e9 ps)
+    durs_ms = [d / 1e9 for _, d in events]
+    gaps_ms = [
+        (events[i + 1][0] - (events[i][0] + events[i][1])) / 1e9
+        for i in range(len(events) - 1)
+    ]
+    return durs_ms, gaps_ms
 
 
 def main(out_path="artifacts/dispatch_r04.json"):
@@ -178,7 +150,7 @@ def main(out_path="artifacts/dispatch_r04.json"):
 
     # 4. device timeline
     try:
-        durs, gaps, state = device_timeline(step, state, batch)
+        durs, gaps = device_timeline(step, state, batch)  # consumes state
         art["device_timeline"] = {
             "module_ms": [round(d, 2) for d in durs],
             "inter_module_gap_us": [round(g * 1e3, 1) for g in gaps],

@@ -93,7 +93,7 @@ def classify(opcode: str, line: str) -> str:
         return "elementwise fusion (BN apply/residual/opt)"
     if opcode == "convolution":
         return "convolution (unfused)"
-    if opcode in ("copy", "copy-start", "copy-done", "transpose", "bitcast"):
+    if opcode in ("copy", "copy-start", "copy-done", "transpose"):
         return "copy/layout"
     if opcode in ("all-reduce", "all-gather", "reduce-scatter"):
         return "collective"

@@ -31,84 +31,25 @@ def _log(m):
     print(f"layout_probe: {m}", file=sys.stderr, flush=True)
 
 
-def build_auto(batch_per_chip: int):
-    """bench.build_bench's step, recompiled with AUTO in/out layouts and
-    inputs re-staged in the chosen formats."""
-    import jax
-    from jax.experimental.layout import Format, Layout
-
-    step, state, batch, batch_size, n_chips, devices = bench.build_bench(
-        batch_per_chip, 1
-    )
-    # rebuild the jit with AUTO layouts over the same fn: reuse the traced
-    # fn via step's underlying callable is not exposed, so rebuild from
-    # bench (same code path, same seeds)
-    return step, state, batch, batch_size
-
-
 def main(out_path="artifacts/layout_probe_r04.json"):
     import jax
     from jax.experimental.layout import Format, Layout
 
     art = {"what": __doc__.split("\n")[0], "window": WINDOW, "reps": REPS}
 
-    # Build the default-layout step via bench (also yields fn-free state)
-    _log("building default-layout step")
-    import deep_vision_tpu  # noqa: F401  (import side effects once)
-
-    # Re-create the exact bench train_step fn by calling build_bench twice
-    # would double-compile; instead reach into bench for the pieces.
-    from deep_vision_tpu.core.train_state import create_train_state
-    from deep_vision_tpu.losses.classification import classification_loss_fn
-    from deep_vision_tpu.models import get_model
-    from deep_vision_tpu.parallel.mesh import create_mesh, data_sharding, replicated
-    from deep_vision_tpu.train.optimizers import build_optimizer
-    import jax.numpy as jnp
-
-    devices = jax.devices()
-    mesh = create_mesh(devices=devices)
-    batch_size = 256 * len(devices)
-    model = get_model("resnet50", num_classes=1000, dtype=jnp.bfloat16,
-                      stem="s2d")
-    tx = build_optimizer("sgd", learning_rate=0.1, momentum=0.9,
-                         weight_decay=1e-4)
-    sample = jnp.ones((8, 112, 112, 12), jnp.float32)
-    state = create_train_state(model, tx, sample)
-    state = jax.device_put(state, replicated(mesh))
-    rng = np.random.RandomState(0)
-    batch_np = {
-        "image": rng.rand(batch_size, 112, 112, 12).astype(np.float32)
-        .astype(jnp.bfloat16),
-        "label": rng.randint(0, 1000, size=(batch_size,)).astype(np.int32),
-    }
-    batch = {k: jax.device_put(v, data_sharding(mesh, v.ndim))
-             for k, v in batch_np.items()}
-
-    def train_step(state, batch):
-        step_rng = jax.random.fold_in(state.rng, state.step)
-
-        def loss_fn(params):
-            variables = {"params": params, "batch_stats": state.batch_stats}
-            outputs, new_model_state = state.apply_fn(
-                variables, batch["image"], train=True,
-                rngs={"dropout": step_rng}, mutable=["batch_stats"],
-            )
-            loss, _ = classification_loss_fn(outputs, batch)
-            return loss, new_model_state["batch_stats"]
-
-        (loss, new_bs), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-            state.params
-        )
-        return state.apply_gradients(grads).replace(batch_stats=new_bs), loss
+    _log("building the flagship step (bench.make_train_parts)")
+    train_step, state, batch, batch_size, n_chips, devices = (
+        bench.make_train_parts(256)
+    )
 
     _log("compiling A (default layouts)")
     step_a = jax.jit(train_step, donate_argnums=0).lower(state, batch).compile()
 
     _log("compiling B (AUTO layouts)")
     auto = Format(Layout.AUTO)
-    fmt_tree_in = (jax.tree.map(lambda _: auto, (state, batch)),)
     jitted_b = jax.jit(train_step, donate_argnums=0,
-                       in_shardings=fmt_tree_in[0],
+                       in_shardings=jax.tree.map(lambda _: auto,
+                                                 (state, batch)),
                        out_shardings=jax.tree.map(
                            lambda _: auto,
                            jax.eval_shape(train_step, state, batch)))
