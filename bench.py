@@ -38,7 +38,16 @@ same boundaries.
 Resilience: the timing loop retries transient runtime/transport failures
 (the round-2 driver run died to a single tunnel hiccup, `BENCH_r02.json`)
 by rebuilding the jitted step and replaying the window; the JSON line is
-ALWAYS emitted, degraded if necessary, with an `error` field.
+ALWAYS emitted, degraded if necessary, with an `error` field. Two hard
+wall-clock guards make that promise hold even against a HUNG (not erroring)
+backend — the round-4 failure mode, where a dead relay tunnel blocks the
+main thread in socket recv and no exception ever fires (`BENCH_r04.json`:
+rc=124, no output): a threaded liveness probe must complete a trivial
+device op within BENCH_INIT_BUDGET_S (default 180 s) before any real work
+starts, and a watchdog thread force-emits the degraded JSON line and exits
+0 at BENCH_BUDGET_S (default 1500 s) no matter where the main thread is
+stuck. A healthy fresh-compile run finishes in ~6 min; both budgets are
+env-overridable.
 
 `--data host` / `--data fused` instead benchmark the REAL input pipeline
 (SURVEY §7 hard part #1): sharded records -> JPEG decode -> augment -> host
@@ -56,6 +65,7 @@ import json
 import math
 import os
 import sys
+import threading
 import time
 
 import jax
@@ -78,6 +88,143 @@ TIMED_STEPS = 600  # steps per timed window. Long windows amortize the
 WINDOWS = 3  # report the MEDIAN window: robust to tunnel jitter without
              # inflating the metric the way a best-of-N min would
 MAX_RETRIES = 5  # rebuild-and-replay budget for transient tunnel failures
+
+# Hard wall-clock budgets (seconds, env-overridable). A dead tunnel HANGS
+# rather than raising, so exception-based retries alone cannot bound the
+# run; these can. Healthy timings for scale: fresh-shape compile ~4 min,
+# warmup + 3x600-step windows ~2 min, liveness round trip ~120 ms.
+BUDGET_S = float(os.environ.get("BENCH_BUDGET_S", "1500"))
+INIT_BUDGET_S = float(os.environ.get("BENCH_INIT_BUDGET_S", "180"))
+# cooperative early-stop margins, scaled down with tiny (test) budgets: how
+# close to the watchdog deadline it is still worth starting another timed
+# window / a device trace window
+_STOP_MARGIN_S = min(120.0, 0.1 * BUDGET_S)
+_TRACE_MARGIN_S = min(90.0, 0.075 * BUDGET_S)
+# starting a REBUILD needs room for a fresh-shape compile (~4 min on this
+# rig): rebuilding with less than this left would let the watchdog fire
+# mid-compile and lose the pre-failure windows' degraded median
+_REBUILD_MARGIN_S = min(330.0, 0.22 * BUDGET_S)
+
+_DEADLINE = None  # monotonic; set when the watchdog starts
+_EMIT_LOCK = threading.Lock()
+_EMITTED = False
+_LAST_STAGE = "start"
+_WINDOWS_DONE = 0
+
+
+def _emit(result: dict) -> bool:
+    """Print the one contract JSON line, exactly once process-wide.
+
+    Both the normal completion path and the watchdog call this; whichever
+    arrives first wins, so the driver can never see two JSON lines (or
+    zero)."""
+    global _EMITTED
+    with _EMIT_LOCK:
+        if _EMITTED:
+            return False
+        _EMITTED = True
+        # print under the lock: if the winner released first and was then
+        # descheduled before printing, the loser's path could reach
+        # _hard_exit and kill the process with ZERO lines emitted
+        print(json.dumps(result), flush=True)
+    return True
+
+
+def _remaining() -> float:
+    return math.inf if _DEADLINE is None else _DEADLINE - time.monotonic()
+
+
+def _hard_exit(code: int = 0) -> None:
+    """Flush, reap worker children, then os._exit.
+
+    os._exit skips multiprocessing's atexit cleanup, and surviving decode
+    workers hold an inherited stdout fd — a driver reading the pipe to EOF
+    would block on them past its timeout even with the parent gone. So the
+    children are terminated explicitly first."""
+    try:
+        sys.stdout.flush()
+        sys.stderr.flush()
+    except Exception:
+        pass
+    try:
+        import multiprocessing
+
+        for p in multiprocessing.active_children():
+            p.terminate()
+        for p in multiprocessing.active_children():
+            p.join(timeout=2.0)
+            if p.is_alive():
+                p.kill()
+    except Exception:
+        pass
+    os._exit(code)
+
+
+def _start_watchdog(result: dict) -> None:
+    """Arm the BENCH_BUDGET_S guard: at the deadline, emit `result` (plus a
+    budget-exhausted error and the last logged stage) and exit 0.
+
+    os._exit, not sys.exit: the main thread may be unrecoverably blocked in
+    a backend socket recv, and a hung jax client can also wedge interpreter
+    teardown — the driver must see rc=0 and one parseable line regardless.
+    """
+    global _DEADLINE
+    _DEADLINE = time.monotonic() + BUDGET_S
+
+    def bite():
+        while time.monotonic() < _DEADLINE:
+            time.sleep(min(1.0, max(0.05, _DEADLINE - time.monotonic())))
+        try:  # snapshot: the main thread may be mutating `result` right now
+            payload = dict(result)
+            errors = list(payload.get("errors", []))
+        except RuntimeError:
+            payload = {"metric": result.get("metric", "unknown"),
+                       "value": 0.0, "vs_baseline": 0.0}
+            errors = []
+        errors.append(
+            f"wall-clock budget exhausted ({BUDGET_S:.0f}s); "
+            f"last stage: {_LAST_STAGE}"
+        )
+        payload["errors"] = errors[-5:]
+        payload.setdefault("windows_completed", _WINDOWS_DONE)
+        _emit(payload)
+        _hard_exit(0)
+
+    threading.Thread(target=bite, daemon=True, name="bench-watchdog").start()
+
+
+def _backend_alive(budget_s: float, probe=None):
+    """(ok, error) — does a trivial device op complete within budget_s?
+
+    The op runs in a worker thread: against a dead relay it blocks forever
+    in socket recv (no exception), so a plain try/except cannot detect the
+    outage — a join timeout can. The orphaned thread stays blocked and is
+    daemon-irrelevant because degraded exits go through os._exit."""
+    if probe is None and os.environ.get("BENCH_SIMULATE_DEAD"):
+        # rehearsal hook: behave exactly like a dead relay (block, don't
+        # raise) so the degraded path can be exercised on a healthy machine
+        def probe():
+            return time.sleep(7 * 24 * 3600)
+    if probe is None:
+        def probe():
+            return float(jnp.ones((), jnp.float32).sum())
+    out = {}
+
+    def run():
+        try:
+            out["value"] = probe()
+        except Exception as e:
+            out["error"] = f"{type(e).__name__}: {e}"
+
+    t = threading.Thread(target=run, daemon=True, name="bench-liveness")
+    t.start()
+    t.join(budget_s)
+    if t.is_alive():
+        return False, (f"backend liveness probe still blocked after "
+                       f"{budget_s:.0f}s (dead tunnel?)")
+    if "error" in out:
+        return False, f"backend liveness probe failed: {out['error']}"
+    return True, None
 
 # bf16 peak of the chips this bench is expected to meet; device_kind prefix
 # match, first hit wins, conservative default otherwise.
@@ -171,15 +318,17 @@ def data_main(mode: str, num_procs: int) -> None:
         f"num_procs={num_procs}",
         file=sys.stderr,
     )
-    print(json.dumps({
+    _emit({
         "metric": f"imagenet_pipeline_{mode}_images_per_sec_per_core",
         "value": round(per_core, 1),
         "unit": "images/sec/core",
         "vs_baseline": round(per_core / DATA_TARGET_PER_CORE, 3),
-    }))
+    })
 
 
 def _log(msg: str) -> None:
+    global _LAST_STAGE
+    _LAST_STAGE = msg  # the watchdog's degraded JSON names the stuck stage
     print(f"bench: {msg}", file=sys.stderr, flush=True)
 
 
@@ -346,7 +495,19 @@ def _timed_windows(batch_per_chip: int, multistep: int):
     last_good = None  # survives rebuild failures: completed windows stay
                       # attributed to a real (step, ..., devices) tuple
     attempt = 0
+    global _WINDOWS_DONE
     while len(window_dts) < WINDOWS:
+        margin = _STOP_MARGIN_S if built else _REBUILD_MARGIN_S
+        if _remaining() < margin:
+            # close enough to the watchdog that another attempt (a window,
+            # or a rebuild's full compile) can't finish: stop here so the
+            # MEASURED windows (including the stale pre-failure fallback)
+            # reach the JSON line instead of the watchdog's stage snapshot
+            errors.append("stopping early: wall-clock budget nearly "
+                          f"exhausted ({_remaining():.0f}s left, "
+                          f"need {margin:.0f}s)")
+            _log(errors[-1])
+            break
         try:
             if built is None:
                 step, state, batch, batch_size, n_chips, devices = build_bench(
@@ -373,6 +534,7 @@ def _timed_windows(batch_per_chip: int, multistep: int):
             dt = time.perf_counter() - t0
             _log(f"window {w}: {dt / steps_per_window * 1e3:.1f} ms/step")
             window_dts.append(dt / steps_per_window)
+            _WINDOWS_DONE = len(window_dts)
             # the step donates its state input: refresh the snapshot so the
             # returned state is the LIVE buffer, not a donated husk
             last_good[1] = state
@@ -386,6 +548,7 @@ def _timed_windows(batch_per_chip: int, multistep: int):
                 stale_dts = window_dts
                 window_dts = []  # discard pre-failure windows: one healthy
                                  # session only feeds the median
+                _WINDOWS_DONE = 0  # keep the watchdog's count honest
             if attempt > MAX_RETRIES:
                 _log("retry budget exhausted")
                 break
@@ -393,6 +556,7 @@ def _timed_windows(batch_per_chip: int, multistep: int):
             _recover_backend(attempt)
     if not window_dts and stale_dts:
         window_dts = stale_dts
+        _WINDOWS_DONE = len(window_dts)
         errors.append("degraded: median from pre-failure windows")
     if last_good is None:
         return window_dts, None, None, None, 0, 0, [], errors
@@ -401,8 +565,10 @@ def _timed_windows(batch_per_chip: int, multistep: int):
             errors)
 
 
-def main(args) -> None:
-    result = {
+def train_result_stub(args) -> dict:
+    """The degraded-case contract line for the train bench: what the driver
+    parses if nothing past argument parsing ever completes."""
+    return {
         "metric": "resnet50_train_images_per_sec_per_chip",
         "value": 0.0,
         "unit": "images/sec/chip",
@@ -411,7 +577,22 @@ def main(args) -> None:
         "batch_per_chip": args.batch,
         "multistep": args.multistep,
     }
+
+
+def main(args, result: dict | None = None) -> None:
+    if result is None:
+        result = train_result_stub(args)
     try:
+        # leave the watchdog 30s of headroom so a dead backend reports as
+        # the specific liveness error, not the generic budget one
+        probe_budget = min(INIT_BUDGET_S, max(1.0, _remaining() - 30.0))
+        _log(f"backend liveness probe (budget {probe_budget:.0f}s)")
+        t0 = time.perf_counter()
+        ok, err = _backend_alive(probe_budget)
+        if not ok:
+            result["errors"] = [err]
+            return  # degraded emission from finally
+        _log(f"backend alive ({time.perf_counter() - t0:.1f}s)")
         (window_dts, step, state, batch, batch_size, n_chips, devices,
          errors) = _timed_windows(args.batch, args.multistep)
         if errors:
@@ -457,8 +638,14 @@ def main(args) -> None:
         # Device step time from a profiler trace. Wall differs from it only
         # by the per-host-sync relay latency amortized over the window
         # (~118 ms / TIMED_STEPS; mechanism measured in
-        # artifacts/dispatch_r04.json — NOT a per-dispatch cost).
-        dev_ms = _device_step_ms(step, state, batch, args.multistep)
+        # artifacts/dispatch_r04.json — NOT a per-dispatch cost). Skipped
+        # when the watchdog deadline is close: the wall headline above is
+        # already measured and must not be lost to a trace-window hang.
+        dev_ms = None
+        if _remaining() > _TRACE_MARGIN_S:
+            dev_ms = _device_step_ms(step, state, batch, args.multistep)
+        else:
+            _log("skipping device trace: budget nearly exhausted")
         if dev_ms is not None:
             dev_per_chip = batch_size / n_chips / (dev_ms / 1e3)
             _log(f"device step {dev_ms:.1f} ms")
@@ -477,7 +664,7 @@ def main(args) -> None:
         ]
         _log(f"fatal: {type(e).__name__}: {e}")
     finally:
-        print(json.dumps(result), flush=True)
+        _emit(result)
 
 
 def _trace_module_events(step, state, batch, dispatches: int):
@@ -638,9 +825,8 @@ def sweep_main(out_path: str) -> None:
     with open(out_path, "w") as f:
         json.dump(artifact, f, indent=2)
     # the one-line JSON contract holds even for a fully-failed sweep
-    print(json.dumps({"metric": "dispatch_sweep", "artifact": out_path,
-                      "rows": rows, **({"errors": errors[-3:]} if errors
-                                       else {})}))
+    _emit({"metric": "dispatch_sweep", "artifact": out_path,
+           "rows": rows, **({"errors": errors[-3:]} if errors else {})})
 
 
 if __name__ == "__main__":
@@ -660,8 +846,41 @@ if __name__ == "__main__":
                              "write the artifact JSON")
     args = parser.parse_args()
     if args.data:
-        data_main(args.data, args.num_procs)
+        stub = {
+            "metric": f"imagenet_pipeline_{args.data}_images_per_sec_per_core",
+            "value": 0.0, "unit": "images/sec/core", "vs_baseline": 0.0,
+        }
+        # 'host' mode never touches a device: no liveness gate needed
+        run = lambda: data_main(args.data, args.num_procs)
+        needs_device = args.data == "fused"
     elif args.sweep:
-        sweep_main(args.sweep)
+        stub = {"metric": "dispatch_sweep", "artifact": args.sweep,
+                "rows": []}
+        run = lambda: sweep_main(args.sweep)
+        needs_device = True
     else:
-        main(args)
+        stub = train_result_stub(args)
+        run = lambda: main(args, stub)
+        needs_device = False  # main() runs its own gate with headroom
+    _start_watchdog(stub)
+    try:
+        if needs_device:
+            ok, err = _backend_alive(INIT_BUDGET_S)
+            if not ok:
+                stub["errors"] = [err]
+                _emit(stub)
+                _hard_exit(0)
+        run()
+    except KeyboardInterrupt:
+        raise
+    except Exception as e:
+        # the contract line must exist even for failures outside main()'s
+        # own try/finally (e.g. a fixture-dir write error in data_main)
+        stub["errors"] = stub.get("errors", []) + [f"{type(e).__name__}: {e}"]
+        _log(f"fatal: {type(e).__name__}: {e}")
+        _emit(stub)
+    # hard exit, not fall-through: after a degraded run a wedged jax client
+    # thread can hang interpreter teardown past the driver's timeout, which
+    # is exactly the rc:124 this file exists to prevent. The contract line
+    # is already flushed.
+    _hard_exit(0)
