@@ -71,6 +71,14 @@ def classification_loss_fn(
                             f"aux metric name {name!r} collides with a "
                             "reserved metric key; rename it"
                         )
+                    if name[1:] in metrics:
+                        # same fail-loud intent as the reserved-key guard:
+                        # '_x' next to a penalty 'x' (or a repeated name
+                        # across aux dicts) would silently last-writer-win
+                        raise ValueError(
+                            f"duplicate aux metric name {name[1:]!r}; "
+                            "rename one of the colliding aux outputs"
+                        )
                     metrics[name[1:]] = value
                     continue
                 # reserved keys are written below and would silently
@@ -80,6 +88,11 @@ def classification_loss_fn(
                     raise ValueError(
                         f"aux penalty name {name!r} collides with a reserved "
                         "metric key; rename it (e.g. 'aux_" + name + "')"
+                    )
+                if name in metrics:
+                    raise ValueError(
+                        f"duplicate aux penalty name {name!r}; rename one "
+                        "of the colliding aux outputs"
                     )
                 loss = loss + penalty_weight * value
                 metrics[name] = value

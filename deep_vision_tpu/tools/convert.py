@@ -118,9 +118,13 @@ def main(argv=None) -> int:
             train_dir=args.train_dir, val_dir=args.val_dir,
             val_synsets=args.val_synsets, move=args.move,
         )
-        print(f"prepare-imagenet: {stats['train']} train -> "
-              f"{args.out_dir}/train_flatten, {stats['val']} val -> "
-              f"{args.out_dir}/val_flatten")
+        parts = []
+        if args.train_tars or args.train_dir:
+            parts.append(f"{stats['train']} train -> "
+                         f"{args.out_dir}/train_flatten")
+        if args.val_dir:
+            parts.append(f"{stats['val']} val -> {args.out_dir}/val_flatten")
+        print("prepare-imagenet: " + ", ".join(parts))
     elif args.dataset == "imagenet_bboxes":
         stats = C.imagenet_bbox_csv(args.xml_dir, args.out_csv, args.synsets)
         annotated = (stats["files"] - stats["skipped_files"]

@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import json
 import os
+import re
 import shutil
 import xml.etree.ElementTree as ET
 from multiprocessing import Pool
@@ -453,12 +454,35 @@ def prepare_imagenet(out_dir: str,
             labels = [line.strip() for line in f if line.strip()]
         vdst = os.path.join(out_dir, "val_flatten")
         os.makedirs(vdst, exist_ok=True)
-        names = sorted(n for n in os.listdir(val_dir)
-                       if n.lower().endswith((".jpeg", ".jpg", ".png")))
+        names = [n for n in os.listdir(val_dir)
+                 if n.lower().endswith((".jpeg", ".jpg", ".png"))]
+
+        # The label file is ordered by validation INDEX (line i = image
+        # ILSVRC2012_val_{i+1:08d}), so pair by the parsed index, never by
+        # lexicographic order: a renamed file that still matches the
+        # extension filter would silently shift every label after it while
+        # keeping the counts equal.
+        def _val_index(name: str) -> int:
+            m = re.match(r"ILSVRC2012_val_(\d{8})\.", name)
+            if not m:
+                raise ValueError(
+                    f"unrecognized validation image name {name!r} in "
+                    f"{val_dir}: expected ILSVRC2012_val_NNNNNNNN.<ext>; "
+                    "refusing to pair images with synset labels"
+                )
+            return int(m.group(1))
+
+        names.sort(key=_val_index)
         if len(names) != len(labels):
             raise ValueError(
                 f"{len(names)} val images but {len(labels)} synset labels"
             )
+        for i, name in enumerate(names):
+            if _val_index(name) != i + 1:
+                raise ValueError(
+                    f"validation set has a gap: expected index {i + 1}, "
+                    f"found {name!r} — labels would misalign from here on"
+                )
         for name, synset in zip(names, labels):
             dst = os.path.join(vdst, f"{synset}_{name}")
             if not os.path.exists(dst):

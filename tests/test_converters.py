@@ -276,6 +276,25 @@ def test_prepare_imagenet(tmp_path):
     C.prepare_imagenet(str(out), train_tars=str(tars))
     assert len(os.listdir(out / "train_flatten")) == 2
 
+    # ADVICE r4: a renamed val file that still matches the extension filter
+    # must refuse loudly, not silently shift labels for part of the split
+    bad = tmp_path / "val_bad"
+    os.makedirs(bad)
+    _write_jpeg(bad / "ILSVRC2012_val_00000001.JPEG")
+    _write_jpeg(bad / "copy_of_val_2.JPEG")
+    with pytest.raises(ValueError, match="unrecognized validation"):
+        C.prepare_imagenet(str(tmp_path / "p2"), val_dir=str(bad),
+                           val_synsets=str(val_labels))
+    # a gap in the index sequence (file 1 missing, files 2-3 present) would
+    # misalign every later label even though the counts match
+    gap = tmp_path / "val_gap"
+    os.makedirs(gap)
+    _write_jpeg(gap / "ILSVRC2012_val_00000002.JPEG")
+    _write_jpeg(gap / "ILSVRC2012_val_00000003.JPEG")
+    with pytest.raises(ValueError, match="gap"):
+        C.prepare_imagenet(str(tmp_path / "p3"), val_dir=str(gap),
+                           val_synsets=str(val_labels))
+
     # the flattened output is exactly what the converter consumes
     synsets = tmp_path / "synsets.txt"
     synsets.write_text("n01440764\nn02119789\n")

@@ -143,3 +143,17 @@ def test_aux_penalty_name_collision_raises():
     batch = {"label": np.zeros((4,), np.int32)}
     with pytest.raises(ValueError, match="reserved"):
         classification_loss_fn((logits, {"loss": jnp.float32(1.0)}), batch)
+
+
+def test_aux_duplicate_name_collision_raises():
+    """A '_'-prefixed diagnostic and a same-named penalty (or repeats across
+    aux dicts) must not silently last-writer-win in metrics (ADVICE r4)."""
+    logits = jnp.zeros((4, 8))
+    batch = {"label": np.zeros((4,), np.int32)}
+    one = jnp.float32(1.0)
+    # diagnostic '_x' surfaces as 'x'; penalty 'x' then collides
+    with pytest.raises(ValueError, match="duplicate"):
+        classification_loss_fn((logits, {"_x": one, "x": one}), batch)
+    # same surfaced name across two aux dicts
+    with pytest.raises(ValueError, match="duplicate"):
+        classification_loss_fn((logits, {"_x": one}, {"_x": one}), batch)

@@ -139,3 +139,26 @@ def test_gratings_difficulty_knob():
     # 32-class variant factors 8 orientations x 4 freqs and stays in range
     imgs32, labels32 = procedural_gratings(8, classes=32, size=32)
     assert labels32.max() < 32
+
+
+def test_gratings_nonfactoring_class_count_stays_in_freq_range():
+    """ADVICE r4: class counts that don't factor as n_orient x n_freq must
+    still map every label to a frequency inside the documented 4-13 cycles
+    grid (n_freq rounds UP, never leaving labels off-grid)."""
+    import math
+
+    import numpy as np
+
+    from deep_vision_tpu.tools.convergence_run import procedural_gratings
+
+    for classes in (20, 30, 5):
+        imgs, labels = procedural_gratings(2 * classes, classes=classes,
+                                           size=32, seed=1)
+        assert labels.max() < classes and np.isfinite(imgs).all()
+        # the implementation's own grid: ceil'd n_freq keeps every label's
+        # frequency inside [4, 13] cycles
+        n_orient = 4 if classes <= 16 else 8
+        n_freq = max(1, math.ceil(classes / n_orient))
+        for c in range(classes):
+            freq = 4.0 + (9.0 / max(1, n_freq - 1)) * (c // n_orient)
+            assert 4.0 <= freq <= 13.0 + 1e-9, (classes, c, freq)
