@@ -1,0 +1,71 @@
+"""Regression guard for the r4 V-MoE "router stall" root cause.
+
+`artifacts/vmoe_stall_analysis_r04.md` (hardware, vmoe_s16): training the
+attention family with AdamW at full LR from step 0 produces a plateau at the
+uniform-prediction loss (ln C) that a short linear warmup removes entirely —
+the collapsed MoE router during the plateau is a symptom of the optimizer
+transient, not an MoE defect, and the shipped `vit_s16`/`vmoe_s16` configs
+carry `warmup_epochs: 5` as the validated mitigation.
+
+This CPU-sized reproduction (tiny 2-block V-MoE, 16-class memorization
+fixture, the same `_train_step`/`build_optimizer` path as
+`tools/convergence_run.py --warmup`) encodes both curves' qualitative shape
+so the finding can't silently rot: no-warmup still sits near ln C at step
+30 while the warmed-up run has escaped, and the warmed-up run converges.
+Seed pinned: across seeds the two distributions are well separated at these
+margins (no-warmup@30 in [1.9, 3.3], warmup@30 in [0.2, 2.0]); seed 0 sits
+mid-distribution (2.56 vs 0.32).
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from deep_vision_tpu.core.train_state import create_train_state
+from deep_vision_tpu.models.vit import ViT
+from deep_vision_tpu.tools.convergence_run import _train_step
+from deep_vision_tpu.train.optimizers import build_optimizer
+
+CLASSES = 16
+LR = 3e-3  # at tiny scale the transient needs the larger LR to show; the
+           # hardware runs reproduced it at vmoe_s16 scale with 1e-3
+
+
+def _run(warmup: int, steps: int):
+    rng = np.random.RandomState(0)
+    batch = {
+        "image": jnp.asarray(rng.rand(32, 32, 32, 3).astype(np.float32)),
+        "label": jnp.asarray(np.arange(32) % CLASSES, jnp.int32),
+    }
+    model = ViT(depth=2, dim=64, num_heads=4, patch=8,
+                num_classes=CLASSES, num_experts=4)
+    sched = optax.linear_schedule(0.0, LR, warmup) if warmup else LR
+    tx = build_optimizer("adamw", sched, weight_decay=1e-4)
+    state = create_train_state(model, tx, jnp.ones((2, 32, 32, 3)),
+                               jax.random.PRNGKey(0))
+    step = jax.jit(functools.partial(_train_step, aux_weight=0.01),
+                   donate_argnums=0)
+    at30 = final = None
+    for i in range(steps):
+        state, metrics = step(state, batch)
+        if i == 30:
+            at30 = float(metrics["loss"])
+        if i == steps - 1:
+            final = float(metrics["loss"])
+    return at30, final
+
+
+def test_warmup_removes_the_no_warmup_plateau():
+    uniform = float(np.log(CLASSES))  # 2.77: the stall's loss level
+    nowarm_at30, _ = _run(warmup=0, steps=31)
+    warm_at30, warm_final = _run(warmup=50, steps=80)
+    # the plateau exists without warmup: still near the uniform loss
+    assert nowarm_at30 > 0.6 * uniform, nowarm_at30
+    # warmup escapes it: well below both the plateau and the no-warmup run
+    assert warm_at30 < 1.2, warm_at30
+    assert warm_at30 < 0.5 * nowarm_at30, (warm_at30, nowarm_at30)
+    # and the warmed-up recipe actually converges
+    assert warm_final < 0.1, warm_final
