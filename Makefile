@@ -50,6 +50,15 @@ demo:
 	python -m deep_vision_tpu.tools.convergence_run --model hourglass \
 	  --holdout --render-dir examples/output
 
+demo-gan:
+	python -m deep_vision_tpu.tools.convergence_run --model dcgan \
+	  --render-dir examples/output --out artifacts/dcgan_convergence.json
+	python -m deep_vision_tpu.tools.convergence_run --model cyclegan \
+	  --render-dir examples/output --out artifacts/cyclegan_convergence.json
+
+demo-real:
+	python examples/real_photo_demo.py
+
 dryrun:
 	python __graft_entry__.py 8
 
@@ -62,4 +71,4 @@ ps:
 native:
 	$(MAKE) -C native
 
-.PHONY: train resume train-fg test bench bench-evidence demo dryrun tb ps native
+.PHONY: train resume train-fg test bench bench-evidence demo demo-gan demo-real dryrun tb ps native

@@ -110,6 +110,22 @@ def draw_detections(image: np.ndarray, boxes, scores, classes,
     return out
 
 
+def draw_classification(image: np.ndarray, label: str,
+                        prob: float) -> np.ndarray:
+    """Top-1 label banner on an RGB uint8 image (the rendered-output parity
+    of ResNet50.ipynb's classify-a-real-photo demo). PIL text, so the
+    classification overlay stays cv2-free like the rest of this path."""
+    from PIL import Image, ImageDraw
+
+    im = Image.fromarray(image)
+    d = ImageDraw.Draw(im, "RGBA")
+    h = max(20, image.shape[0] // 14)
+    d.rectangle([0, 0, image.shape[1], h], fill=(0, 0, 0, 190))
+    d.text((8, max(3, h // 4)), f"{label}  {prob:.2f}",
+           fill=(255, 255, 255, 255))
+    return np.asarray(im)
+
+
 def draw_pose(image: np.ndarray, kpts, score_threshold: float = 0.1,
               skeleton=POSE_SKELETON) -> np.ndarray:
     """Joint dots + skeleton limbs; kpts (J, 3) = normalized x, y, score
@@ -160,6 +176,14 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--preprocessing", default="torch", choices=["torch", "tf"],
                    help="must match how the checkpoint was trained "
                         "(train.py --preprocessing)")
+    p.add_argument("--render", action="store_true",
+                   help="classification configs: also write a "
+                        "<name>_classified.jpg display copy with the top-1 "
+                        "label drawn")
+    p.add_argument("--labels", default=None,
+                   help="class-name file, one name per line, line i = model "
+                        "class index i (the converter's imagenet labels are "
+                        "1-based with 0 = background)")
     p.add_argument("images", nargs="+")
     args = p.parse_args(argv)
 
@@ -169,6 +193,16 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     cfg = get_config(args.model)
     size = cfg.input_shape[0]
+
+    # class names apply to classification (top-5 lines, --render banner)
+    # AND detection (printed lines + box overlay labels)
+    names = None
+    if args.labels:
+        with open(args.labels) as fh:
+            names = [line.strip() for line in fh if line.strip()]
+
+    def name_of(i: int) -> str:
+        return names[i] if names and 0 <= i < len(names) else f"class {i}"
 
     def outpath(src: str, suffix: str) -> str:
         base = os.path.basename(src)
@@ -209,8 +243,16 @@ def main(argv: Optional[List[str]] = None) -> int:
         probs /= probs.sum(-1, keepdims=True)
         for f, pr in zip(args.images, probs):
             top = np.argsort(pr)[::-1][:5]
-            picks = " ".join(f"class {i}: {pr[i]:.3f}" for i in top)
+            picks = " ".join(f"{name_of(int(i))}: {pr[i]:.3f}" for i in top)
             print(f"{f}: {picks}")
+            if args.render:
+                k = int(top[0])
+                drawn = draw_classification(
+                    _reload_rgb(f, size), name_of(k), float(pr[k])
+                )
+                dst = outpath(f, "_classified.jpg")
+                _write_jpeg(dst, drawn)
+                print(f"  wrote {dst}")
         return 0
 
     if cfg.task in ("detection", "centernet"):
@@ -246,7 +288,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             lines = []
             for j in range(n):
                 b = out["boxes"][i, j]
-                line = (f"  class {int(out['classes'][i, j])} "
+                line = (f"  {name_of(int(out['classes'][i, j]))} "
                         f"score {float(out['scores'][i, j]):.3f} "
                         f"box [{b[0]:.3f} {b[1]:.3f} {b[2]:.3f} {b[3]:.3f}]")
                 print(line)
@@ -259,6 +301,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                 drawn = draw_detections(
                     _reload_rgb(f, size), out["boxes"][i, :n],
                     out["scores"][i, :n], out["classes"][i, :n],
+                    class_names=names,
                 )
                 dst = outpath(f, "_detected.jpg")
                 cv2.imwrite(dst, drawn[..., ::-1])  # RGB -> BGR

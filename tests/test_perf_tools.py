@@ -141,6 +141,48 @@ def test_gratings_difficulty_knob():
     assert labels32.max() < 32
 
 
+def test_roofline_analytic_model_matches_known_resnet50_figures():
+    """The shape-math traffic/FLOP model must reproduce the published
+    ResNet-50 numbers: ~8.2 GFLOP forward per image (so ~24.6 train at the
+    3x convention) and a total parameter count near 25.6M."""
+    from deep_vision_tpu.tools.roofline import (
+        analytic_traffic,
+        resnet50_conv_shapes,
+    )
+
+    a = analytic_traffic(128)
+    per_img_gflop = a["train_tflops_per_step"] * 1e3 / 128
+    assert 22.0 < per_img_gflop < 27.0, per_img_gflop
+    params = sum(L["k"] * L["k"] * L["cin"] * L["cout"]
+                 for L in resnet50_conv_shapes())
+    assert 23e6 < params < 28e6, params  # conv+head (BN scales excluded)
+    # the bound is a LOWER bound: far under the cost_analysis overcount
+    # (~40 GB at b128) and strictly positive floors
+    assert 5.0 < a["total_gb"] < 40.0
+    assert a["min_step_ms_if_memory_bound"] > 0
+    assert a["min_step_ms_if_compute_bound"] > 0
+    # the per-layer itemization accounts for the whole total (not just the
+    # top-10 excerpt that top_layers shows)
+    assert abs(a["itemized_total_gb"] - a["total_gb"]) < 0.05
+    assert sum(r["gb"] for r in a["top_layers"]) > 0.3 * a["total_gb"]
+
+
+def test_roofline_verdict_paths():
+    from deep_vision_tpu.tools.roofline import analytic_traffic, verdict
+
+    a = analytic_traffic(128)
+    assert "analytic-only" in verdict(a, None)
+    # memory-bound path: device time equal to the memory floor
+    v = verdict(a, {"device_step_ms": a["min_step_ms_if_memory_bound"],
+                    "dma_gb_per_step": a["total_gb"]})
+    assert "memory-bound" in v
+    # not-bound path: device time far above both floors, low traffic
+    v = verdict(a, {"device_step_ms": 10
+                    * a["min_step_ms_if_memory_bound"],
+                    "dma_gb_per_step": a["total_gb"]})
+    assert "NOT memory-bound" in v
+
+
 def test_gratings_nonfactoring_class_count_stays_in_freq_range():
     """ADVICE r4: class counts that don't factor as n_orient x n_freq must
     still map every label to a frequency inside the documented 4-13 cycles
