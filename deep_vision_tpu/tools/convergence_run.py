@@ -354,7 +354,7 @@ def procedural_shapes(n: int, size: int = 192, max_boxes: int = 3,
 
 def run_holdout_detection(steps: int = 400, batch: int = 16,
                           size: int = 192, out_path: Optional[str] = None,
-                          n_train: int = 256, n_val: int = 64,
+                          n_train: int = 256, n_val: int = 256,
                           lr: float = 1e-3,
                           render_dir: Optional[str] = None) -> dict:
     """Train YOLOv3 on procedural shapes ON-CHIP, score HELD-OUT mAP via
@@ -486,6 +486,11 @@ def run_holdout_detection(steps: int = 400, batch: int = 16,
         "val_map50": round(float(res["mAP"]), 4),
         "val_ap_per_class": {str(k): round(float(v), 4)
                              for k, v in res.get("ap_per_class", {}).items()},
+        # per-class GT support: makes round-to-round AP deltas attributable
+        # (a 1-point swing over 20 boxes is noise; over 300 it isn't)
+        "val_gt_per_class": {
+            str(k): int((va_c[va_c >= 0] == k).sum()) for k in range(3)
+        },
     }
     _write_artifact(out_path, result)
     return result
@@ -542,7 +547,7 @@ def procedural_figures(n: int, size: int = 128, seed: int = 0,
 
 def run_holdout_pose(steps: int = 300, batch: int = 16, size: int = 128,
                      out_path: Optional[str] = None, n_train: int = 256,
-                     n_val: int = 64, lr: float = 2.5e-4,
+                     n_val: int = 256, lr: float = 2.5e-4,
                      render_dir: Optional[str] = None) -> dict:
     """Train a 2-stack hourglass on procedural figures ON-CHIP, score
     HELD-OUT PCKh@0.5 via the real heatmap-peak decode
@@ -658,6 +663,9 @@ def run_holdout_pose(steps: int = 300, batch: int = 16, size: int = 128,
         "val_pckh50": round(float(res["PCKh@0.5"]), 4),
         "val_pck_per_joint": [round(float(v), 4)
                               for v in res.get("per_joint", [])],
+        # support per joint (all joints visible on every procedural figure):
+        # the denominator behind each per-joint number above
+        "val_scored_per_joint": int(vis.sum(axis=0)[0]),
     }
     _write_artifact(out_path, result)
     return result
