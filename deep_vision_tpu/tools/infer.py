@@ -200,6 +200,13 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.labels:
         with open(args.labels) as fh:
             names = [line.strip() for line in fh if line.strip()]
+    elif cfg.dataset.get("schema") == "voc":
+        # the 20 VOC names are fixed by the dataset (interop constants,
+        # like the anchor priors): the demo output shows "person 0.92",
+        # not "class 14", with no flag needed
+        from deep_vision_tpu.tools.converters import VOC_CLASSES
+
+        names = list(VOC_CLASSES)
 
     def name_of(i: int) -> str:
         return names[i] if names and 0 <= i < len(names) else f"class {i}"
