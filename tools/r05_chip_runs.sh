@@ -1,0 +1,47 @@
+#!/bin/bash
+# Round-5 chip-work queue: run ONE AT A TIME when the tunnel is back.
+# (Two concurrent clients can wedge the tunnel permanently — see the
+# ONE-CLIENT-AT-A-TIME note in the perf memory; poll log files only.)
+#
+#   nohup bash tools/r05_chip_runs.sh > /tmp/r05_chip.log 2>&1 &
+#
+# Order: cheapest/most-valuable first, so a mid-queue outage still leaves
+# the headline evidence captured.
+set -x
+cd "$(dirname "$0")/.."
+
+# 0. liveness
+timeout 120 python -c "import jax; print(float(jax.numpy.ones(()).sum()))" || exit 1
+
+# 1. headline bench preview (the driver runs its own at round end; this is
+#    the builder-side capture + sanity that the outage-proofing didn't slow
+#    the healthy path)
+timeout 1800 python bench.py > artifacts/bench_preview_r05.json.tmp 2>/tmp/bench_r05.err \
+  && tail -1 artifacts/bench_preview_r05.json.tmp > artifacts/bench_preview_r05.json \
+  && rm artifacts/bench_preview_r05.json.tmp
+
+# 2. roofline measured half (DMA totals + device step)
+timeout 1800 python -m deep_vision_tpu.tools.roofline --out artifacts/roofline_r05.json
+
+# 3. fine batch sweep around the knee (argv: out_path batches_csv)
+timeout 3600 python tools/batch_sweep.py artifacts/batch_fine_r05.json 96,112,128,144,160
+
+# 4. model-zoo step times at 100-step windows (fixes the biased YOLO/flash rows)
+timeout 3600 python tools/bench_models.py
+
+# 5. ablations regen (flash ratio at long windows)
+timeout 3600 python tools/bench_ablate.py
+
+# 6. GAN hardware evidence + sample grids
+timeout 2400 python -m deep_vision_tpu.tools.convergence_run --model dcgan \
+  --render-dir examples/output --out artifacts/dcgan_convergence.json
+timeout 2400 python -m deep_vision_tpu.tools.convergence_run --model cyclegan \
+  --render-dir examples/output --out artifacts/cyclegan_convergence.json
+
+# 7. fattened holdouts (n_val 256 + support counts)
+timeout 3600 python -m deep_vision_tpu.tools.convergence_run --model yolov3 \
+  --holdout --render-dir examples/output
+timeout 3600 python -m deep_vision_tpu.tools.convergence_run --model hourglass \
+  --holdout --render-dir examples/output
+
+echo "R05 CHIP QUEUE DONE"
