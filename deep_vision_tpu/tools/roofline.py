@@ -266,6 +266,11 @@ def main(argv=None) -> int:
                    help="per-chip batch (the flagship bench point)")
     p.add_argument("--analytic", action="store_true",
                    help="skip the chip: shape-math bound only")
+    p.add_argument("--device-ms", type=float, default=None,
+                   help="previously measured device step time (ms) to use "
+                        "when the chip is unreachable; cite --device-ms-source")
+    p.add_argument("--device-ms-source", default=None,
+                   help="artifact the --device-ms number came from")
     p.add_argument("--out", default="artifacts/roofline_r05.json")
     args = p.parse_args(argv)
 
@@ -276,6 +281,14 @@ def main(argv=None) -> int:
             measured = measure_on_chip(args.batch)
         except Exception as e:
             measured = {"error": f"{type(e).__name__}: {e}"}
+    if (measured is None or "error" in measured) and args.device_ms:
+        prior = {"device_step_ms": args.device_ms,
+                 "source": args.device_ms_source or "prior measurement",
+                 "note": "chip unreachable; device time from the cited "
+                         "prior artifact (no DMA totals this run)"}
+        if measured and "error" in measured:
+            prior["chip_error"] = measured["error"]
+        measured = prior
     v = verdict(analytic, measured if measured and "error" not in
                 (measured or {}) else None)
     result = {
@@ -287,6 +300,27 @@ def main(argv=None) -> int:
         "analytic": analytic,
         "measured": measured,
         "verdict": v,
+        # the measured optimization attempts behind the current operating
+        # point (interleaved same-process A/B unless noted):
+        "optimization_attempts": [
+            {"lever": "batch size (coarse sweep 128-512)",
+             "result": "WIN: 97.88 -> 46.31 ms per 128 images "
+                       "(2615 -> 2764 img/s); batch 128 is the knee",
+             "artifact": "artifacts/batch_scaling_r04.json"},
+            {"lever": "Layout.AUTO input/param layouts",
+             "result": "NULL: bytes-accessed 77.9 -> 68.1 GB but device "
+                       "time 97.9 -> 103.4 ms — XLA's default layout "
+                       "copies buy conv-optimal tiling worth more than "
+                       "their bandwidth",
+             "artifact": "artifacts/layout_probe_r04.json"},
+            {"lever": "compiler knobs (rwb fusion, latency-hiding "
+                      "scheduler, scoped vmem, MSA)",
+             "result": "NULL: none beat baseline in interleaved A/B (r3)",
+             "artifact": "memory: r3 probe series"},
+            {"lever": "fused single-pass BatchNorm",
+             "result": "WIN (shipped): 1.286x step vs flax nn.BatchNorm",
+             "artifact": "artifacts/ablate_r04.json"},
+        ],
     }
     os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
     with open(args.out, "w") as f:
