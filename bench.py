@@ -667,6 +667,26 @@ def main(args, result: dict | None = None) -> None:
         _emit(result)
 
 
+def load_xspace(tmpdir: str):
+    """Parse the xplane.pb a jax.profiler trace left under `tmpdir`.
+
+    Shared by the module-event timing here and tools/roofline.py's
+    DMA-byte walk. TF ships stale generated protos; the pure-python parser
+    accepts them (must be set before google.protobuf first loads)."""
+    import glob
+
+    os.environ.setdefault("PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION", "python")
+    from tensorflow.tsl.profiler.protobuf import xplane_pb2
+
+    path = glob.glob(
+        os.path.join(tmpdir, "**", "*.xplane.pb"), recursive=True
+    )[0]
+    xs = xplane_pb2.XSpace()
+    with open(path, "rb") as f:
+        xs.ParseFromString(f.read())
+    return xs
+
+
 def _trace_module_events(step, state, batch, dispatches: int):
     """[(start_ps, duration_ps)] of device "XLA Modules" events from one
     traced window of `dispatches` executions, sorted by start time.
@@ -678,7 +698,6 @@ def _trace_module_events(step, state, batch, dispatches: int):
     needs the start timestamps for inter-module gap analysis. Raises on
     trace failure; callers decide the fallback.
     """
-    import glob
     import shutil
     import tempfile
 
@@ -689,19 +708,7 @@ def _trace_module_events(step, state, batch, dispatches: int):
             state, loss = step(state, batch)
         float(loss)
         jax.profiler.stop_trace()
-        # TF ships stale generated protos; the pure-python parser accepts
-        # them (must be set before google.protobuf first loads)
-        os.environ.setdefault(
-            "PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION", "python"
-        )
-        from tensorflow.tsl.profiler.protobuf import xplane_pb2
-
-        path = glob.glob(
-            os.path.join(tmpdir, "**", "*.xplane.pb"), recursive=True
-        )[0]
-        xs = xplane_pb2.XSpace()
-        with open(path, "rb") as f:
-            xs.ParseFromString(f.read())
+        xs = load_xspace(tmpdir)
         events = []
         for plane in xs.planes:
             if not plane.name.startswith("/device:TPU"):

@@ -844,8 +844,8 @@ def run_gan_cyclegan(steps: int = 400, batch: int = 8, size: int = 64,
     b = procedural_oriented(n_batches * batch, size, horizontal=False, seed=1)
     # host numpy slices: train_step shard_batches internally (see the dcgan
     # runner's staging note)
-    a_dev = [a[i * batch:(i + 1) * batch] for i in range(n_batches)]
-    b_dev = [b[i * batch:(i + 1) * batch] for i in range(n_batches)]
+    a_batches = [a[i * batch:(i + 1) * batch] for i in range(n_batches)]
+    b_batches = [b[i * batch:(i + 1) * batch] for i in range(n_batches)]
     mk_g = lambda: CycleGanGenerator(n_blocks=3, base=16)
     mk_d = lambda: PatchGanDiscriminator(base=16)
     trainer = CycleGanTrainer(
@@ -856,7 +856,8 @@ def run_gan_cyclegan(steps: int = 400, batch: int = 8, size: int = 64,
     )
     curves = {"g_loss": [], "g_cycle": [], "d_loss": []}
     for i in range(steps):
-        m = trainer.train_step(a_dev[i % n_batches], b_dev[i % n_batches])
+        m = trainer.train_step(a_batches[i % n_batches],
+                               b_batches[i % n_batches])
         if i % 10 == 0 or i == steps - 1:
             host = jax.device_get(m)
             for k in curves:

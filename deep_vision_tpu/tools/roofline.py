@@ -50,17 +50,14 @@ def resnet50_conv_shapes(image: int = 224, width: int = 64,
     {name, h, w, cin, cout, k, stride} — the shape source for the traffic
     and FLOP models. Includes the bottleneck projection (downsample) convs.
     """
-    layers = []
-    if stem == "s2d":
-        # host space-to-depth ships (H/2, W/2, 12); the stem conv is the
-        # 4x4 reshaped twin of the 7x7/s2 (models/resnet.py SpaceToDepthStem)
-        layers.append(dict(name="stem", h=image // 2, w=image // 2, cin=12,
-                           cout=width, k=4, stride=1))
-        h = image // 2
-    else:
-        layers.append(dict(name="stem", h=image // 2, w=image // 2, cin=3,
-                           cout=width, k=7, stride=2))
-        h = image // 2
+    # s2d: host space-to-depth ships (H/2, W/2, 12) and the stem conv is the
+    # 4x4 reshaped twin of the 7x7/s2 (models/resnet.py SpaceToDepthStem);
+    # either way the stem's output grid is image/2
+    stem_args = (dict(cin=12, k=4, stride=1) if stem == "s2d"
+                 else dict(cin=3, k=7, stride=2))
+    layers = [dict(name="stem", h=image // 2, w=image // 2, cout=width,
+                   **stem_args)]
+    h = image // 2
     h //= 2  # maxpool /2
     stage_sizes = (3, 4, 6, 3)
     cin = width
@@ -110,7 +107,7 @@ def analytic_traffic(batch: int, image: int = 224,
         a_in = batch * L["h"] * L["w"] * L["cin"] * ACT_BYTES
         a_out = batch * hout * wout * L["cout"] * ACT_BYTES
         # fwd: read in, write out; bwd: read in, read dout, write din
-        act = (2 * a_in) + a_out + a_out + a_in
+        act = 3 * a_in + 2 * a_out
         p = L["k"] * L["k"] * L["cin"] * L["cout"] * PAR_BYTES
         par = 6 * p
         flops = 2 * batch * hout * wout * L["k"] * L["k"] * L["cin"] * \
@@ -149,7 +146,6 @@ def measure_on_chip(batch: int) -> dict:
 
     sys.path.insert(0, os.path.dirname(os.path.dirname(
         os.path.dirname(os.path.abspath(__file__)))))
-    import glob
     import shutil
     import tempfile
 
@@ -171,16 +167,7 @@ def measure_on_chip(batch: int) -> dict:
             state, loss = step(state, b)
         float(loss)
         jax.profiler.stop_trace()
-        os.environ.setdefault(
-            "PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION", "python"
-        )
-        from tensorflow.tsl.profiler.protobuf import xplane_pb2
-
-        path = glob.glob(os.path.join(tmpdir, "**", "*.xplane.pb"),
-                         recursive=True)[0]
-        xs = xplane_pb2.XSpace()
-        with open(path, "rb") as f:
-            xs.ParseFromString(f.read())
+        xs = bench.load_xspace(tmpdir)
         module_ms = []
         dma_bytes = 0
         dma_events = 0
@@ -234,6 +221,7 @@ def verdict(analytic: dict, measured: Optional[dict]) -> str:
     frac_mem = mem_ms / dev
     frac_mxu = mxu_ms / dev
     dma = measured.get("dma_gb_per_step")
+    dma_gbs = dma / dev * 1e3 if dma else None  # measured bandwidth
     parts = [
         f"device step {dev} ms vs memory-bound floor {mem_ms} ms "
         f"({100 * frac_mem:.0f}% of step) and MXU floor {mxu_ms} ms "
@@ -241,14 +229,13 @@ def verdict(analytic: dict, measured: Optional[dict]) -> str:
     ]
     if dma:
         parts.append(
-            f"measured DMA traffic {dma} GB/step = "
-            f"{dma / dev * 1e3:.0f} GB/s "
-            f"({100 * dma / dev * 1e3 / PEAK_HBM_GBS:.0f}% of pin bw)"
+            f"measured DMA traffic {dma} GB/step = {dma_gbs:.0f} GB/s "
+            f"({100 * dma_gbs / PEAK_HBM_GBS:.0f}% of pin bw)"
         )
     if frac_mem >= 0.8:
         parts.append("VERDICT: memory-bound at the fusion-optimal limit — "
                      "byte-cutting (layout, dtype, recompute) is the lever")
-    elif dma and dma / dev * 1e3 >= 0.8 * PEAK_HBM_GBS:
+    elif dma and dma_gbs >= 0.8 * PEAK_HBM_GBS:
         parts.append("VERDICT: memory-bound via measured traffic (real "
                      "schedule moves more bytes than the optimal-dataflow "
                      "bound) — close the gap between measured and bound")
