@@ -13,6 +13,15 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# honor a JAX_PLATFORMS override even when a site hook imported jax before
+# the env var could take effect at backend init (e.g. JAX_PLATFORMS=cpu to
+# run this example without an accelerator)
+import jax  # noqa: E402
+
+if os.environ.get("JAX_PLATFORMS"):
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+
 import argparse
 import tempfile
 
