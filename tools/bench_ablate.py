@@ -28,7 +28,10 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import bench  # noqa: E402
 
-WINDOW = 50
+WINDOW = 100  # window-closing fetch costs ~118 ms once per window; 100
+              # steps caps the per-step bias at ~1.2 ms (was 50 in r4 —
+              # fine for the ResNet ms/step scale, but the short flash
+              # attention calls need the longer window; see bench_models)
 REPS = 3
 BATCH = 128  # flagship batch (artifacts/batch_scaling_r04.json)
 
