@@ -34,6 +34,12 @@ train-fg:
 test:
 	python -m pytest tests/ -x -q
 
+# the tier-1 gate, verbatim from ROADMAP.md: run before shipping any PR
+# (bash, not sh: the command uses pipefail and PIPESTATUS)
+verify: SHELL := /bin/bash
+verify:
+	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
+
 bench:
 	python bench.py
 
@@ -71,4 +77,4 @@ ps:
 native:
 	$(MAKE) -C native
 
-.PHONY: train resume train-fg test bench bench-evidence demo demo-gan demo-real dryrun tb ps native
+.PHONY: train resume train-fg test verify bench bench-evidence demo demo-gan demo-real dryrun tb ps native

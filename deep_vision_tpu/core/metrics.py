@@ -55,39 +55,82 @@ class _Meter:
         return self.total / max(self.count, 1)
 
 
-class MetricLogger:
-    """Host-side metric series, stdout logging and examples/sec meter."""
+def _metric_slug(name: str) -> str:
+    """Prometheus-safe metric name ('mAP@.5' -> 'mAP__5')."""
+    return "".join(c if c.isalnum() or c == "_" else "_" for c in name)
 
-    def __init__(self, tb_writer=None, print_every: int = 10, name: str = "train"):
+
+class MetricLogger:
+    """Host-side metric series, stdout logging and examples/sec meter.
+
+    With `registry`/`journal` (obs/ subsystem), every step's metrics also
+    land as gauges and every epoch summary as a journal `epoch` event —
+    one log call fans out to stdout, TensorBoard, Prometheus, and JSONL.
+    """
+
+    def __init__(self, tb_writer=None, print_every: int = 10, name: str = "train",
+                 registry=None, journal=None):
         self.history: Dict[str, list] = collections.defaultdict(list)
         self.tb = tb_writer
         self.print_every = print_every
         self.name = name
+        self.registry = registry
+        self.journal = journal
         self._epoch_meters: Dict[str, _Meter] = {}
         self._epoch_start = time.time()
         self._epoch_examples = 0
+        self._last_step_time: Optional[float] = None
 
     # -- epoch lifecycle ---------------------------------------------------
     def start_epoch(self):
         self._epoch_meters = collections.defaultdict(_Meter)
         self._epoch_start = time.time()
         self._epoch_examples = 0
+        self._last_step_time = None
 
     def log_step(self, step: int, metrics: dict, batch_size: int = 0,
-                 epoch: Optional[int] = None, lr: Optional[float] = None):
+                 epoch: Optional[int] = None, lr: Optional[float] = None,
+                 data_wait_ms: Optional[float] = None,
+                 examples_per_sec: Optional[float] = None):
         metrics = {k: float(v) for k, v in metrics.items()}
         for k, v in metrics.items():
             self._epoch_meters[k].update(v, max(batch_size, 1))
         self._epoch_examples += batch_size
+        # instantaneous rate when the caller has no StepClock: wall time
+        # since the previous log_step closes the reference's only perf
+        # metric (YOLO/tensorflow/train.py:217-223) at step granularity
+        now = time.time()
+        if examples_per_sec is None and batch_size and \
+                self._last_step_time is not None:
+            dt = max(now - self._last_step_time, 1e-9)
+            examples_per_sec = batch_size / dt
+        self._last_step_time = now
         if self.tb is not None:
             for k, v in metrics.items():
                 self.tb.scalar(f"{self.name}/batch_{k}", v, step)
+            if examples_per_sec is not None:
+                self.tb.scalar(f"{self.name}/examples_per_sec",
+                               examples_per_sec, step)
+            if data_wait_ms is not None:
+                self.tb.scalar(f"{self.name}/data_wait_ms", data_wait_ms, step)
+        if self.registry is not None:
+            for k, v in metrics.items():
+                self.registry.gauge(
+                    f"{self.name}_{_metric_slug(k)}").set(v)
+            if lr is not None and lr == lr:  # skip NaN
+                self.registry.gauge(f"{self.name}_learning_rate").set(lr)
         if self.print_every and step % self.print_every == 0:
             ts = datetime.datetime.now().isoformat(timespec="seconds")
             parts = " ".join(f"{k}={v:.4f}" for k, v in metrics.items())
             lr_s = f" lr={lr:.2e}" if lr is not None else ""
             ep_s = f"epoch {epoch} " if epoch is not None else ""
-            print(f"[{ts}] {self.name} {ep_s}step {step}: {parts}{lr_s}", flush=True)
+            perf_s = ""
+            if examples_per_sec is not None:
+                perf_s += f" ex/s={examples_per_sec:.1f}"
+            if data_wait_ms is not None:
+                perf_s += f" data_wait_ms={data_wait_ms:.1f}"
+            print(f"[{ts}] {self.name} {ep_s}step {step}: {parts}{lr_s}{perf_s}",
+                  flush=True)
 
     def end_epoch(self, epoch: int, extra: Optional[dict] = None) -> dict:
         elapsed = max(time.time() - self._epoch_start, 1e-9)
@@ -101,6 +144,12 @@ class MetricLogger:
             self.history[k].append((epoch, v))
             if self.tb is not None:
                 self.tb.scalar(f"{self.name}/epoch_{k}", v, epoch)
+            if self.registry is not None:
+                self.registry.gauge(
+                    f"{self.name}_epoch_{_metric_slug(k)}").set(v)
+        if self.journal is not None:
+            self.journal.write("epoch", name=self.name, epoch=epoch,
+                               summary=summary)
         ts = datetime.datetime.now().isoformat(timespec="seconds")
         parts = " ".join(f"{k}={v:.4f}" for k, v in summary.items())
         print(f"[{ts}] {self.name} epoch {epoch} done: {parts}", flush=True)

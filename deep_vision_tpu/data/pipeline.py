@@ -117,8 +117,10 @@ class DataLoader:
         collate_fn: Callable = collate,
         prefetch: int = 2,
         num_procs: int = 0,
+        name: str = "default",
     ):
         self.dataset = dataset
+        self.name = name  # labels this loader's obs metrics (train vs val)
         self.batch_size = batch_size
         self.transform = transform
         self.shuffle = shuffle
@@ -300,6 +302,22 @@ class DataLoader:
         if self.prefetch <= 0:
             yield from self._batches()
             return
+        # obs hooks: registry.py is jax-free, so this stays importable from
+        # spawned data workers. Depth is sampled at every consumer get;
+        # a get on an empty queue means the accelerator out-ran the host
+        # pipeline (starvation — exactly the data_wait the StepClock sees).
+        from deep_vision_tpu.obs.registry import get_registry
+
+        reg = get_registry()
+        labels = {"loader": self.name}  # train vs val stay distinguishable
+        g_depth = reg.gauge("data_prefetch_depth",
+                            "prefetch batches ready when the consumer asked",
+                            labels=labels)
+        c_starved = reg.counter("data_prefetch_starved_total",
+                                "consumer gets that found the queue empty",
+                                labels=labels)
+        c_batches = reg.counter("data_batches_total", "batches yielded",
+                                labels=labels)
         q: "queue.Queue" = queue.Queue(maxsize=self.prefetch)
         sentinel = object()
         err: List[BaseException] = []
@@ -315,10 +333,20 @@ class DataLoader:
 
         t = threading.Thread(target=producer, daemon=True)
         t.start()
+        first = True
         while True:
+            depth = q.qsize()
             item = q.get()
             if item is sentinel:
-                break
+                break  # end-of-epoch wait is not starvation
+            g_depth.set(depth)
+            # skip the first get (the producer just started — inevitably
+            # empty): counting it would stamp phantom starvation on every
+            # epoch of a healthy pipeline
+            if depth == 0 and not first:
+                c_starved.inc()
+            first = False
+            c_batches.inc()
             yield item
         t.join()
         if err:

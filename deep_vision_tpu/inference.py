@@ -14,6 +14,7 @@ Predictors:
 from __future__ import annotations
 
 import functools
+import time
 from typing import Callable, Optional, Sequence
 
 import jax
@@ -22,6 +23,31 @@ import jax.numpy as jnp
 from deep_vision_tpu.ops.anchors import YOLO_ANCHOR_MASKS, YOLO_ANCHORS
 from deep_vision_tpu.ops.boxes import decode_yolo_boxes
 from deep_vision_tpu.ops.nms import non_maximum_suppression
+
+
+def _observed(fn: Callable, task: str) -> Callable:
+    """Wrap a jitted predictor with a per-request latency histogram
+    (obs registry, labeled by task). The wrapper fences with
+    block_until_ready so the observation is end-to-end request latency,
+    not enqueue time — predictors feed host-side evaluators/renderers
+    that fetch the result immediately anyway."""
+    from deep_vision_tpu.obs.registry import get_registry
+
+    reg = get_registry()
+    hist = reg.histogram("inference_latency_ms",
+                         "per-request predictor latency, fenced",
+                         labels={"task": task})
+    count = reg.counter("inference_requests_total", "predictor calls",
+                        labels={"task": task})
+
+    def wrapped(variables, images):
+        t0 = time.perf_counter()
+        out = jax.block_until_ready(fn(variables, images))
+        hist.observe((time.perf_counter() - t0) * 1e3)
+        count.inc()
+        return out
+
+    return wrapped
 
 
 def yolo_decode_outputs(outputs, anchors=YOLO_ANCHORS, anchor_masks=YOLO_ANCHOR_MASKS):
@@ -92,7 +118,7 @@ def make_yolo_detector(
         iou_threshold=iou_threshold,
         score_threshold=score_threshold,
     )
-    return jax.jit(fn)
+    return _observed(jax.jit(fn), "yolo")
 
 
 def centernet_decode(
@@ -157,7 +183,7 @@ def make_centernet_detector(model, *, max_detections: int = 100,
             score_threshold=score_threshold,
         )
 
-    return jax.jit(detect)
+    return _observed(jax.jit(detect), "centernet")
 
 
 def heatmaps_to_keypoints(heatmaps):
@@ -181,4 +207,4 @@ def make_pose_estimator(model):
         heatmaps = outputs[-1] if isinstance(outputs, (list, tuple)) else outputs
         return heatmaps_to_keypoints(heatmaps)
 
-    return jax.jit(estimate)
+    return _observed(jax.jit(estimate), "pose")

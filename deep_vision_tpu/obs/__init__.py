@@ -1,0 +1,45 @@
+"""Unified telemetry: metrics registry, run journal, step-time breakdown.
+
+The observability layer every perf PR reports through (SURVEY.md §2.7
+records the reference's instrumentation as one examples/sec print):
+
+- `registry`: counters / gauges / log-scale histograms, exported as
+  Prometheus text format or JSONL snapshots (`Registry`, `get_registry`).
+- `journal`: append-only JSONL of typed run events — manifest, steps,
+  evals, checkpoints, crash/exit markers (`RunJournal`, `read_journal`).
+- `stepclock`: host data-wait vs dispatch vs device-compute breakdown
+  with periodic `block_until_ready` fences, plus recompile and HBM
+  tracking (`StepClock`, `recompile_count`, `hbm_bytes_in_use`).
+
+All file writers are process-0-only under `jax.process_index()`; metric
+*collection* runs on every host so counters stay meaningful if a
+follower is later asked to dump state.
+"""
+from deep_vision_tpu.obs.journal import RunJournal, read_journal
+from deep_vision_tpu.obs.registry import (
+    Counter,
+    Gauge,
+    Histogram,
+    Registry,
+    get_registry,
+    is_primary_host,
+)
+from deep_vision_tpu.obs.stepclock import (
+    StepClock,
+    hbm_bytes_in_use,
+    recompile_count,
+)
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "Registry",
+    "RunJournal",
+    "StepClock",
+    "get_registry",
+    "hbm_bytes_in_use",
+    "is_primary_host",
+    "read_journal",
+    "recompile_count",
+]

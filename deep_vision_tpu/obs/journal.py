@@ -1,0 +1,175 @@
+"""Run journal: append-only JSONL of typed run events.
+
+One file per run, one JSON object per line, `event` + `ts` on every line.
+Event types (full schema in obs/README.md):
+
+  run_manifest  config, argv, mesh, device/process topology, jax version
+  step          per-step timing/metrics (step_time_ms, data_wait_ms, ...)
+  epoch         MetricLogger epoch summaries
+  eval          eval-pass summaries
+  checkpoint    checkpoint saves/restores
+  profile       profiler trace start/stop
+  bench         one benchmark measurement (tools/bench_*.py)
+  note          free-form annotation
+  crash         atexit marker: the process died without close()
+  exit          clean close, with status
+
+The writer is process-0-only (`jax.process_index()`), appends with a
+flush per line (a crash loses at most the in-flight line), and registers
+an atexit hook that stamps a `crash` event — so a reader can always tell
+a finished run (`exit`) from a dead one (`crash`, or no terminal event at
+all for SIGKILL). Readers: `read_journal`, tools/obs_report.py.
+"""
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import platform
+import sys
+import time
+from typing import Callable, List, Optional
+
+from deep_vision_tpu.obs.registry import is_primary_host
+
+
+def _jsonable(v):
+    """Best-effort conversion for numpy/jax scalars and containers."""
+    if isinstance(v, dict):
+        return {str(k): _jsonable(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        return [_jsonable(x) for x in v]
+    if isinstance(v, (str, int, bool)) or v is None:
+        return v
+    if isinstance(v, float):
+        return v if v == v and abs(v) != float("inf") else repr(v)
+    try:
+        return float(v)  # numpy/jax 0-d arrays and scalars
+    except (TypeError, ValueError):
+        return repr(v)
+
+
+class RunJournal:
+    """Append-only JSONL journal for one run (or one bench session)."""
+
+    def __init__(self, path: str, run_id: Optional[str] = None,
+                 kind: str = "train"):
+        self.path = path
+        self.kind = kind
+        self.run_id = run_id or f"{kind}-{os.getpid()}-{int(time.time())}"
+        self._closed = False
+        self._closers: List[Callable[[], None]] = []
+        self._primary = is_primary_host()
+        self._f = None
+        if self._primary:
+            d = os.path.dirname(path)
+            if d:
+                os.makedirs(d, exist_ok=True)
+            self._f = open(path, "a")
+        # the crash marker: fires only if close() never ran
+        atexit.register(self._atexit)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def add_closer(self, fn: Callable[[], None]) -> None:
+        """Register cleanup run by close() (and by the atexit crash path):
+        e.g. Trainer.close so an unwinding run still stops an in-flight
+        profiler trace and flushes writers."""
+        self._closers.append(fn)
+
+    def _run_closers(self) -> None:
+        closers, self._closers = self._closers, []
+        for fn in closers:
+            try:
+                fn()
+            except Exception as e:  # a failing closer must not mask the rest
+                self.write("note", note=f"closer {fn!r} failed: {e!r}")
+
+    def _atexit(self) -> None:
+        if self._closed:
+            return
+        self._run_closers()
+        self.write("crash", reason="process exited without journal.close()")
+        self._closed = True
+        if self._f is not None:
+            self._f.close()
+            self._f = None
+
+    def close(self, status: str = "clean_exit") -> None:
+        if self._closed:
+            return
+        self._run_closers()
+        self.write("exit", status=status)
+        self._closed = True
+        atexit.unregister(self._atexit)
+        if self._f is not None:
+            self._f.close()
+            self._f = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.close("clean_exit" if exc_type is None
+                   else f"exception: {exc_type.__name__}")
+
+    # -- writers -----------------------------------------------------------
+
+    def write(self, event: str, **fields) -> None:
+        if self._f is None:
+            return
+        row = {"event": event, "ts": round(time.time(), 3),
+               "run_id": self.run_id}
+        row.update({k: _jsonable(v) for k, v in fields.items()})
+        self._f.write(json.dumps(row) + "\n")
+        self._f.flush()
+
+    def manifest(self, config: Optional[dict] = None, **extra) -> None:
+        """The run's identity card: everything needed to interpret (or
+        machine-diff) the numbers that follow."""
+        info = {
+            "kind": self.kind,
+            "argv": list(sys.argv),
+            "python": platform.python_version(),
+            "hostname": platform.node(),
+            "pid": os.getpid(),
+        }
+        try:
+            import jax
+
+            info.update(
+                jax_version=jax.__version__,
+                backend=jax.default_backend(),
+                device_kind=jax.devices()[0].device_kind,
+                device_count=jax.device_count(),
+                local_device_count=jax.local_device_count(),
+                process_index=jax.process_index(),
+                process_count=jax.process_count(),
+            )
+        except Exception as e:
+            info["jax"] = f"unavailable: {e!r}"
+        if config is not None:
+            info["config"] = config
+        info.update(extra)
+        self.write("run_manifest", **info)
+
+    def step(self, step: int, **fields) -> None:
+        self.write("step", step=int(step), **fields)
+
+    def bench(self, name: str, result: dict, **extra) -> None:
+        self.write("bench", name=name, result=result, **extra)
+
+
+def read_journal(path: str) -> List[dict]:
+    """Parse a journal JSONL; tolerates a torn final line (crash mid-write)."""
+    events = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                events.append(json.loads(line))
+            except json.JSONDecodeError:
+                events.append({"event": "_torn_line", "raw": line[:200]})
+    return events

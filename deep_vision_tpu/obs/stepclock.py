@@ -1,0 +1,271 @@
+"""Step-time breakdown + recompile and HBM tracking.
+
+Under JAX's async dispatch the wall time around a `train_step` call
+measures *enqueue*, not compute — the reference's examples/sec print
+(YOLO/tensorflow/train.py:217-223) and any naive timer conflate host
+data-wait, dispatch, and device work. StepClock separates them:
+
+  data_wait_ms   host blocked in the data iterator's next()
+  dispatch_ms    host time to trace/shard/enqueue the step
+  step_time_ms   full wall time of the step iteration (wait + dispatch)
+  sync_ms        on sampled steps only: block_until_ready fence closing
+                 the device pipeline — dispatch_ms + sync_ms on those
+                 steps is the true per-step cost
+
+The fence runs every `sample_every` steps (default 16) so steady-state
+throughput stays async and unperturbed; between fences the device queue
+absorbs the timing. Recompiles are counted process-wide from the
+`/jax/core/compile/backend_compile_duration` monitoring event (fires per
+backend compile, silent on cache hits — verified against jit cache
+behavior in tests), HBM from `device.memory_stats()` where the backend
+provides it (TPU yes, CPU None).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Iterable, Iterator, Optional
+
+from deep_vision_tpu.obs.registry import Registry, get_registry
+
+# -- recompile tracking ------------------------------------------------------
+
+_compile_lock = threading.Lock()
+_compile_events = 0
+_listener_installed = False
+
+
+def _install_compile_listener() -> None:
+    """Idempotent: jax.monitoring listeners cannot be individually removed,
+    so exactly one module-level listener feeds a process-wide counter."""
+    global _listener_installed
+    with _compile_lock:
+        if _listener_installed:
+            return
+        import jax
+
+        def _on_duration(event: str, duration: float, **kw) -> None:
+            global _compile_events
+            if "backend_compile" in event:
+                with _compile_lock:
+                    _compile_events += 1
+
+        jax.monitoring.register_event_duration_secs_listener(_on_duration)
+        _listener_installed = True
+
+
+def recompile_count() -> int:
+    """Backend compiles observed process-wide since the listener was
+    installed (first StepClock construction or first explicit call)."""
+    _install_compile_listener()
+    return _compile_events
+
+
+def hbm_bytes_in_use(device=None) -> Optional[int]:
+    """Live device memory, or None where the backend has no stats (CPU)."""
+    try:
+        import jax
+
+        dev = device or jax.local_devices()[0]
+        stats = dev.memory_stats()
+        if not stats:
+            return None
+        return int(stats.get("bytes_in_use", stats.get("bytes_in_use_", 0)))
+    except Exception:
+        return None
+
+
+class StepClock:
+    """Per-step timing harness around a host training loop.
+
+    Usage (what Trainer._run_epoch does):
+
+        clock.start_epoch()
+        for batch in clock.iter_data(data):      # times next() = data wait
+            with clock.step(batch_size=n) as rec:  # times dispatch
+                out = train_step(batch)
+                rec.fence_on(out)                # sampled block_until_ready
+            journal fields: rec.fields()
+
+    All timing is host-side perf_counter; the only device interaction is
+    the sampled fence, and `examples_per_sec` is computed from the wall
+    step time so it matches what an operator observes end to end.
+    """
+
+    def __init__(self, registry: Optional[Registry] = None,
+                 journal=None, name: str = "train",
+                 sample_every: int = 16, track_memory: bool = True):
+        self.registry = registry or get_registry()
+        self.journal = journal
+        self.name = name
+        self.sample_every = max(1, int(sample_every))
+        self.track_memory = track_memory
+        self._steps_seen = 0
+        self._sync_samples = 0
+        self._last_data_wait_ms = 0.0
+        self._recompiles_at_start: Optional[int] = None
+        _install_compile_listener()
+
+        r = self.registry
+        self._g_data_wait = r.gauge(f"{name}_data_wait_ms",
+                                    "host ms blocked on the data iterator")
+        self._g_step = r.gauge(f"{name}_step_time_ms",
+                               "wall ms per step (wait + dispatch)")
+        self._g_eps = r.gauge(f"{name}_examples_per_sec",
+                              "wall-clock examples/sec")
+        self._g_recompiles = r.gauge("jit_recompiles_total",
+                                     "backend compiles observed this process")
+        self._g_hbm = r.gauge("hbm_bytes_in_use",
+                              "device bytes in use (0 where unavailable)")
+        self._h_step = r.histogram(f"{name}_step_ms",
+                                   "per-step wall ms distribution")
+        self._h_wait = r.histogram(f"{name}_data_wait_ms_hist",
+                                   "per-step data-wait ms distribution")
+        self._c_steps = r.counter(f"{name}_steps_total", "steps executed")
+        self._c_examples = r.counter(f"{name}_examples_total",
+                                     "examples consumed")
+        self._c_starved = r.counter(
+            f"{name}_data_starved_steps_total",
+            "steps whose data wait exceeded their dispatch time")
+
+    # -- data-wait side ----------------------------------------------------
+
+    def iter_data(self, data: Iterable) -> Iterator:
+        """Wrap a batch iterable, timing each next() as data wait."""
+        it = iter(data)
+        while True:
+            t0 = time.perf_counter()
+            try:
+                batch = next(it)
+            except StopIteration:
+                return
+            self._last_data_wait_ms = (time.perf_counter() - t0) * 1e3
+            yield batch
+
+    # -- step side ---------------------------------------------------------
+
+    def step(self, batch_size: int = 0,
+             auto_commit: bool = True) -> "_StepRecord":
+        """`auto_commit=False` defers the registry/journal write to an
+        explicit `rec.commit(step=..., metrics=...)` AFTER the with-block,
+        so host-side device fetches (optimizer step, LR) the caller makes
+        between dispatch and logging count toward step_time_ms but never
+        pollute dispatch_ms."""
+        self._steps_seen += 1
+        do_sample = (self._steps_seen % self.sample_every) == 0
+        return _StepRecord(self, batch_size, self._last_data_wait_ms,
+                           do_sample, auto_commit)
+
+    def _finish(self, rec: "_StepRecord") -> None:
+        self._c_steps.inc()
+        if rec.batch_size:
+            self._c_examples.inc(rec.batch_size)
+        self._g_data_wait.set(rec.data_wait_ms)
+        self._g_step.set(rec.step_time_ms)
+        self._h_step.observe(rec.step_time_ms)
+        self._h_wait.observe(rec.data_wait_ms)
+        if rec.examples_per_sec is not None:
+            self._g_eps.set(rec.examples_per_sec)
+        if rec.data_wait_ms > rec.dispatch_ms:
+            self._c_starved.inc()
+        if rec.sampled:
+            self._sync_samples += 1
+            n = recompile_count()
+            self._g_recompiles.set(n)
+            rec.recompiles = n
+            if self.track_memory:
+                hbm = hbm_bytes_in_use()
+                if hbm is not None:
+                    self._g_hbm.set(hbm)
+                    rec.hbm_bytes = hbm
+        if self.journal is not None:
+            self.journal.step(rec.step if rec.step is not None
+                              else self._steps_seen, **rec.fields())
+
+    @property
+    def sync_samples(self) -> int:
+        return self._sync_samples
+
+    @property
+    def steps_seen(self) -> int:
+        return self._steps_seen
+
+
+class _StepRecord:
+    """Context manager for one step; collects the timing fields."""
+
+    def __init__(self, clock: StepClock, batch_size: int,
+                 data_wait_ms: float, sampled: bool, auto_commit: bool):
+        self._clock = clock
+        self.batch_size = batch_size
+        self.data_wait_ms = data_wait_ms
+        self.sampled = sampled
+        self.step: Optional[int] = None  # caller may set the optimizer step
+        self.metrics: dict = {}
+        self.dispatch_ms = 0.0
+        self.sync_ms: Optional[float] = None
+        self.step_time_ms = 0.0
+        self.examples_per_sec: Optional[float] = None
+        self.recompiles: Optional[int] = None
+        self.hbm_bytes: Optional[int] = None
+        self._t0 = 0.0
+        self._fenced = None
+        self._auto_commit = auto_commit
+        self._committed = False
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def fence_on(self, out) -> None:
+        """Hand the step's output here; on sampled steps it is fenced with
+        block_until_ready so sync_ms captures the device pipeline drain."""
+        self._fenced = out
+
+    def __exit__(self, exc_type, exc, tb):
+        self.dispatch_ms = (time.perf_counter() - self._t0) * 1e3
+        if self.sampled and self._fenced is not None and exc_type is None:
+            import jax
+
+            t1 = time.perf_counter()
+            jax.block_until_ready(self._fenced)
+            self.sync_ms = (time.perf_counter() - t1) * 1e3
+        if exc_type is None and self._auto_commit:
+            self.commit()
+        return False
+
+    def commit(self, step: Optional[int] = None,
+               metrics: Optional[dict] = None) -> None:
+        """Close the record and write registry/journal. step_time_ms spans
+        enter -> commit, so deferred-commit callers fold their post-dispatch
+        host fetches into the step total without widening dispatch_ms."""
+        if self._committed:
+            return
+        self._committed = True
+        if step is not None:
+            self.step = step
+        if metrics is not None:
+            self.metrics = metrics
+        self.step_time_ms = self.data_wait_ms + (
+            time.perf_counter() - self._t0) * 1e3
+        if self.batch_size and self.step_time_ms > 0:
+            self.examples_per_sec = self.batch_size / self.step_time_ms * 1e3
+        self._clock._finish(self)
+
+    def fields(self) -> dict:
+        out = {
+            "step_time_ms": round(self.step_time_ms, 3),
+            "data_wait_ms": round(self.data_wait_ms, 3),
+            "dispatch_ms": round(self.dispatch_ms, 3),
+        }
+        if self.examples_per_sec is not None:
+            out["examples_per_sec"] = round(self.examples_per_sec, 2)
+        if self.sync_ms is not None:
+            out["sync_ms"] = round(self.sync_ms, 3)
+        if self.recompiles is not None:
+            out["recompiles"] = self.recompiles
+        if self.hbm_bytes is not None:
+            out["hbm_bytes"] = self.hbm_bytes
+        if self.metrics:
+            out["metrics"] = {k: float(v) for k, v in self.metrics.items()}
+        return out

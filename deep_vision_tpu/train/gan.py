@@ -21,6 +21,7 @@ import numpy as np
 import optax
 
 from deep_vision_tpu.core.train_state import TrainState, create_train_state
+from deep_vision_tpu.obs.stepclock import StepClock
 from deep_vision_tpu.losses.gan import (
     bce_discriminator_loss,
     bce_generator_loss,
@@ -87,9 +88,17 @@ class DcganTrainer:
 
     def __init__(self, generator, discriminator, g_tx, d_tx,
                  latent_dim: int = 100, image_shape=(28, 28, 1),
-                 mesh=None, rng: Optional[jax.Array] = None):
+                 mesh=None, rng: Optional[jax.Array] = None,
+                 journal=None, registry=None,
+                 telemetry_sample_every: int = 32):
         self.mesh = mesh if mesh is not None else create_mesh()
         self.latent_dim = latent_dim
+        # per-step journal events carry timing only: the GAN loop keeps
+        # metrics as device arrays until epoch end, and the clock's sampled
+        # fence is the only sync (obs/stepclock.py)
+        self.clock = StepClock(registry=registry, journal=journal,
+                               name="gan",
+                               sample_every=telemetry_sample_every)
         rng = rng if rng is not None else jax.random.PRNGKey(0)
         g_rng, d_rng = jax.random.split(rng)
         g_state = create_train_state(
@@ -133,10 +142,12 @@ class DcganTrainer:
         return g_state, d_state, {"g_loss": g_loss, "d_loss": d_loss}
 
     def train_step(self, real_images) -> dict:
-        real = shard_batch(self.mesh, np.asarray(real_images))
-        self.g_state, self.d_state, metrics = self._step(
-            self.g_state, self.d_state, real
-        )
+        with self.clock.step(batch_size=np.shape(real_images)[0]) as rec:
+            real = shard_batch(self.mesh, np.asarray(real_images))
+            self.g_state, self.d_state, metrics = self._step(
+                self.g_state, self.d_state, real
+            )
+            rec.fence_on(metrics)
         return metrics
 
     def generate(self, n: int, seed: int = 0):
@@ -185,8 +196,13 @@ class CycleGanTrainer:
 
     def __init__(self, gen_ab, gen_ba, disc_a, disc_b, g_tx_fn: Callable,
                  d_tx_fn: Callable, image_shape=(256, 256, 3), mesh=None,
-                 pool_size: int = 50, rng: Optional[jax.Array] = None):
+                 pool_size: int = 50, rng: Optional[jax.Array] = None,
+                 journal=None, registry=None,
+                 telemetry_sample_every: int = 32):
         self.mesh = mesh if mesh is not None else create_mesh()
+        self.clock = StepClock(registry=registry, journal=journal,
+                               name="gan",
+                               sample_every=telemetry_sample_every)
         rng = rng if rng is not None else jax.random.PRNGKey(0)
         rngs = jax.random.split(rng, 4)
         sample = jnp.zeros((2, *image_shape))
@@ -297,18 +313,21 @@ class CycleGanTrainer:
         return da, db, {"d_loss": d_loss}
 
     def train_step(self, real_a, real_b) -> dict:
-        real_a = shard_batch(self.mesh, np.asarray(real_a))
-        real_b = shard_batch(self.mesh, np.asarray(real_b))
-        self.gab, self.gba, g_metrics, fake_a, fake_b = self._g_step(
-            self.gab, self.gba, self.da, self.db, real_a, real_b
-        )
-        # host boundary: replay-buffer query between the two jitted steps
-        fake_a = shard_batch(self.mesh, self.pool_a.query(np.asarray(fake_a)))
-        fake_b = shard_batch(self.mesh, self.pool_b.query(np.asarray(fake_b)))
-        self.da, self.db, d_metrics = self._d_step(
-            self.da, self.db, real_a, real_b, fake_a, fake_b
-        )
-        return {**g_metrics, **d_metrics}
+        with self.clock.step(batch_size=np.shape(real_a)[0]) as rec:
+            real_a = shard_batch(self.mesh, np.asarray(real_a))
+            real_b = shard_batch(self.mesh, np.asarray(real_b))
+            self.gab, self.gba, g_metrics, fake_a, fake_b = self._g_step(
+                self.gab, self.gba, self.da, self.db, real_a, real_b
+            )
+            # host boundary: replay-buffer query between the two jitted steps
+            fake_a = shard_batch(self.mesh, self.pool_a.query(np.asarray(fake_a)))
+            fake_b = shard_batch(self.mesh, self.pool_b.query(np.asarray(fake_b)))
+            self.da, self.db, d_metrics = self._d_step(
+                self.da, self.db, real_a, real_b, fake_a, fake_b
+            )
+            metrics = {**g_metrics, **d_metrics}
+            rec.fence_on(metrics)
+        return metrics
 
     def translate(self, images_a):
         out, _ = _apply(self.gab, jnp.asarray(images_a), jax.random.PRNGKey(0),

@@ -28,6 +28,7 @@ import optax
 
 from deep_vision_tpu.core.metrics import MetricLogger
 from deep_vision_tpu.core.train_state import TrainState, create_train_state
+from deep_vision_tpu.obs.stepclock import StepClock
 from deep_vision_tpu.parallel.mesh import (
     DATA_AXIS,
     create_mesh,
@@ -74,6 +75,10 @@ class Trainer:
         profile_steps: tuple = (10, 20),
         checkify_errors: bool = False,
         ema_decay: Optional[float] = None,
+        journal=None,  # obs.RunJournal or None
+        registry=None,  # obs.Registry; default process-wide registry
+        telemetry_sample_every: int = 16,
+        lr_schedule=None,  # the optax schedule behind tx, for current_lr
     ):
         self.mesh = mesh if mesh is not None else create_mesh()
         self.model = model  # single source of truth for summaries/export
@@ -83,8 +88,21 @@ class Trainer:
         self.ckpt = checkpoint_manager
         self.plateau = plateau
         self.plateau_metric = plateau_metric
-        self.logger = logger or MetricLogger(name="train")
-        self.eval_logger = eval_logger or MetricLogger(name="val", print_every=0)
+        # telemetry: step-time breakdown + recompile/HBM gauges into the
+        # registry, per-step events into the journal (obs/ subsystem)
+        self.journal = journal
+        self.clock = StepClock(
+            registry=registry, journal=journal, name="train",
+            sample_every=telemetry_sample_every,
+        )
+        self._lr_schedule = lr_schedule
+        self.logger = logger or MetricLogger(
+            name="train", registry=self.clock.registry, journal=journal)
+        # no journal on the val logger: evaluate() writes the typed 'eval'
+        # event itself — a journal-wired val logger would duplicate every
+        # summary as an 'epoch' event
+        self.eval_logger = eval_logger or MetricLogger(
+            name="val", print_every=0, registry=self.clock.registry)
         # profiler hook: the instrumentation the reference never had
         # (SURVEY.md §2.7 'tracing/profilers: NONE'); trace is captured for
         # steps [start, stop) and viewed with tensorboard-plugin-profile/xprof
@@ -92,6 +110,7 @@ class Trainer:
         self.profile_steps = profile_steps
         self._profiling = False
         self._pguard = None  # PreemptionGuard, live only inside fit
+        self._closed = False
 
         state = create_train_state(model, tx, sample_input, rng)
         # device boundary: state lives replicated on the mesh from here on
@@ -227,10 +246,22 @@ class Trainer:
         if not self._profiling and step == start:
             jax.profiler.start_trace(self.profile_dir)
             self._profiling = True
+            if self.journal is not None:
+                self.journal.write("profile", action="start_trace",
+                                   step=step, dir=self.profile_dir)
         elif self._profiling and step >= stop:
-            jax.block_until_ready(self.state.params)
-            jax.profiler.stop_trace()
-            self._profiling = False
+            self._stop_trace(step)
+
+    def _stop_trace(self, step: Optional[int] = None) -> None:
+        """Close an in-flight profiler trace (idempotent)."""
+        if not self._profiling:
+            return
+        jax.block_until_ready(self.state.params)
+        jax.profiler.stop_trace()
+        self._profiling = False
+        if self.journal is not None:
+            self.journal.write("profile", action="stop_trace", step=step,
+                               dir=self.profile_dir)
 
     def train_step(self, batch) -> dict:
         self._profiler_hook()
@@ -252,12 +283,46 @@ class Trainer:
             state = state.replace(params=self.ema.params)
         return self._eval_step(state, batch)
 
-    @property
-    def current_lr(self) -> float:
+    def lr_at(self, step: int) -> float:
+        """LR for a step the caller already fetched (the hot loop passes its
+        opt_step so the fallback costs no extra device round-trip)."""
         try:
             return float(self.state.opt_state.hyperparams["learning_rate"])
         except (AttributeError, KeyError, TypeError):
-            return float("nan")
+            pass
+        # optimizer built without inject_hyperparams: evaluate the schedule
+        # at the given step instead of logging NaN forever
+        if self._lr_schedule is not None:
+            if callable(self._lr_schedule):
+                return float(self._lr_schedule(step))
+            return float(self._lr_schedule)
+        return float("nan")
+
+    @property
+    def current_lr(self) -> float:
+        return self.lr_at(int(self.state.step))
+
+    def close(self) -> None:
+        """Release run-scoped resources: stop an in-flight profiler trace
+        (the start_trace leak when training ends before profile_steps[1]),
+        flush TensorBoard writers, and drain async checkpoint saves.
+        Idempotent; called from train_cli.py and, via journal.add_closer,
+        from the journal's atexit hook on abnormal exits."""
+        if self._closed:
+            return
+        self._closed = True
+        self._stop_trace(step=None)
+        for lg in (self.logger, self.eval_logger):
+            tb = getattr(lg, "tb", None)
+            if tb is not None:
+                try:
+                    tb.flush()
+                except Exception:
+                    pass
+        if self.ckpt is not None:
+            self.ckpt.wait()
+        if self._ema_ckpt is not None:
+            self._ema_ckpt.wait()
 
     def evaluate(self, eval_data: Iterable, epoch: int = 0) -> dict:
         self.eval_logger.start_epoch()
@@ -288,7 +353,10 @@ class Trainer:
             metrics = self.eval_step(batch)
             self.eval_logger.log_step(step, metrics, batch_size=n, epoch=epoch)
             step += 1
-        return self.eval_logger.end_epoch(epoch)
+        summary = self.eval_logger.end_epoch(epoch)
+        if self.journal is not None:
+            self.journal.write("eval", epoch=epoch, summary=summary)
+        return summary
 
     def fit(
         self,
@@ -314,6 +382,7 @@ class Trainer:
             PreemptionGuard(poll_every=preemption_poll_every)
             if handle_preemption else None
         )
+        self._closed = False  # fit may be re-entered after a close()
         import contextlib
 
         ctx = self._pguard if self._pguard is not None else contextlib.nullcontext()
@@ -330,9 +399,7 @@ class Trainer:
                         return self.state
         finally:
             self._pguard = None
-            if self._profiling:  # stop gate never reached (short run)
-                jax.profiler.stop_trace()
-                self._profiling = False
+            self._stop_trace()  # stop gate never reached (short run)
             if self.ckpt is not None:
                 self.ckpt.wait()
             if self._ema_ckpt is not None:
@@ -356,6 +423,9 @@ class Trainer:
                 int(self.state.step), dict(self.ema.params),
                 host_state=self.ema.state_dict(),
             )
+        if self.journal is not None:
+            self.journal.write("checkpoint", step=int(self.state.step),
+                               epoch=epoch, saved=bool(saved))
         return bool(saved)
 
     def _preempt_save(self, epoch: int) -> None:
@@ -382,13 +452,25 @@ class Trainer:
     def _run_epoch(self, train_data_fn, epoch):
         """One epoch of steps; returns ("preempted"|None, logger summary)."""
         self.logger.start_epoch()
-        for batch in train_data_fn():
+        for batch in self.clock.iter_data(train_data_fn()):
             n = np.shape(batch[self.input_key])[0]
-            metrics = self.train_step(batch)
+            with self.clock.step(batch_size=n, auto_commit=False) as rec:
+                metrics = self.train_step(batch)
+                rec.fence_on(metrics)
+            # these fetches block on the in-flight state — outside the
+            # with-block so dispatch_ms stays enqueue-only (the starvation
+            # signal compares data_wait against it); commit() folds their
+            # cost into step_time_ms
             opt_step = int(self.state.step)
+            lr = self.lr_at(opt_step)
+            rec.commit(step=opt_step,
+                       metrics={"loss": metrics["loss"], "lr": lr}
+                       if "loss" in metrics else {"lr": lr})
+            # (train_learning_rate gauge: MetricLogger's NaN-guarded write)
             self.logger.log_step(
                 opt_step, metrics, batch_size=n, epoch=epoch,
-                lr=self.current_lr,
+                lr=lr, data_wait_ms=rec.data_wait_ms,
+                examples_per_sec=rec.examples_per_sec,
             )
             # poll keyed to the optimizer step — globally consistent across
             # hosts, immune to unequal agreed() call counts elsewhere
@@ -410,9 +492,10 @@ class Trainer:
             # async checkpoint and close any open profiler trace first
             if self.ckpt is not None:
                 self.ckpt.wait()
-            if self._profiling:
-                jax.profiler.stop_trace()
-                self._profiling = False
+            self._stop_trace()
+            if self.journal is not None:
+                self.journal.write("note", note=f"diverged at epoch {epoch}: "
+                                                f"mean loss {loss_avg}")
             raise FloatingPointError(
                 f"training diverged: epoch {epoch} mean loss is "
                 f"{loss_avg} (re-run with train.py --debug-nans to "

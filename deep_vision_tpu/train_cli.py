@@ -165,8 +165,9 @@ def build_dataloaders(cfg: ExperimentConfig, data_dir: str, fake: bool,
         )
         tf_ = Compose([T.ToFloat(), T.Normalize(mean=[0.1307], std=[0.3081])])
         train = DataLoader(train_ds, cfg.batch_size, tf_, shuffle=True,
-                           num_workers=num_workers)
-        evl = DataLoader(eval_ds, cfg.batch_size, tf_, num_workers=num_workers)
+                           num_workers=num_workers, name="train")
+        evl = DataLoader(eval_ds, cfg.batch_size, tf_, num_workers=num_workers,
+                         name="val")
         return (lambda: train), (lambda: evl)
 
     if kind == "imagenet":
@@ -211,15 +212,17 @@ def build_dataloaders(cfg: ExperimentConfig, data_dir: str, fake: bool,
             )
             train = DataLoader(train_ds, cfg.batch_size, train_tf, shuffle=True,
                                shuffle_buffer=10000, num_workers=num_workers,
-                               num_procs=num_procs)
+                               num_procs=num_procs, name="train")
         else:
             train_ds = ImageFolderDataset(os.path.join(data_dir, "train_flatten"))
             eval_ds = ImageFolderDataset(os.path.join(data_dir, "val_flatten"))
             # forwarding num_procs surfaces the folder dataset's lack of
             # .split as a clear TypeError instead of silently ignoring it
             train = DataLoader(train_ds, cfg.batch_size, train_tf, shuffle=True,
-                               num_workers=num_workers, num_procs=num_procs)
-        evl = DataLoader(eval_ds, cfg.batch_size, eval_tf, num_workers=num_workers)
+                               num_workers=num_workers, num_procs=num_procs,
+                               name="train")
+        evl = DataLoader(eval_ds, cfg.batch_size, eval_tf, num_workers=num_workers,
+                         name="val")
         return (lambda: train), (lambda: evl)
 
     if kind == "records":
@@ -263,9 +266,11 @@ def build_dataloaders(cfg: ExperimentConfig, data_dir: str, fake: bool,
         )
         train = DataLoader(train_ds, cfg.batch_size, Compose(train_chain),
                            shuffle=True, num_workers=num_workers,
-                           num_procs=num_procs, drop_remainder=True)
+                           num_procs=num_procs, drop_remainder=True,
+                           name="train")
         evl = DataLoader(eval_ds, cfg.batch_size, Compose(eval_chain),
-                         num_workers=num_workers, drop_remainder=True)
+                         num_workers=num_workers, drop_remainder=True,
+                         name="val")
         return (lambda: train), (lambda: evl)
 
     raise ValueError(f"unknown dataset kind {kind!r}")
@@ -304,7 +309,9 @@ def build_trainer(cfg: ExperimentConfig, train_fn, ckpt_dir: Optional[str],
                   tb_dir: Optional[str] = None,
                   profile_dir: Optional[str] = None,
                   checkify_errors: bool = False,
-                  ema_decay: Optional[float] = None):
+                  ema_decay: Optional[float] = None,
+                  journal=None,
+                  telemetry_sample_every: int = 16):
     import functools
 
     import jax.numpy as jnp
@@ -355,23 +362,34 @@ def build_trainer(cfg: ExperimentConfig, train_fn, ckpt_dir: Optional[str],
     plateau = ReduceLROnPlateau(**cfg.plateau) if cfg.plateau else None
     ckpt = CheckpointManager(ckpt_dir) if ckpt_dir else None
     sample = jnp.ones((2, *model_input_shape(cfg)), jnp.float32)
-    logger = eval_logger = None
+    from deep_vision_tpu.core.metrics import MetricLogger
+    from deep_vision_tpu.obs.registry import get_registry
+
+    tb = None
     if tb_dir:
-        from deep_vision_tpu.core.metrics import MetricLogger
         from deep_vision_tpu.core.tensorboard import SummaryWriter
 
         tb = SummaryWriter(tb_dir)
-        logger = MetricLogger(tb_writer=tb, name="train")
-        eval_logger = MetricLogger(tb_writer=tb, name="val", print_every=0)
+    # loggers always carry the registry (and the train logger the journal):
+    # stdout/TensorBoard/Prometheus/JSONL all fan out from one log call.
+    # The val logger stays journal-free — Trainer.evaluate writes the typed
+    # 'eval' event, a journal-wired val logger would duplicate it.
+    logger = MetricLogger(tb_writer=tb, name="train",
+                          registry=get_registry(), journal=journal)
+    eval_logger = MetricLogger(tb_writer=tb, name="val", print_every=0,
+                               registry=get_registry())
     return Trainer(
         model, tx, loss_fn, sample, plateau=plateau,
         plateau_metric=plateau_metric, checkpoint_manager=ckpt,
         logger=logger, eval_logger=eval_logger, profile_dir=profile_dir,
         checkify_errors=checkify_errors, ema_decay=ema_decay,
+        journal=journal, lr_schedule=lr,
+        telemetry_sample_every=telemetry_sample_every,
     )
 
 
-def build_gan_trainer(cfg: ExperimentConfig):
+def build_gan_trainer(cfg: ExperimentConfig, journal=None,
+                      telemetry_sample_every: int = 32):
     from deep_vision_tpu.models import get_model
     from deep_vision_tpu.train import build_optimizer
     from deep_vision_tpu.train.gan import CycleGanTrainer, DcganTrainer
@@ -386,12 +404,16 @@ def build_gan_trainer(cfg: ExperimentConfig):
             build_optimizer(name, lr, **opt_kw),
             build_optimizer(name, lr, **opt_kw),
             image_shape=cfg.input_shape,
+            journal=journal,
+            telemetry_sample_every=telemetry_sample_every,
         )
     tx_fn = lambda: build_optimizer(name, lr, **dict(opt_kw))
     return CycleGanTrainer(
         get_model("cyclegan_generator"), get_model("cyclegan_generator"),
         get_model("cyclegan_discriminator"), get_model("cyclegan_discriminator"),
         tx_fn, tx_fn, image_shape=cfg.input_shape,
+        journal=journal,
+        telemetry_sample_every=telemetry_sample_every,
     )
 
 
@@ -477,6 +499,30 @@ def _maybe_upload(args, ckpt_dir: str) -> None:
     print(f"uploaded checkpoints to {uri}")
 
 
+def _make_journal(args, cfg: ExperimentConfig):
+    if not args.journal:
+        return None
+    import dataclasses
+
+    from deep_vision_tpu.obs import RunJournal
+
+    journal = RunJournal(args.journal, kind="train")
+    journal.manifest(config=dataclasses.asdict(cfg))
+    return journal
+
+
+def _finish_obs(args, journal, status: str = "clean_exit") -> None:
+    """Clean-run epilogue: Prometheus export + journal exit marker.
+    (Abnormal exits are covered by the journal's atexit crash marker.)"""
+    if args.metrics_export:
+        from deep_vision_tpu.obs.registry import get_registry
+
+        if get_registry().write_prometheus(args.metrics_export):
+            print(f"metrics exported to {args.metrics_export}")
+    if journal is not None:
+        journal.close(status)
+
+
 # -- main --------------------------------------------------------------------
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -502,6 +548,16 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--tensorboard-dir", default=None)
     parser.add_argument("--profile-dir", default=None,
                         help="capture a jax.profiler trace of steps 10-20")
+    parser.add_argument("--journal", default=None, metavar="PATH",
+                        help="append typed run events (manifest, per-step "
+                             "timing, eval/checkpoint, exit marker) to this "
+                             "JSONL; render with tools/obs_report.py")
+    parser.add_argument("--metrics-export", default=None, metavar="PATH",
+                        help="write the metrics registry as Prometheus text "
+                             "exposition format at the end of the run")
+    parser.add_argument("--telemetry-sample-every", type=int, default=16,
+                        help="block_until_ready fence cadence for the "
+                             "step-time breakdown (obs/stepclock.py)")
     parser.add_argument("--eval-first", action="store_true",
                         help="epoch-0 sanity validate (ResNet/pytorch/train.py:390)")
     parser.add_argument("--eval-only", action="store_true",
@@ -565,7 +621,12 @@ def main(argv: Optional[List[str]] = None) -> int:
 
         from deep_vision_tpu.core.summary import count_params
 
-        trainer = build_gan_trainer(cfg)
+        journal = _make_journal(args, cfg)
+        trainer = build_gan_trainer(
+            cfg, journal=journal,
+            telemetry_sample_every=args.telemetry_sample_every)
+        if journal is not None:
+            journal.write("note", mesh_shape=dict(trainer.mesh.shape))
         states = (
             {"G": trainer.g_state, "D": trainer.d_state}
             if cfg.task == "dcgan"
@@ -623,7 +684,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                 # poll keyed to the batch index — host-identical (sharded
                 # drop_remainder loaders yield equal counts), so every host
                 # rendezvouses at the same boundary
-                for batch_i, batch in enumerate(train_fn()):
+                for batch_i, batch in enumerate(
+                        trainer.clock.iter_data(train_fn())):
                     if guard.agreed(step=batch_i):
                         interrupted = True
                         break
@@ -640,12 +702,16 @@ def main(argv: Optional[List[str]] = None) -> int:
                     # duplicate the re-run epoch's row, as in Trainer.fit)
                     collected = _jax.device_get(collected)  # one host round-trip
                     keys = sorted(collected[0])
-                    print(f"epoch {epoch}: " + " ".join(
-                        "{}={:.4f}".format(
-                            k, sum(float(m[k]) for m in collected) / len(collected)
-                        )
+                    summary = {
+                        k: sum(float(m[k]) for m in collected) / len(collected)
                         for k in keys
+                    }
+                    print(f"epoch {epoch}: " + " ".join(
+                        f"{k}={v:.4f}" for k, v in summary.items()
                     ))
+                    if journal is not None:
+                        journal.write("epoch", name="gan", epoch=epoch,
+                                      summary=summary)
                 if guard.agreed(force=True):
                     # interrupted: mid-epoch states saved under the global
                     # optimizer step, marked so resume re-runs this epoch; a
@@ -661,14 +727,23 @@ def main(argv: Optional[List[str]] = None) -> int:
                     trainer.save(gan_ckpt, epoch)
         gan_ckpt.wait()
         _maybe_upload(args, ckpt_dir)
+        _finish_obs(args, journal)
         return 0
 
     ckpt_dir = args.ckpt_dir or os.path.join("checkpoints", cfg.name)
+    journal = _make_journal(args, cfg)
     trainer = build_trainer(cfg, train_fn, ckpt_dir,
                             tb_dir=args.tensorboard_dir,
                             profile_dir=args.profile_dir,
                             checkify_errors=args.checkify,
-                            ema_decay=args.ema_decay)
+                            ema_decay=args.ema_decay,
+                            journal=journal,
+                            telemetry_sample_every=args.telemetry_sample_every)
+    if journal is not None:
+        # an unwinding run (exception/SIGTERM) still stops an in-flight
+        # profiler trace and flushes writers via the atexit crash path
+        journal.add_closer(trainer.close)
+        journal.write("note", mesh_shape=dict(trainer.mesh.shape))
     # param accounting before training, like summary(net, (3,224,224)) at
     # ResNet/pytorch/train.py:350 / model.summary() at YOLO/tensorflow/train.py:297
     from deep_vision_tpu.core.summary import count_params
@@ -692,12 +767,16 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(f"resumed from step {int(trainer.state.step)} -> epoch {start_epoch}")
     if args.eval_only:
         run_eval_only(cfg, trainer, eval_fn)
+        trainer.close()
+        _finish_obs(args, journal)
         return 0
     trainer.fit(
         train_fn, eval_fn, epochs=cfg.epochs, start_epoch=start_epoch,
         eval_first=args.eval_first,
     )
+    trainer.close()
     _maybe_upload(args, ckpt_dir)
+    _finish_obs(args, journal)
     return 0
 
 

@@ -96,7 +96,17 @@ VARIANTS = [
 ]
 
 
-def main(out_path="artifacts/ablate_r04.json", skip_flash=False):
+def main(out_path="artifacts/ablate_r04.json", skip_flash=False,
+         journal_path=None):
+    from deep_vision_tpu.obs import RunJournal
+
+    journal = RunJournal(
+        journal_path or os.path.splitext(out_path)[0] + ".journal.jsonl",
+        kind="bench",
+    )
+    journal.manifest(config={"tool": "bench_ablate", "out": out_path,
+                             "batch_per_chip": BATCH, "window": WINDOW,
+                             "reps": REPS})
     art = {"what": __doc__.split("\n")[0], "batch_per_chip": BATCH,
            "window": WINDOW, "reps": REPS}
     built = {}
@@ -187,20 +197,26 @@ def main(out_path="artifacts/ablate_r04.json", skip_flash=False):
                 row["device_ms_per_step"] / flagship["device_ms_per_step"], 3
             )
     art["resnet50_variants"] = rows
+    for row in rows:
+        journal.bench(row.get("variant", "?"), row)
     if not skip_flash:
         try:
             from tools.bench_models import bench_flash
 
             art["flash_attention"] = bench_flash()
             _log(f"flash: {art['flash_attention']}")
+            journal.bench("flash_attention", art["flash_attention"])
         except Exception as e:
             art.setdefault("errors", []).append(
                 f"flash: {type(e).__name__}: {e}"
             )
             _log(f"flash failed: {e}")
+    for err in art.get("errors", []):
+        journal.write("note", note=err)
     os.makedirs(os.path.dirname(out_path) or ".", exist_ok=True)
     with open(out_path, "w") as f:
         json.dump(art, f, indent=2)
+    journal.close()
     _log(f"wrote {out_path}")
 
 
@@ -209,6 +225,8 @@ if __name__ == "__main__":
 
     p = argparse.ArgumentParser()
     p.add_argument("--out", default="artifacts/ablate_r04.json")
+    p.add_argument("--journal", default=None,
+                   help="bench-journal JSONL (default: <out>.journal.jsonl)")
     p.add_argument("--skip-flash", action="store_true")
     a = p.parse_args()
-    main(a.out, a.skip_flash)
+    main(a.out, a.skip_flash, a.journal)

@@ -162,27 +162,42 @@ def bench_flash(b=4, t=4096, h=8, d=64) -> dict:
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("--out", default="artifacts/models_bench.json")
+    p.add_argument("--journal", default=None,
+                   help="bench-journal JSONL (default: <out>.journal.jsonl); "
+                        "same schema as train_cli --journal, so BENCH_* "
+                        "artifacts machine-diff across PRs via "
+                        "tools/obs_report.py")
     p.add_argument("--skip-yolo", action="store_true")
     p.add_argument("--skip-flash", action="store_true")
     args = p.parse_args(argv)
 
     import jax
 
+    from deep_vision_tpu.obs import RunJournal
+
+    journal_path = args.journal or (
+        os.path.splitext(args.out)[0] + ".journal.jsonl"
+    )
     result = {"device_kind": jax.devices()[0].device_kind}
-    if not args.skip_yolo:
-        result["yolov3"] = bench_yolo()
-        print("yolo:", json.dumps(result["yolov3"]))
-        # per-chip batch optimum moved for ResNet-50 (batch_scaling_r04);
-        # check YOLO's curve one octave up too
-        result["yolov3_b32"] = bench_yolo(batch=32)
-        print("yolo b32:", json.dumps(result["yolov3_b32"]))
-    if not args.skip_flash:
-        result["flash_attention"] = bench_flash()
-        print("flash:", json.dumps(result["flash_attention"]))
+    with RunJournal(journal_path, kind="bench") as journal:
+        journal.manifest(config={"tool": "bench_models", "out": args.out})
+        if not args.skip_yolo:
+            result["yolov3"] = bench_yolo()
+            print("yolo:", json.dumps(result["yolov3"]))
+            journal.bench("yolov3", result["yolov3"])
+            # per-chip batch optimum moved for ResNet-50 (batch_scaling_r04);
+            # check YOLO's curve one octave up too
+            result["yolov3_b32"] = bench_yolo(batch=32)
+            print("yolo b32:", json.dumps(result["yolov3_b32"]))
+            journal.bench("yolov3_b32", result["yolov3_b32"])
+        if not args.skip_flash:
+            result["flash_attention"] = bench_flash()
+            print("flash:", json.dumps(result["flash_attention"]))
+            journal.bench("flash_attention", result["flash_attention"])
     os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
     with open(args.out, "w") as f:
         json.dump(result, f, indent=2)
-    print(f"-> {args.out}")
+    print(f"-> {args.out} (journal: {journal_path})")
     return 0
 
 

@@ -1,0 +1,157 @@
+"""Render a run journal (obs/journal.py JSONL) into a per-run summary table.
+
+    PYTHONPATH=. python tools/obs_report.py runs/resnet50.journal.jsonl [...]
+
+One table row block per run_id found in the files: manifest identity,
+step-time/data-wait/examples-per-sec statistics (mean/p50/p90 from the
+per-step events), recompile and HBM peaks, eval/checkpoint/bench events,
+and the terminal marker (clean exit vs crash vs still-running). This is
+the diff surface for BENCH_* rounds: two journals from different PRs
+summarize into directly comparable tables.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import Dict, List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from deep_vision_tpu.obs.journal import read_journal  # noqa: E402
+
+
+def _percentile(xs: List[float], q: float) -> float:
+    if not xs:
+        return 0.0
+    s = sorted(xs)
+    i = min(len(s) - 1, int(round(q * (len(s) - 1))))
+    return s[i]
+
+
+def _stats(xs: List[float]) -> Optional[dict]:
+    if not xs:
+        return None
+    return {
+        "n": len(xs),
+        "mean": sum(xs) / len(xs),
+        "p50": _percentile(xs, 0.5),
+        "p90": _percentile(xs, 0.9),
+        "max": max(xs),
+    }
+
+
+def summarize_run(events: List[dict]) -> dict:
+    """Collapse one run's events into the report row dict."""
+    out: dict = {"run_id": events[0].get("run_id", "?")}
+    steps = [e for e in events if e.get("event") == "step"]
+    manifest = next((e for e in events if e.get("event") == "run_manifest"), None)
+    if manifest:
+        out["kind"] = manifest.get("kind", "?")
+        out["backend"] = manifest.get("backend", "?")
+        out["devices"] = "%s x%s" % (
+            manifest.get("device_kind", "?"), manifest.get("device_count", "?"))
+        cfg = manifest.get("config") or {}
+        if cfg:
+            out["config"] = "%s (%s)" % (cfg.get("name", "?"), cfg.get("task", "?"))
+        out["jax"] = manifest.get("jax_version", "?")
+    out["steps"] = len(steps)
+    for field in ("step_time_ms", "data_wait_ms", "examples_per_sec", "sync_ms"):
+        st = _stats([float(e[field]) for e in steps if field in e])
+        if st:
+            out[field] = st
+    recompiles = [int(e["recompiles"]) for e in steps if "recompiles" in e]
+    if recompiles:
+        out["recompiles"] = max(recompiles)
+    hbm = [int(e["hbm_bytes"]) for e in steps if "hbm_bytes" in e]
+    if hbm:
+        out["hbm_peak_gb"] = max(hbm) / 1e9
+    out["epochs"] = [e for e in events if e.get("event") == "epoch"]
+    out["evals"] = [e for e in events if e.get("event") == "eval"]
+    out["checkpoints"] = sum(
+        1 for e in events if e.get("event") == "checkpoint" and e.get("saved"))
+    out["benches"] = [e for e in events if e.get("event") == "bench"]
+    terminal = next(
+        (e for e in reversed(events) if e.get("event") in ("exit", "crash")),
+        None)
+    if terminal is None:
+        out["status"] = "RUNNING-OR-KILLED (no terminal event)"
+    elif terminal["event"] == "crash":
+        out["status"] = "CRASHED: " + str(terminal.get("reason", ""))
+    else:
+        out["status"] = terminal.get("status", "clean_exit")
+    first, last = events[0].get("ts"), events[-1].get("ts")
+    if first is not None and last is not None:
+        out["wall_s"] = float(last) - float(first)
+    return out
+
+
+def _fmt_stat(st: dict, unit: str = "") -> str:
+    return (f"mean {st['mean']:.2f}{unit}  p50 {st['p50']:.2f}{unit}  "
+            f"p90 {st['p90']:.2f}{unit}  max {st['max']:.2f}{unit}  "
+            f"(n={st['n']})")
+
+
+def render(summary: dict) -> str:
+    rows = [("run", summary["run_id"]),
+            ("status", summary["status"])]
+    for k in ("kind", "config", "backend", "devices", "jax"):
+        if k in summary:
+            rows.append((k, summary[k]))
+    if "wall_s" in summary:
+        rows.append(("wall clock", f"{summary['wall_s']:.1f} s"))
+    rows.append(("steps", str(summary["steps"])))
+    for field, unit in (("step_time_ms", " ms"), ("data_wait_ms", " ms"),
+                        ("sync_ms", " ms"), ("examples_per_sec", "")):
+        if field in summary:
+            rows.append((field, _fmt_stat(summary[field], unit)))
+    if "recompiles" in summary:
+        rows.append(("recompiles", str(summary["recompiles"])))
+    if "hbm_peak_gb" in summary:
+        rows.append(("hbm peak", f"{summary['hbm_peak_gb']:.2f} GB"))
+    if summary["checkpoints"]:
+        rows.append(("checkpoints", str(summary["checkpoints"])))
+    for e in summary["epochs"]:
+        parts = " ".join(f"{k}={v:.4f}" for k, v in
+                         (e.get("summary") or {}).items()
+                         if isinstance(v, (int, float)))
+        label = f"epoch {e.get('epoch')}"
+        if e.get("name"):
+            label += f" [{e['name']}]"
+        rows.append((label, parts))
+    for e in summary["evals"]:
+        parts = " ".join(f"{k}={v:.4f}" for k, v in
+                         (e.get("summary") or {}).items()
+                         if isinstance(v, (int, float)))
+        rows.append((f"eval e{e.get('epoch')}", parts))
+    for e in summary["benches"]:
+        res = e.get("result") or {}
+        parts = " ".join(f"{k}={v}" for k, v in res.items()
+                         if isinstance(v, (int, float)))
+        rows.append((f"bench {e.get('name')}", parts))
+    width = max(len(k) for k, _ in rows)
+    lines = ["=" * (width + 46)]
+    lines += [f"{k:<{width}}  {v}" for k, v in rows]
+    lines.append("=" * (width + 46))
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("journals", nargs="+", help="journal JSONL path(s)")
+    args = p.parse_args(argv)
+
+    by_run: Dict[str, List[dict]] = {}
+    for path in args.journals:
+        for e in read_journal(path):
+            by_run.setdefault(e.get("run_id", path), []).append(e)
+    if not by_run:
+        print("no events found", file=sys.stderr)
+        return 1
+    for run_id, events in by_run.items():
+        print(render(summarize_run(events)))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
