@@ -35,10 +35,26 @@ test:
 	python -m pytest tests/ -x -q
 
 # the tier-1 gate, verbatim from ROADMAP.md: run before shipping any PR
-# (bash, not sh: the command uses pipefail and PIPESTATUS)
+# (bash, not sh: the command uses pipefail and PIPESTATUS); obs-smoke
+# first — the telemetry artifacts must validate before the tests count
 verify: SHELL := /bin/bash
-verify:
+verify: obs-smoke
 	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
+
+# observability smoke: a tiny CPU train with tracing + health guard on,
+# then validate the journal/trace artifacts against the obs/ schemas
+obs-smoke:
+	rm -rf artifacts/obs_smoke
+	mkdir -p artifacts/obs_smoke
+	JAX_PLATFORMS=cpu python train.py -m lenet5 --fake-data --epochs 1 \
+	  --ckpt-dir artifacts/obs_smoke/ckpt \
+	  --journal artifacts/obs_smoke/journal.jsonl \
+	  --trace artifacts/obs_smoke/trace.json \
+	  --health-policy warn --watchdog-timeout 300
+	python tools/check_journal.py artifacts/obs_smoke/journal.jsonl \
+	  --trace artifacts/obs_smoke/trace.json --require-exit
+	python tools/obs_report.py artifacts/obs_smoke/journal.jsonl \
+	  --trace artifacts/obs_smoke/trace.json
 
 bench:
 	python bench.py
@@ -77,4 +93,4 @@ ps:
 native:
 	$(MAKE) -C native
 
-.PHONY: train resume train-fg test verify bench bench-evidence demo demo-gan demo-real dryrun tb ps native
+.PHONY: train resume train-fg test verify obs-smoke bench bench-evidence demo demo-gan demo-real dryrun tb ps native

@@ -22,6 +22,10 @@ from typing import Callable, Iterable, Iterator, List, Optional, Sequence
 
 import numpy as np
 
+# jax-free like obs/registry: spans are no-ops unless train_cli installed a
+# tracer, and this module stays importable from spawned data workers
+from deep_vision_tpu.obs.trace import now_us, span, trace_event
+
 
 class Compose:
     """Chain of transforms, each `(sample, rng) -> sample`."""
@@ -289,13 +293,25 @@ class DataLoader:
         else:
             samples = self._transformed(epoch_seed)
         buf: List[dict] = []
+        # per-batch producer span via explicit timestamps: one batch's
+        # decode+augment work spans loop iterations, so a with-block can't
+        # bracket it. t0 is when the batch's first sample was requested.
+        t0 = now_us()
         for s in samples:
             buf.append(s)
             if len(buf) == self.batch_size:
-                yield self.collate_fn(buf)
+                with span("data/collate", loader=self.name):
+                    batch = self.collate_fn(buf)
+                trace_event("data/augment_batch", t0, loader=self.name,
+                            batch_size=len(buf))
+                yield batch
                 buf = []
+                t0 = now_us()
         if buf and not self.drop_remainder:
-            yield self.collate_fn(buf)
+            batch = self.collate_fn(buf)
+            trace_event("data/augment_batch", t0, loader=self.name,
+                        batch_size=len(buf))
+            yield batch
 
     def __iter__(self) -> Iterator[dict]:
         """Yield batches, producing up to `prefetch` ahead on a thread."""
@@ -336,9 +352,15 @@ class DataLoader:
         first = True
         while True:
             depth = q.qsize()
+            t0 = now_us()
             item = q.get()
             if item is sentinel:
-                break  # end-of-epoch wait is not starvation
+                # end-of-epoch wait is not starvation — and not fetch
+                # time either: a span here would stamp one giant
+                # producer-drain wait per epoch onto the fetch totals
+                break
+            trace_event("data/fetch", t0, loader=self.name,
+                        prefetch_depth=depth)
             g_depth.set(depth)
             # skip the first get (the producer just started — inevitably
             # empty): counting it would stamp phantom starvation on every

@@ -32,6 +32,7 @@ def _observed(fn: Callable, task: str) -> Callable:
     not enqueue time — predictors feed host-side evaluators/renderers
     that fetch the result immediately anyway."""
     from deep_vision_tpu.obs.registry import get_registry
+    from deep_vision_tpu.obs.trace import span
 
     reg = get_registry()
     hist = reg.histogram("inference_latency_ms",
@@ -41,9 +42,12 @@ def _observed(fn: Callable, task: str) -> Callable:
                         labels={"task": task})
 
     def wrapped(variables, images):
-        t0 = time.perf_counter()
-        out = jax.block_until_ready(fn(variables, images))
-        hist.observe((time.perf_counter() - t0) * 1e3)
+        # per-request span: the same fenced region the histogram times,
+        # so a Perfetto timeline and the latency quantiles agree
+        with span(f"infer/{task}"):
+            t0 = time.perf_counter()
+            out = jax.block_until_ready(fn(variables, images))
+            hist.observe((time.perf_counter() - t0) * 1e3)
         count.inc()
         return out
 

@@ -1,4 +1,5 @@
-"""Unified telemetry: metrics registry, run journal, step-time breakdown.
+"""Unified telemetry: metrics registry, run journal, step-time breakdown,
+span tracing, and the training health monitor.
 
 The observability layer every perf PR reports through (SURVEY.md §2.7
 records the reference's instrumentation as one examples/sec print):
@@ -6,16 +7,35 @@ records the reference's instrumentation as one examples/sec print):
 - `registry`: counters / gauges / log-scale histograms, exported as
   Prometheus text format or JSONL snapshots (`Registry`, `get_registry`).
 - `journal`: append-only JSONL of typed run events — manifest, steps,
-  evals, checkpoints, crash/exit markers (`RunJournal`, `read_journal`).
+  evals, checkpoints, health, crash/exit markers (`RunJournal`,
+  `read_journal`).
 - `stepclock`: host data-wait vs dispatch vs device-compute breakdown
   with periodic `block_until_ready` fences, plus recompile and HBM
   tracking (`StepClock`, `recompile_count`, `hbm_bytes_in_use`).
+- `trace`: Chrome trace-event spans across the data pipeline, trainers,
+  and inference — *where* the time went (`Tracer`, `span`, `set_tracer`).
+- `health`: NaN/Inf guard with warn/skip_step/abort policies, rolling
+  z-score divergence detection, and a hang watchdog that dumps thread
+  stacks — *why* the run died (`HealthMonitor`, `TrainingHealthError`).
 
 All file writers are process-0-only under `jax.process_index()`; metric
 *collection* runs on every host so counters stay meaningful if a
 follower is later asked to dump state.
 """
+from deep_vision_tpu.obs.health import (
+    HealthMonitor,
+    TrainingHealthError,
+    dump_all_stacks,
+)
 from deep_vision_tpu.obs.journal import RunJournal, read_journal
+from deep_vision_tpu.obs.trace import (
+    Tracer,
+    get_tracer,
+    set_tracer,
+    span,
+    trace_event,
+    traced,
+)
 from deep_vision_tpu.obs.registry import (
     Counter,
     Gauge,
@@ -33,13 +53,22 @@ from deep_vision_tpu.obs.stepclock import (
 __all__ = [
     "Counter",
     "Gauge",
+    "HealthMonitor",
     "Histogram",
     "Registry",
     "RunJournal",
     "StepClock",
+    "Tracer",
+    "TrainingHealthError",
+    "dump_all_stacks",
     "get_registry",
+    "get_tracer",
     "hbm_bytes_in_use",
     "is_primary_host",
     "read_journal",
     "recompile_count",
+    "set_tracer",
+    "span",
+    "trace_event",
+    "traced",
 ]

@@ -8,6 +8,8 @@ Event types (full schema in obs/README.md):
   epoch         MetricLogger epoch summaries
   eval          eval-pass summaries
   checkpoint    checkpoint saves/restores
+  health        health monitor findings (obs/health.py: non_finite,
+                loss_spike, divergence, hang with thread stacks)
   profile       profiler trace start/stop
   bench         one benchmark measurement (tools/bench_*.py)
   note          free-form annotation
@@ -27,6 +29,7 @@ import json
 import os
 import platform
 import sys
+import threading
 import time
 from typing import Callable, List, Optional
 
@@ -60,6 +63,9 @@ class RunJournal:
         self._closed = False
         self._closers: List[Callable[[], None]] = []
         self._primary = is_primary_host()
+        # writes come from the train loop AND side threads (the health
+        # watchdog, data prefetch errors): one lock keeps lines whole
+        self._lock = threading.Lock()
         self._f = None
         if self._primary:
             d = os.path.dirname(path)
@@ -91,9 +97,10 @@ class RunJournal:
         self._run_closers()
         self.write("crash", reason="process exited without journal.close()")
         self._closed = True
-        if self._f is not None:
-            self._f.close()
-            self._f = None
+        with self._lock:
+            if self._f is not None:
+                self._f.close()
+                self._f = None
 
     def close(self, status: str = "clean_exit") -> None:
         if self._closed:
@@ -102,9 +109,10 @@ class RunJournal:
         self.write("exit", status=status)
         self._closed = True
         atexit.unregister(self._atexit)
-        if self._f is not None:
-            self._f.close()
-            self._f = None
+        with self._lock:
+            if self._f is not None:
+                self._f.close()
+                self._f = None
 
     def __enter__(self):
         return self
@@ -116,13 +124,14 @@ class RunJournal:
     # -- writers -----------------------------------------------------------
 
     def write(self, event: str, **fields) -> None:
-        if self._f is None:
-            return
         row = {"event": event, "ts": round(time.time(), 3),
                "run_id": self.run_id}
         row.update({k: _jsonable(v) for k, v in fields.items()})
-        self._f.write(json.dumps(row) + "\n")
-        self._f.flush()
+        with self._lock:
+            if self._f is None:
+                return
+            self._f.write(json.dumps(row) + "\n")
+            self._f.flush()
 
     def manifest(self, config: Optional[dict] = None, **extra) -> None:
         """The run's identity card: everything needed to interpret (or

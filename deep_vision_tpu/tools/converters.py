@@ -15,15 +15,19 @@ Parity targets (field names byte-compatible, so shards interop both ways):
 The reference fans out with Ray (`@ray.remote build_single_tfrecord`,
 VOC2007/tfrecords.py:98-107) or threads (ImageNet). Here:
 `multiprocessing.Pool` over shard chunks — same parallelism, stdlib only.
+Spawn context, not fork: converters run in processes that have usually
+imported jax already (the data pipeline's _proc_samples makes the same
+call for the same reason), and forking a multithreaded process is a
+deadlock lottery that CPython now warns about at every fork().
 """
 from __future__ import annotations
 
 import json
+import multiprocessing as mp
 import os
 import re
 import shutil
 import xml.etree.ElementTree as ET
-from multiprocessing import Pool
 from typing import Callable, Dict, Iterable, List, Optional, Sequence, Tuple
 
 from deep_vision_tpu.data.example_codec import encode_example
@@ -86,7 +90,7 @@ def build_shards(
     if num_workers <= 1 or len(jobs) == 1:
         counts = [_write_shard(j) for j in jobs]
     else:
-        with Pool(num_workers) as pool:
+        with mp.get_context("spawn").Pool(num_workers) as pool:
             counts = pool.map(_write_shard, jobs)
     print(f"wrote {sum(counts)} examples to {len(jobs)} shards in {out_dir}")
     return [j[1] for j in jobs]

@@ -22,6 +22,7 @@ import optax
 
 from deep_vision_tpu.core.train_state import TrainState, create_train_state
 from deep_vision_tpu.obs.stepclock import StepClock
+from deep_vision_tpu.obs.trace import span
 from deep_vision_tpu.losses.gan import (
     bce_discriminator_loss,
     bce_generator_loss,
@@ -90,9 +91,13 @@ class DcganTrainer:
                  latent_dim: int = 100, image_shape=(28, 28, 1),
                  mesh=None, rng: Optional[jax.Array] = None,
                  journal=None, registry=None,
-                 telemetry_sample_every: int = 32):
+                 telemetry_sample_every: int = 32, health=None):
         self.mesh = mesh if mesh is not None else create_mesh()
         self.latent_dim = latent_dim
+        # health: the GAN loop keeps metrics on device until epoch end, so
+        # the per-step hook is heartbeat-only; the epoch summary check
+        # (check_summary) runs from the train_cli loop
+        self.health = health
         # per-step journal events carry timing only: the GAN loop keeps
         # metrics as device arrays until epoch end, and the clock's sampled
         # fence is the only sync (obs/stepclock.py)
@@ -142,12 +147,15 @@ class DcganTrainer:
         return g_state, d_state, {"g_loss": g_loss, "d_loss": d_loss}
 
     def train_step(self, real_images) -> dict:
-        with self.clock.step(batch_size=np.shape(real_images)[0]) as rec:
-            real = shard_batch(self.mesh, np.asarray(real_images))
-            self.g_state, self.d_state, metrics = self._step(
-                self.g_state, self.d_state, real
-            )
-            rec.fence_on(metrics)
+        with span("gan/step"):
+            with self.clock.step(batch_size=np.shape(real_images)[0]) as rec:
+                real = shard_batch(self.mesh, np.asarray(real_images))
+                self.g_state, self.d_state, metrics = self._step(
+                    self.g_state, self.d_state, real
+                )
+                rec.fence_on(metrics)
+        if self.health is not None:
+            self.health.beat()
         return metrics
 
     def generate(self, n: int, seed: int = 0):
@@ -164,19 +172,23 @@ class DcganTrainer:
         silently declines the second. `completed_epoch` (default: epoch) is
         what restore() resumes after — the preemption path passes epoch-1
         so the interrupted epoch re-runs. Returns whether orbax saved."""
-        return bool(ckpt.save_tree(
-            int(self.g_state.step),
-            {"g": _state_arrays(self.g_state), "d": _state_arrays(self.d_state)},
-            host_state={"epoch": epoch if completed_epoch is None
-                        else completed_epoch},
-        ))
+        with span("checkpoint/save", epoch=epoch,
+                  step=int(self.g_state.step)):
+            return bool(ckpt.save_tree(
+                int(self.g_state.step),
+                {"g": _state_arrays(self.g_state),
+                 "d": _state_arrays(self.d_state)},
+                host_state={"epoch": epoch if completed_epoch is None
+                            else completed_epoch},
+            ))
 
     def restore(self, ckpt) -> int:
         """Restore-or-initialize; returns the next epoch to run (0 if fresh)."""
         template = {
             "g": _state_arrays(self.g_state), "d": _state_arrays(self.d_state)
         }
-        restored, host = ckpt.restore_tree(template)
+        with span("checkpoint/restore"):
+            restored, host = ckpt.restore_tree(template)
         if restored is None:
             return 0
         self.g_state = _load_state_arrays(self.g_state, restored["g"])
@@ -198,8 +210,9 @@ class CycleGanTrainer:
                  d_tx_fn: Callable, image_shape=(256, 256, 3), mesh=None,
                  pool_size: int = 50, rng: Optional[jax.Array] = None,
                  journal=None, registry=None,
-                 telemetry_sample_every: int = 32):
+                 telemetry_sample_every: int = 32, health=None):
         self.mesh = mesh if mesh is not None else create_mesh()
+        self.health = health
         self.clock = StepClock(registry=registry, journal=journal,
                                name="gan",
                                sample_every=telemetry_sample_every)
@@ -219,20 +232,23 @@ class CycleGanTrainer:
     # checkpoint/resume: G_ab/G_ba/D_a/D_b + epoch, saved every N epochs
     # (CycleGAN/tensorflow/train.py:133-148, 329-333)
     def save(self, ckpt, epoch: int, completed_epoch: int | None = None) -> bool:
-        return bool(ckpt.save_tree(
-            int(self.gab.step),
-            {"gab": _state_arrays(self.gab), "gba": _state_arrays(self.gba),
-             "da": _state_arrays(self.da), "db": _state_arrays(self.db)},
-            host_state={"epoch": epoch if completed_epoch is None
-                        else completed_epoch},
-        ))
+        with span("checkpoint/save", epoch=epoch, step=int(self.gab.step)):
+            return bool(ckpt.save_tree(
+                int(self.gab.step),
+                {"gab": _state_arrays(self.gab),
+                 "gba": _state_arrays(self.gba),
+                 "da": _state_arrays(self.da), "db": _state_arrays(self.db)},
+                host_state={"epoch": epoch if completed_epoch is None
+                            else completed_epoch},
+            ))
 
     def restore(self, ckpt) -> int:
         template = {
             "gab": _state_arrays(self.gab), "gba": _state_arrays(self.gba),
             "da": _state_arrays(self.da), "db": _state_arrays(self.db),
         }
-        restored, host = ckpt.restore_tree(template)
+        with span("checkpoint/restore"):
+            restored, host = ckpt.restore_tree(template)
         if restored is None:
             return 0
         self.gab = _load_state_arrays(self.gab, restored["gab"])
@@ -313,20 +329,32 @@ class CycleGanTrainer:
         return da, db, {"d_loss": d_loss}
 
     def train_step(self, real_a, real_b) -> dict:
-        with self.clock.step(batch_size=np.shape(real_a)[0]) as rec:
-            real_a = shard_batch(self.mesh, np.asarray(real_a))
-            real_b = shard_batch(self.mesh, np.asarray(real_b))
-            self.gab, self.gba, g_metrics, fake_a, fake_b = self._g_step(
-                self.gab, self.gba, self.da, self.db, real_a, real_b
-            )
-            # host boundary: replay-buffer query between the two jitted steps
-            fake_a = shard_batch(self.mesh, self.pool_a.query(np.asarray(fake_a)))
-            fake_b = shard_batch(self.mesh, self.pool_b.query(np.asarray(fake_b)))
-            self.da, self.db, d_metrics = self._d_step(
-                self.da, self.db, real_a, real_b, fake_a, fake_b
-            )
-            metrics = {**g_metrics, **d_metrics}
-            rec.fence_on(metrics)
+        with span("gan/step"):
+            with self.clock.step(batch_size=np.shape(real_a)[0]) as rec:
+                real_a = shard_batch(self.mesh, np.asarray(real_a))
+                real_b = shard_batch(self.mesh, np.asarray(real_b))
+                with span("gan/g_step"):
+                    self.gab, self.gba, g_metrics, fake_a, fake_b = \
+                        self._g_step(
+                            self.gab, self.gba, self.da, self.db,
+                            real_a, real_b
+                        )
+                # host boundary: replay-buffer query between the two
+                # jitted steps (the np.asarray fetch is the sync point,
+                # which is why it gets its own span)
+                with span("gan/pool"):
+                    fake_a = shard_batch(
+                        self.mesh, self.pool_a.query(np.asarray(fake_a)))
+                    fake_b = shard_batch(
+                        self.mesh, self.pool_b.query(np.asarray(fake_b)))
+                with span("gan/d_step"):
+                    self.da, self.db, d_metrics = self._d_step(
+                        self.da, self.db, real_a, real_b, fake_a, fake_b
+                    )
+                metrics = {**g_metrics, **d_metrics}
+                rec.fence_on(metrics)
+        if self.health is not None:
+            self.health.beat()
         return metrics
 
     def translate(self, images_a):

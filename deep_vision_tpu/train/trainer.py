@@ -29,6 +29,7 @@ import optax
 from deep_vision_tpu.core.metrics import MetricLogger
 from deep_vision_tpu.core.train_state import TrainState, create_train_state
 from deep_vision_tpu.obs.stepclock import StepClock
+from deep_vision_tpu.obs.trace import span
 from deep_vision_tpu.parallel.mesh import (
     DATA_AXIS,
     create_mesh,
@@ -79,6 +80,7 @@ class Trainer:
         registry=None,  # obs.Registry; default process-wide registry
         telemetry_sample_every: int = 16,
         lr_schedule=None,  # the optax schedule behind tx, for current_lr
+        health=None,  # obs.HealthMonitor or None
     ):
         self.mesh = mesh if mesh is not None else create_mesh()
         self.model = model  # single source of truth for summaries/export
@@ -91,6 +93,12 @@ class Trainer:
         # telemetry: step-time breakdown + recompile/HBM gauges into the
         # registry, per-step events into the journal (obs/ subsystem)
         self.journal = journal
+        self.health = health
+        # skip_step policy: the jitted step itself discards a poisoned
+        # update via a finiteness select — host-side "skip" would need the
+        # pre-step state, which donate_argnums already gave back to XLA
+        self._skip_nonfinite = bool(health is not None
+                                    and health.skip_nonfinite)
         self.clock = StepClock(
             registry=registry, journal=journal, name="train",
             sample_every=telemetry_sample_every,
@@ -206,6 +214,19 @@ class Trainer:
         if state.batch_stats:
             new_state = new_state.replace(batch_stats=new_bs)
         metrics["grad_norm"] = optax.global_norm(grads)
+        if self._skip_nonfinite:
+            # health skip_step policy: one poisoned batch must not destroy
+            # the weights — keep the whole pre-step state (params, opt
+            # moments, step counter, batch_stats) when loss or grads went
+            # non-finite. A select inside jit, so no extra host sync and
+            # no reliance on the donated input buffers.
+            ok = jnp.isfinite(metrics["grad_norm"])
+            if "loss" in metrics:
+                ok = ok & jnp.isfinite(metrics["loss"])
+            new_state = jax.tree_util.tree_map(
+                lambda n, o: jnp.where(ok, n, o), new_state, state
+            )
+            metrics["skipped"] = 1.0 - ok.astype(jnp.float32)
         return new_state, metrics
 
     def _eval_step_impl(self, state: TrainState, batch):
@@ -311,6 +332,8 @@ class Trainer:
         if self._closed:
             return
         self._closed = True
+        if self.health is not None:
+            self.health.stop()  # disarm the watchdog before teardown
         self._stop_trace(step=None)
         for lg in (self.logger, self.eval_logger):
             tb = getattr(lg, "tb", None)
@@ -325,9 +348,17 @@ class Trainer:
             self._ema_ckpt.wait()
 
     def evaluate(self, eval_data: Iterable, epoch: int = 0) -> dict:
+        with span("eval", epoch=epoch):
+            return self._evaluate(eval_data, epoch)
+
+    def _evaluate(self, eval_data: Iterable, epoch: int = 0) -> dict:
         self.eval_logger.start_epoch()
         step = 0
         for batch in eval_data:
+            # eval batches are forward progress too: a long val pass must
+            # not trip the hang watchdog
+            if self.health is not None:
+                self.health.beat()
             # consensus (not the local flag): in multi-host runs every host
             # must leave the eval collectives at the same batch boundary.
             # Keyed on the eval-batch index, which is host-identical because
@@ -383,6 +414,8 @@ class Trainer:
             if handle_preemption else None
         )
         self._closed = False  # fit may be re-entered after a close()
+        if self.health is not None:
+            self.health.start_watchdog()  # no-op without a timeout
         import contextlib
 
         ctx = self._pguard if self._pguard is not None else contextlib.nullcontext()
@@ -391,7 +424,9 @@ class Trainer:
                 if eval_first and eval_data_fn is not None:
                     self.evaluate(eval_data_fn(), epoch=start_epoch)
                 for epoch in range(start_epoch, epochs):
-                    status, summary = self._run_epoch(train_data_fn, epoch)
+                    with span("train/epoch", epoch=epoch):
+                        status, summary = self._run_epoch(train_data_fn,
+                                                          epoch)
                     if status == "preempted":
                         return self.state
                     if self._post_epoch(summary, eval_data_fn, epoch,
@@ -407,22 +442,24 @@ class Trainer:
         return self.state
 
     def _save_checkpoint(self, epoch: int, val_summary=None) -> bool:
-        host_state = {
-            "epoch": epoch,
-            "train_logger": self.logger.state_dict(),
-            "val_logger": self.eval_logger.state_dict(),
-        }
-        if self.plateau is not None:
-            host_state["plateau"] = self.plateau.state_dict()
-        saved = self.ckpt.save(
-            int(self.state.step), self.state, host_state=host_state,
-            metrics=val_summary,
-        )
-        if self._ema_ckpt is not None:
-            self._ema_ckpt.save_tree(
-                int(self.state.step), dict(self.ema.params),
-                host_state=self.ema.state_dict(),
+        with span("checkpoint/save", epoch=epoch,
+                  step=int(self.state.step)):
+            host_state = {
+                "epoch": epoch,
+                "train_logger": self.logger.state_dict(),
+                "val_logger": self.eval_logger.state_dict(),
+            }
+            if self.plateau is not None:
+                host_state["plateau"] = self.plateau.state_dict()
+            saved = self.ckpt.save(
+                int(self.state.step), self.state, host_state=host_state,
+                metrics=val_summary,
             )
+            if self._ema_ckpt is not None:
+                self._ema_ckpt.save_tree(
+                    int(self.state.step), dict(self.ema.params),
+                    host_state=self.ema.state_dict(),
+                )
         if self.journal is not None:
             self.journal.write("checkpoint", step=int(self.state.step),
                                epoch=epoch, saved=bool(saved))
@@ -454,24 +491,45 @@ class Trainer:
         self.logger.start_epoch()
         for batch in self.clock.iter_data(train_data_fn()):
             n = np.shape(batch[self.input_key])[0]
-            with self.clock.step(batch_size=n, auto_commit=False) as rec:
-                metrics = self.train_step(batch)
-                rec.fence_on(metrics)
-            # these fetches block on the in-flight state — outside the
-            # with-block so dispatch_ms stays enqueue-only (the starvation
-            # signal compares data_wait against it); commit() folds their
-            # cost into step_time_ms
-            opt_step = int(self.state.step)
-            lr = self.lr_at(opt_step)
-            rec.commit(step=opt_step,
-                       metrics={"loss": metrics["loss"], "lr": lr}
-                       if "loss" in metrics else {"lr": lr})
+            with span("train/step", epoch=epoch) as sp:
+                with self.clock.step(batch_size=n, auto_commit=False) as rec:
+                    metrics = self.train_step(batch)
+                    rec.fence_on(metrics)
+                # these fetches block on the in-flight state — outside the
+                # with-block so dispatch_ms stays enqueue-only (the
+                # starvation signal compares data_wait against it);
+                # commit() folds their cost into step_time_ms
+                opt_step = int(self.state.step)
+                lr = self.lr_at(opt_step)
+                sp.set(step=opt_step)
+                rec.commit(step=opt_step,
+                           metrics={"loss": metrics["loss"], "lr": lr}
+                           if "loss" in metrics else {"lr": lr})
+            # one host fetch for loggers + health (log_step floats every
+            # metric anyway, so this adds no extra device sync)
+            metrics_f = {k: float(v) for k, v in metrics.items()}
+            loss_f = metrics_f.get("loss")
+            grad_norm_f = metrics_f.get("grad_norm")
+            skipped = (self._skip_nonfinite
+                       and metrics_f.get("skipped", 0.0) > 0)
+            if skipped:
+                # the discarded update's loss/grads are garbage: keep them
+                # out of the epoch means and TB series — the health event
+                # and skipped counter (below) carry the record instead
+                metrics_f = {k: v for k, v in metrics_f.items()
+                             if v == v and abs(v) != float("inf")}
             # (train_learning_rate gauge: MetricLogger's NaN-guarded write)
             self.logger.log_step(
-                opt_step, metrics, batch_size=n, epoch=epoch,
+                opt_step, metrics_f, batch_size=n, epoch=epoch,
                 lr=lr, data_wait_ms=rec.data_wait_ms,
                 examples_per_sec=rec.examples_per_sec,
             )
+            # health guard AFTER the step/log writes: an abort's journal
+            # then reads step -> health(non_finite) -> crash, in order
+            if self.health is not None:
+                self.health.check_step(opt_step, loss=loss_f,
+                                       grad_norm=grad_norm_f,
+                                       skipped=skipped)
             # poll keyed to the optimizer step — globally consistent across
             # hosts, immune to unequal agreed() call counts elsewhere
             if self._pguard is not None and self._pguard.agreed(step=opt_step):
@@ -488,19 +546,36 @@ class Trainer:
         # Checked at epoch granularity so the hot loop stays sync-free.
         loss_avg = summary.get("loss")
         if loss_avg is not None and not np.isfinite(loss_avg):
-            # leave postmortem artifacts intact: flush the in-flight
-            # async checkpoint and close any open profiler trace first
-            if self.ckpt is not None:
-                self.ckpt.wait()
-            self._stop_trace()
-            if self.journal is not None:
-                self.journal.write("note", note=f"diverged at epoch {epoch}: "
-                                                f"mean loss {loss_avg}")
-            raise FloatingPointError(
-                f"training diverged: epoch {epoch} mean loss is "
-                f"{loss_avg} (re-run with train.py --debug-nans to "
-                "locate the first non-finite op)"
-            )
+            relax = (self.health is not None
+                     and getattr(self.health, "policy_explicit", True)
+                     and not self.health.skip_nonfinite
+                     and self.health.policy != "abort")
+            if relax:
+                # explicit warn policy: the health layer already journaled
+                # every non-finite step; a poisoned epoch mean is reported,
+                # not fatal — 'warn continues' is the policy's contract. A
+                # defaulted policy (watchdog-only monitor) keeps the
+                # pre-existing fatal behavior below.
+                self.health.check_summary(epoch, {"loss": loss_avg})
+            else:
+                # leave postmortem artifacts intact: flush the in-flight
+                # async checkpoint and close any open profiler trace first
+                if self.ckpt is not None:
+                    self.ckpt.wait()
+                self._stop_trace()
+                if self.journal is not None:
+                    self.journal.write(
+                        "note", note=f"diverged at epoch {epoch}: "
+                                     f"mean loss {loss_avg}")
+                if self.health is not None:
+                    # abort policy (or a skip_step run whose mean still
+                    # went non-finite): typed health event, then raise
+                    self.health.check_summary(epoch, {"loss": loss_avg})
+                raise FloatingPointError(
+                    f"training diverged: epoch {epoch} mean loss is "
+                    f"{loss_avg} (re-run with train.py --debug-nans to "
+                    "locate the first non-finite op)"
+                )
 
         # honor a SIGTERM that landed after the last step (or during eval,
         # which bails early): the epoch's training IS complete, save as such
@@ -530,7 +605,9 @@ class Trainer:
     def resume(self, step: Optional[int] = None) -> int:
         """Restore state + host loggers/plateau; returns next epoch to run."""
         assert self.ckpt is not None, "no CheckpointManager configured"
-        self.state, host_state = self.ckpt.restore(self.state, step)
+        with span("checkpoint/restore", step=step if step is not None
+                  else -1):
+            self.state, host_state = self.ckpt.restore(self.state, step)
         self.state = jax.device_put(self.state, replicated(self.mesh))
         if self.ema is not None:
             restored_ema, ema_host = (None, None)

@@ -311,7 +311,8 @@ def build_trainer(cfg: ExperimentConfig, train_fn, ckpt_dir: Optional[str],
                   checkify_errors: bool = False,
                   ema_decay: Optional[float] = None,
                   journal=None,
-                  telemetry_sample_every: int = 16):
+                  telemetry_sample_every: int = 16,
+                  health=None):
     import functools
 
     import jax.numpy as jnp
@@ -385,11 +386,12 @@ def build_trainer(cfg: ExperimentConfig, train_fn, ckpt_dir: Optional[str],
         checkify_errors=checkify_errors, ema_decay=ema_decay,
         journal=journal, lr_schedule=lr,
         telemetry_sample_every=telemetry_sample_every,
+        health=health,
     )
 
 
 def build_gan_trainer(cfg: ExperimentConfig, journal=None,
-                      telemetry_sample_every: int = 32):
+                      telemetry_sample_every: int = 32, health=None):
     from deep_vision_tpu.models import get_model
     from deep_vision_tpu.train import build_optimizer
     from deep_vision_tpu.train.gan import CycleGanTrainer, DcganTrainer
@@ -406,6 +408,7 @@ def build_gan_trainer(cfg: ExperimentConfig, journal=None,
             image_shape=cfg.input_shape,
             journal=journal,
             telemetry_sample_every=telemetry_sample_every,
+            health=health,
         )
     tx_fn = lambda: build_optimizer(name, lr, **dict(opt_kw))
     return CycleGanTrainer(
@@ -414,6 +417,7 @@ def build_gan_trainer(cfg: ExperimentConfig, journal=None,
         tx_fn, tx_fn, image_shape=cfg.input_shape,
         journal=journal,
         telemetry_sample_every=telemetry_sample_every,
+        health=health,
     )
 
 
@@ -511,9 +515,59 @@ def _make_journal(args, cfg: ExperimentConfig):
     return journal
 
 
-def _finish_obs(args, journal, status: str = "clean_exit") -> None:
-    """Clean-run epilogue: Prometheus export + journal exit marker.
-    (Abnormal exits are covered by the journal's atexit crash marker.)"""
+def _make_tracer(args, journal):
+    """--trace: install the process-wide span tracer; the journal notes
+    the trace path so obs_report readers find the matching timeline."""
+    if not args.trace:
+        return None
+    from deep_vision_tpu.obs import Tracer, set_tracer
+
+    tracer = Tracer(args.trace,
+                    run_id=journal.run_id if journal is not None else None)
+    set_tracer(tracer)
+    if journal is not None:
+        journal.write("note", trace_path=args.trace)
+    return tracer
+
+
+def _make_health(args, journal):
+    """--health-policy / --watchdog-timeout: the run's health monitor.
+    Either flag alone activates it (a watchdog with the default `warn`
+    NaN policy, or a NaN policy with no hang deadline)."""
+    if not args.health_policy and not args.watchdog_timeout:
+        return None
+    from deep_vision_tpu.obs import HealthMonitor
+
+    health = HealthMonitor(
+        policy=args.health_policy or "warn",
+        journal=journal,
+        watchdog_timeout=args.watchdog_timeout,
+        # --watchdog-timeout alone: the 'warn' NaN policy is a default the
+        # user never chose, so it must not soften the trainer's
+        # pre-existing fatal divergence check
+        policy_explicit=args.health_policy is not None,
+    )
+    if journal is not None:
+        # stop() is idempotent: the closer covers abnormal unwinds, the
+        # explicit stop in _finish_obs covers clean exits
+        journal.add_closer(health.stop)
+    return health
+
+
+def _finish_obs(args, journal, status: str = "clean_exit",
+                tracer=None, health=None) -> None:
+    """Clean-run epilogue: Prometheus export + trace flush + journal exit
+    marker. (Abnormal exits are covered by the journal's atexit crash
+    marker, the tracer's atexit flush, and the health closer.)"""
+    if health is not None:
+        health.stop()
+    if tracer is not None:
+        from deep_vision_tpu.obs import set_tracer
+
+        tracer.close()
+        set_tracer(None)
+        print(f"trace written to {tracer.path} "
+              "(load in Perfetto / chrome://tracing)")
     if args.metrics_export:
         from deep_vision_tpu.obs.registry import get_registry
 
@@ -558,6 +612,26 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--telemetry-sample-every", type=int, default=16,
                         help="block_until_ready fence cadence for the "
                              "step-time breakdown (obs/stepclock.py)")
+    parser.add_argument("--trace", default=None, metavar="PATH",
+                        help="write Chrome trace-event JSON spans (data "
+                             "fetch/augment, train/eval steps, checkpoint "
+                             "I/O) to this path; load in Perfetto or "
+                             "chrome://tracing (obs/trace.py)")
+    parser.add_argument("--health-policy", default=None,
+                        choices=["warn", "skip_step", "abort"],
+                        help="NaN/Inf + divergence guard on per-step loss "
+                             "and grad norm: 'warn' logs and continues, "
+                             "'skip_step' discards the poisoned update "
+                             "inside the jitted step, 'abort' writes a "
+                             "typed health journal event and raises "
+                             "(obs/health.py)")
+    parser.add_argument("--watchdog-timeout", type=float, default=None,
+                        metavar="SECONDS",
+                        help="hang detector: if no step completes within "
+                             "this deadline, dump every thread's stack to "
+                             "stderr and a 'health' journal event (a hung "
+                             "multi-host collective stays diagnosable "
+                             "post-mortem)")
     parser.add_argument("--eval-first", action="store_true",
                         help="epoch-0 sanity validate (ResNet/pytorch/train.py:390)")
     parser.add_argument("--eval-only", action="store_true",
@@ -622,9 +696,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         from deep_vision_tpu.core.summary import count_params
 
         journal = _make_journal(args, cfg)
+        tracer = _make_tracer(args, journal)
+        health = _make_health(args, journal)
         trainer = build_gan_trainer(
             cfg, journal=journal,
-            telemetry_sample_every=args.telemetry_sample_every)
+            telemetry_sample_every=args.telemetry_sample_every,
+            health=health)
         if journal is not None:
             journal.write("note", mesh_shape=dict(trainer.mesh.shape))
         states = (
@@ -675,6 +752,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         # consensus at a deterministic cadence)
         from deep_vision_tpu.parallel.multihost import PreemptionGuard
 
+        if health is not None:
+            health.start_watchdog()  # no-op without --watchdog-timeout
         with PreemptionGuard() as guard:
             for epoch in range(start_epoch, cfg.epochs):
                 # keep per-step metrics as device arrays; float() only at epoch
@@ -712,6 +791,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                     if journal is not None:
                         journal.write("epoch", name="gan", epoch=epoch,
                                       summary=summary)
+                    # epoch-granularity NaN guard: the GAN loop keeps
+                    # per-step metrics on device, so the summary is the
+                    # first host-visible place divergence can be caught
+                    if health is not None:
+                        health.check_summary(epoch, summary)
                 if guard.agreed(force=True):
                     # interrupted: mid-epoch states saved under the global
                     # optimizer step, marked so resume re-runs this epoch; a
@@ -727,18 +811,21 @@ def main(argv: Optional[List[str]] = None) -> int:
                     trainer.save(gan_ckpt, epoch)
         gan_ckpt.wait()
         _maybe_upload(args, ckpt_dir)
-        _finish_obs(args, journal)
+        _finish_obs(args, journal, tracer=tracer, health=health)
         return 0
 
     ckpt_dir = args.ckpt_dir or os.path.join("checkpoints", cfg.name)
     journal = _make_journal(args, cfg)
+    tracer = _make_tracer(args, journal)
+    health = _make_health(args, journal)
     trainer = build_trainer(cfg, train_fn, ckpt_dir,
                             tb_dir=args.tensorboard_dir,
                             profile_dir=args.profile_dir,
                             checkify_errors=args.checkify,
                             ema_decay=args.ema_decay,
                             journal=journal,
-                            telemetry_sample_every=args.telemetry_sample_every)
+                            telemetry_sample_every=args.telemetry_sample_every,
+                            health=health)
     if journal is not None:
         # an unwinding run (exception/SIGTERM) still stops an in-flight
         # profiler trace and flushes writers via the atexit crash path
@@ -768,7 +855,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.eval_only:
         run_eval_only(cfg, trainer, eval_fn)
         trainer.close()
-        _finish_obs(args, journal)
+        _finish_obs(args, journal, tracer=tracer, health=health)
         return 0
     trainer.fit(
         train_fn, eval_fn, epochs=cfg.epochs, start_epoch=start_epoch,
@@ -776,7 +863,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     )
     trainer.close()
     _maybe_upload(args, ckpt_dir)
-    _finish_obs(args, journal)
+    _finish_obs(args, journal, tracer=tracer, health=health)
     return 0
 
 

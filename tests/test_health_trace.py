@@ -1,0 +1,478 @@
+"""obs/trace.py + obs/health.py: span tracer, NaN-guard policies,
+divergence detector, hang watchdog, and the check_journal validator."""
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from deep_vision_tpu.obs import (
+    HealthMonitor,
+    Registry,
+    RunJournal,
+    Tracer,
+    TrainingHealthError,
+    read_journal,
+    set_tracer,
+    span,
+    traced,
+)
+
+
+# -- tracer ------------------------------------------------------------------
+
+def test_tracer_writes_valid_chrome_trace(tmp_path):
+    path = str(tmp_path / "t.trace.json")
+    tracer = Tracer(path, run_id="run-42")
+    with tracer.span("outer", step=1):
+        with tracer.span("inner"):
+            pass
+    tracer.close()
+    doc = json.load(open(path))  # valid JSON or this raises
+    events = doc["traceEvents"]
+    by_name = {e["name"]: e for e in events if e["ph"] == "X"}
+    assert set(by_name) == {"outer", "inner"}
+    assert doc["metadata"]["run_id"] == "run-42"
+    # nesting: inner lies within outer on the same thread
+    o, i = by_name["outer"], by_name["inner"]
+    assert o["tid"] == i["tid"]
+    assert o["ts"] <= i["ts"]
+    assert i["ts"] + i["dur"] <= o["ts"] + o["dur"] + 1  # 1us rounding slack
+    assert o["args"]["step"] == 1
+
+
+def test_tracer_file_is_valid_json_mid_run(tmp_path):
+    # the crashed-run contract: every flush leaves complete, parseable JSON
+    path = str(tmp_path / "mid.trace.json")
+    tracer = Tracer(path, flush_every=1)
+    with tracer.span("a"):
+        pass
+    doc = json.load(open(path))
+    assert len([e for e in doc["traceEvents"] if e["ph"] == "X"]) == 1
+    tracer.close()
+
+
+def test_module_level_span_noop_without_tracer(tmp_path):
+    set_tracer(None)
+    with span("nothing", x=1) as sp:
+        sp.set(y=2)  # must not raise on the null span
+    path = str(tmp_path / "m.trace.json")
+    tracer = Tracer(path)
+    set_tracer(tracer)
+    try:
+        with span("active", x=1):
+            pass
+
+        @traced("decorated", kind="test")
+        def f(a):
+            return a + 1
+
+        assert f(1) == 2
+    finally:
+        set_tracer(None)
+        tracer.close()
+    names = {e["name"] for e in json.load(open(path))["traceEvents"]
+             if e["ph"] == "X"}
+    assert names == {"active", "decorated"}
+
+
+def test_tracer_ring_buffer_caps_and_reports_drops(tmp_path):
+    path = str(tmp_path / "ring.trace.json")
+    tracer = Tracer(path, flush_every=10_000, max_events=1000)
+    for i in range(2500):
+        with tracer.span("s", i=i):
+            pass
+    tracer.close()
+    doc = json.load(open(path))
+    assert len(doc["traceEvents"]) <= 1001  # cap (+1 thread_name meta)
+    assert doc["metadata"]["dropped_events"] > 0
+    # the survivors are the most RECENT window (post-mortem wants the end)
+    last = [e["args"]["i"] for e in doc["traceEvents"]
+            if e["ph"] == "X"][-1]
+    assert last == 2499
+
+
+def test_tracer_thread_safety_and_thread_names(tmp_path):
+    path = str(tmp_path / "threads.trace.json")
+    tracer = Tracer(path, flush_every=10_000)
+
+    def worker():
+        for _ in range(50):
+            with tracer.span("w"):
+                pass
+
+    threads = [threading.Thread(target=worker, name=f"worker-{i}")
+               for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    tracer.close()
+    doc = json.load(open(path))
+    spans = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert len(spans) == 200
+    meta_names = {e["args"]["name"] for e in doc["traceEvents"]
+                  if e["ph"] == "M"}
+    assert {f"worker-{i}" for i in range(4)} <= meta_names
+
+
+# -- health monitor: NaN guard ------------------------------------------------
+
+def _nan_journal(tmp_path, name):
+    return RunJournal(str(tmp_path / f"{name}.jsonl"))
+
+
+def test_health_warn_policy_continues(tmp_path):
+    j = _nan_journal(tmp_path, "warn")
+    reg = Registry()
+    h = HealthMonitor(policy="warn", journal=j, registry=reg)
+    assert h.check_step(1, loss=1.0, grad_norm=2.0) == "ok"
+    assert h.check_step(2, loss=float("nan"), grad_norm=1.0) == "warn"
+    assert h.check_step(3, loss=1.0, grad_norm=float("inf")) == "warn"
+    j.close()
+    health = [e for e in read_journal(j.path) if e["event"] == "health"]
+    assert [e["kind"] for e in health] == ["non_finite", "non_finite"]
+    assert health[0]["fields"] == ["loss"]
+    assert health[1]["fields"] == ["grad_norm"]
+    assert reg.counter("health_nonfinite_steps_total").value == 2
+    assert reg.counter("health_skipped_steps_total").value == 0
+
+
+def test_health_abort_policy_raises_after_journal(tmp_path):
+    j = _nan_journal(tmp_path, "abort")
+    h = HealthMonitor(policy="abort", journal=j, registry=Registry())
+    with pytest.raises(TrainingHealthError):
+        h.check_step(7, loss=float("nan"))
+    j._atexit()  # the dying process stamps the crash marker
+    kinds = [e["event"] for e in read_journal(j.path)]
+    # the typed health event precedes the crash marker: the post-mortem
+    # reads health(non_finite) -> crash
+    assert kinds.index("health") < kinds.index("crash")
+
+
+def test_health_divergence_zscore(tmp_path):
+    j = _nan_journal(tmp_path, "div")
+    reg = Registry()
+    h = HealthMonitor(policy="warn", journal=j, registry=reg,
+                      window=30, min_history=10, z_threshold=4.0, patience=3)
+    rng = np.random.RandomState(0)
+    for i in range(20):
+        assert h.check_step(i, loss=1.0 + 0.01 * rng.randn()) == "ok"
+    for i in range(20, 23):
+        assert h.check_step(i, loss=100.0) == "spike"
+    j.close()
+    kinds = [e["kind"] for e in read_journal(j.path) if e["event"] == "health"]
+    assert kinds == ["loss_spike", "loss_spike", "divergence"]
+    assert reg.counter("health_loss_spikes_total").value == 3
+
+
+def test_health_divergence_aborts_under_abort_policy(tmp_path):
+    h = HealthMonitor(policy="abort", registry=Registry(),
+                      window=30, min_history=5, z_threshold=4.0, patience=2)
+    for i in range(10):
+        h.check_step(i, loss=1.0 + 0.01 * i)
+    h.check_step(10, loss=50.0)
+    with pytest.raises(TrainingHealthError, match="divergence"):
+        h.check_step(11, loss=60.0)
+
+
+def test_health_check_summary(tmp_path):
+    j = _nan_journal(tmp_path, "summary")
+    h = HealthMonitor(policy="warn", journal=j, registry=Registry())
+    h.check_summary(0, {"g_loss": 1.0, "d_loss": 2.0})  # fine
+    h.check_summary(1, {"g_loss": float("nan"), "d_loss": 2.0})
+    with pytest.raises(TrainingHealthError):
+        HealthMonitor(policy="abort", journal=j, registry=Registry()) \
+            .check_summary(2, {"loss": float("inf")})
+    j.close()
+    health = [e for e in read_journal(j.path) if e["event"] == "health"]
+    assert [e.get("epoch") for e in health] == [1, 2]
+    assert health[0]["fields"] == ["g_loss"]
+
+
+# -- health monitor: watchdog -------------------------------------------------
+
+def test_watchdog_fires_on_stall_and_dumps_stacks(tmp_path):
+    j = _nan_journal(tmp_path, "hang")
+    reg = Registry()
+    h = HealthMonitor(policy="warn", journal=j, registry=reg,
+                      watchdog_timeout=0.2)
+    h.start_watchdog()
+    try:
+        h.beat()
+        time.sleep(0.6)  # stall: no beats
+    finally:
+        h.stop()
+    j.close()
+    health = [e for e in read_journal(j.path) if e["event"] == "health"]
+    kinds = [e["kind"] for e in health]
+    assert kinds[0] == "watchdog_started"
+    assert "hang" in kinds
+    hang = health[kinds.index("hang")]
+    assert hang["stalled_s"] >= 0.2
+    # the dump names this (stalled) test thread and carries real frames
+    assert any("MainThread" in k for k in hang["stacks"])
+    frames = "\n".join(sum(hang["stacks"].values(), []))
+    assert "test_watchdog_fires_on_stall" in frames
+    assert reg.counter("health_watchdog_fires_total").value >= 1
+    # one stall = one dump (the latch), re-armed only by a beat
+    assert kinds.count("hang") == 1
+
+
+def test_watchdog_rearms_after_beat(tmp_path):
+    j = _nan_journal(tmp_path, "rearm")
+    h = HealthMonitor(policy="warn", journal=j, registry=Registry(),
+                      watchdog_timeout=0.15)
+    h.start_watchdog()
+    try:
+        time.sleep(0.4)   # first stall
+        h.beat()          # progress resumes
+        time.sleep(0.4)   # second stall
+    finally:
+        h.stop()
+    j.close()
+    kinds = [e["kind"] for e in read_journal(j.path) if e["event"] == "health"]
+    assert kinds.count("hang") == 2
+
+
+def test_watchdog_quiet_with_heartbeats(tmp_path):
+    j = _nan_journal(tmp_path, "quiet")
+    h = HealthMonitor(policy="warn", journal=j, registry=Registry(),
+                      watchdog_timeout=0.3)
+    h.start_watchdog()
+    try:
+        for _ in range(6):
+            time.sleep(0.05)
+            h.beat()
+    finally:
+        h.stop()
+    j.close()
+    kinds = [e["kind"] for e in read_journal(j.path) if e["event"] == "health"]
+    assert "hang" not in kinds
+
+
+# -- trainer integration ------------------------------------------------------
+
+def _tiny_trainer(mesh8, **kw):
+    import jax.numpy as jnp
+
+    from deep_vision_tpu.losses import classification_loss_fn
+    from deep_vision_tpu.models import get_model
+    from deep_vision_tpu.train import Trainer, build_optimizer
+
+    return Trainer(
+        get_model("lenet5", num_classes=4),
+        build_optimizer("adam", 1e-3),
+        classification_loss_fn,
+        jnp.ones((2, 32, 32, 1)),
+        mesh=mesh8,
+        **kw,
+    )
+
+
+def _batches_with_nan(n_clean=3, bs=8, nan_at=1):
+    rng = np.random.RandomState(0)
+    out = [
+        {"image": rng.rand(bs, 32, 32, 1).astype(np.float32),
+         "label": rng.randint(0, 4, (bs,)).astype(np.int32)}
+        for _ in range(n_clean)
+    ]
+    out.insert(nan_at, {
+        "image": np.full((bs, 32, 32, 1), np.nan, np.float32),
+        "label": np.zeros((bs,), np.int32),
+    })
+    return out
+
+
+def test_trainer_nan_warn_policy_run_completes(tmp_path, mesh8):
+    path = str(tmp_path / "warn.jsonl")
+    j = RunJournal(path)
+    h = HealthMonitor(policy="warn", journal=j, registry=Registry())
+    t = _tiny_trainer(mesh8, journal=j, registry=h.registry, health=h)
+    t.fit(lambda: _batches_with_nan(), epochs=1, handle_preemption=False)
+    t.close()
+    j.close()
+    events = read_journal(path)
+    assert events[-1]["event"] == "exit"  # warn continues to a clean exit
+    kinds = [e["kind"] for e in events if e["event"] == "health"]
+    assert "non_finite" in kinds
+
+
+def test_trainer_nan_skip_step_policy(tmp_path, mesh8):
+    path = str(tmp_path / "skip.jsonl")
+    j = RunJournal(path)
+    reg = Registry()
+    h = HealthMonitor(policy="skip_step", journal=j, registry=reg)
+    t = _tiny_trainer(mesh8, journal=j, registry=reg, health=h)
+    t.fit(lambda: _batches_with_nan(), epochs=1, handle_preemption=False)
+    import jax
+
+    # the poisoned update was discarded: weights stayed finite throughout
+    leaves = jax.tree_util.tree_leaves(t.state.params)
+    assert all(bool(np.all(np.isfinite(np.asarray(x)))) for x in leaves)
+    # and the step counter advanced only for the 3 applied updates
+    assert int(t.state.step) == 3
+    t.close()
+    j.close()
+    assert reg.counter("health_skipped_steps_total").value == 1
+    summary = [e for e in read_journal(path) if e["event"] == "epoch"][0]
+    # the skipped step's garbage loss stayed out of the epoch mean
+    assert np.isfinite(summary["summary"]["loss"])
+
+
+def test_watchdog_only_health_keeps_divergence_fatal(tmp_path, mesh8):
+    # --watchdog-timeout alone defaults the NaN policy to warn, but that
+    # implicit default must NOT relax the pre-existing fatal
+    # non-finite-epoch-mean check (the user never chose a policy)
+    j = RunJournal(str(tmp_path / "wd.jsonl"))
+    h = HealthMonitor(policy="warn", journal=j, registry=Registry(),
+                      watchdog_timeout=60, policy_explicit=False)
+    t = _tiny_trainer(mesh8, journal=j, registry=h.registry, health=h)
+    with pytest.raises(FloatingPointError):
+        t.fit(lambda: _batches_with_nan(), epochs=2, handle_preemption=False)
+    t.close()
+    j.close()
+
+
+def test_trainer_nan_abort_policy(tmp_path, mesh8):
+    path = str(tmp_path / "abort.jsonl")
+    j = RunJournal(path)
+    h = HealthMonitor(policy="abort", journal=j, registry=Registry())
+    t = _tiny_trainer(mesh8, journal=j, registry=h.registry, health=h)
+    with pytest.raises(TrainingHealthError):
+        t.fit(lambda: _batches_with_nan(), epochs=1, handle_preemption=False)
+    t.close()
+    j._atexit()
+    kinds = [e["event"] for e in read_journal(path)]
+    assert kinds.index("health") < kinds.index("crash")
+
+
+def test_trainer_trace_has_nested_step_eval_spans(tmp_path, mesh8):
+    path = str(tmp_path / "run.trace.json")
+    tracer = Tracer(path)
+    set_tracer(tracer)
+    try:
+        t = _tiny_trainer(mesh8)
+        data = _batches_with_nan(n_clean=2, nan_at=2)[:2]  # clean only
+        t.fit(lambda: data, lambda: data, epochs=1, handle_preemption=False)
+        t.close()
+    finally:
+        set_tracer(None)
+        tracer.close()
+    doc = json.load(open(path))
+    spans = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    names = {e["name"] for e in spans}
+    assert {"train/epoch", "train/step", "eval"} <= names
+    # step spans nest inside their epoch span
+    epoch = next(e for e in spans if e["name"] == "train/epoch")
+    steps = [e for e in spans if e["name"] == "train/step"]
+    assert len(steps) == 2
+    for s in steps:
+        assert epoch["ts"] <= s["ts"]
+        assert s["ts"] + s["dur"] <= epoch["ts"] + epoch["dur"] + 1
+        assert "step" in s["args"]
+    # validator agrees the artifact is well-formed
+    from tools.check_journal import check_trace
+
+    assert check_trace(path) == []
+
+
+def test_dataloader_emits_fetch_and_batch_spans(tmp_path):
+    from deep_vision_tpu.data.pipeline import DataLoader
+
+    path = str(tmp_path / "dl.trace.json")
+    tracer = Tracer(path)
+    set_tracer(tracer)
+    try:
+        ds = [{"x": np.ones((2,), np.float32)} for _ in range(8)]
+        dl = DataLoader(ds, batch_size=4, num_workers=1, prefetch=2,
+                        name="trace-test")
+        assert sum(1 for _ in dl) == 2
+    finally:
+        set_tracer(None)
+        tracer.close()
+    spans = [e for e in json.load(open(path))["traceEvents"]
+             if e["ph"] == "X"]
+    names = [e["name"] for e in spans]
+    # one fetch per BATCH: the end-of-epoch sentinel get is producer-drain
+    # wait, not fetch time, and must not appear in the totals
+    assert names.count("data/fetch") == 2
+    assert names.count("data/augment_batch") == 2
+    fetch = next(e for e in spans if e["name"] == "data/fetch")
+    assert fetch["args"]["loader"] == "trace-test"
+
+
+# -- check_journal validator --------------------------------------------------
+
+def test_check_journal_accepts_real_journal(tmp_path):
+    from tools.check_journal import check_journal
+
+    path = str(tmp_path / "good.jsonl")
+    with RunJournal(path, kind="train") as j:
+        j.manifest(config={"name": "lenet5"})
+        j.step(1, step_time_ms=1.0)
+        j.write("checkpoint", step=1, epoch=0, saved=True)
+        j.write("health", kind="non_finite", step=2, fields=["loss"])
+    assert check_journal(path, require_exit=True) == []
+
+
+def test_check_journal_rejects_bad_events(tmp_path):
+    from tools.check_journal import check_journal
+
+    path = str(tmp_path / "bad.jsonl")
+    with open(path, "w") as f:
+        f.write(json.dumps({"event": "step", "ts": 1.0, "run_id": "r"}) + "\n")
+        f.write(json.dumps({"event": "wat", "ts": 1.0, "run_id": "r"}) + "\n")
+        f.write(json.dumps({"event": "exit", "ts": 1.0}) + "\n")
+    errs = check_journal(path)
+    assert any("step event missing field 'step'" in e for e in errs)
+    assert any("unknown event type 'wat'" in e for e in errs)
+    assert any("missing envelope field 'run_id'" in e for e in errs)
+    # crash terminal fails --require-exit
+    path2 = str(tmp_path / "crashed.jsonl")
+    j = RunJournal(path2)
+    j.step(1, step_time_ms=1.0)
+    j._atexit()
+    assert check_journal(path2) == []
+    assert any("crash marker" in e
+               for e in check_journal(path2, require_exit=True))
+
+
+def test_check_trace_rejects_malformed(tmp_path):
+    from tools.check_journal import check_trace
+
+    bad = tmp_path / "bad.trace.json"
+    bad.write_text("{not json")
+    assert any("not valid JSON" in e for e in check_trace(str(bad)))
+    empty = tmp_path / "empty.trace.json"
+    empty.write_text(json.dumps({"traceEvents": []}))
+    assert any("no complete" in e for e in check_trace(str(empty)))
+    missing = tmp_path / "missing.trace.json"
+    missing.write_text(json.dumps(
+        {"traceEvents": [{"name": "x", "ph": "X", "ts": 1.0}]}))
+    assert any("missing 'dur'" in e for e in check_trace(str(missing)))
+
+
+def test_obs_report_renders_health_and_trace(tmp_path, capsys):
+    from tools.obs_report import main as report_main
+
+    jpath = str(tmp_path / "r.jsonl")
+    with RunJournal(jpath, kind="train") as j:
+        j.manifest(config={"name": "lenet5", "task": "classification"})
+        j.step(1, step_time_ms=10.0)
+        j.write("health", kind="non_finite", step=2, fields=["loss"],
+                action="warn", policy="warn")
+        j.write("health", kind="hang", stalled_s=12.0, timeout_s=10.0,
+                stacks={"MainThread (1)": ["frame"]})
+    tpath = str(tmp_path / "r.trace.json")
+    tracer = Tracer(tpath)
+    with tracer.span("train/step", step=1):
+        pass
+    tracer.close()
+    assert report_main([jpath, "--trace", tpath]) == 0
+    out = capsys.readouterr().out
+    assert "non_finitex1" in out and "hangx1" in out
+    assert "1 thread stacks dumped" in out
+    assert "span time summary" in out and "train/step" in out

@@ -1,0 +1,182 @@
+"""Validate journal JSONL + Chrome trace JSON against the obs/ schemas.
+
+    PYTHONPATH=. python tools/check_journal.py run.jsonl [run2.jsonl ...]
+        [--trace trace.json] [--require-exit]
+
+The CI teeth behind obs/README.md: every event line must parse, carry
+the `event`/`ts`/`run_id` envelope, use a known event type, and carry
+that type's required fields; `--require-exit` additionally demands a
+clean `exit` terminal event (what `make obs-smoke` asserts after its
+tiny train run — a smoke run that crashed is a failure even if every
+line it did write was well-formed). Trace files must be valid JSON in
+Trace Event Format: a `traceEvents` list whose complete events ("ph":
+"X") carry name/ts/dur/pid/tid.
+
+Exit status 0 = all files valid; 1 = any violation (each printed with
+its file:line).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# envelope fields on every line, then per-event required fields
+ENVELOPE = ("event", "ts", "run_id")
+EVENT_FIELDS = {
+    "run_manifest": ("kind", "argv"),
+    "step": ("step",),
+    "epoch": ("epoch", "summary"),
+    "eval": ("epoch", "summary"),
+    "checkpoint": ("step", "saved"),
+    "health": ("kind",),
+    "profile": ("action",),
+    "bench": ("name", "result"),
+    "note": (),
+    "exit": ("status",),
+    "crash": ("reason",),
+}
+HEALTH_KINDS = {"non_finite", "loss_spike", "divergence", "hang",
+                "watchdog_started"}
+
+
+def check_journal(path: str, require_exit: bool = False) -> List[str]:
+    """Returns a list of violations ('' prefix stripped); empty = valid."""
+    errors: List[str] = []
+    events: List[dict] = []
+    try:
+        with open(path) as f:
+            lines = f.readlines()
+    except OSError as e:
+        return [f"{path}: unreadable: {e}"]
+    for i, line in enumerate(lines, 1):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            row = json.loads(line)
+        except json.JSONDecodeError:
+            # only the FINAL line may be torn (crash mid-write); anywhere
+            # else it is corruption
+            if i == len(lines):
+                errors.append(f"{path}:{i}: torn final line (tolerated by "
+                              "readers, but the run died mid-write)")
+            else:
+                errors.append(f"{path}:{i}: unparseable JSON")
+            continue
+        if not isinstance(row, dict):
+            errors.append(f"{path}:{i}: not a JSON object")
+            continue
+        for k in ENVELOPE:
+            if k not in row:
+                errors.append(f"{path}:{i}: missing envelope field {k!r}")
+        ev = row.get("event")
+        if ev not in EVENT_FIELDS:
+            errors.append(f"{path}:{i}: unknown event type {ev!r}")
+            continue
+        for k in EVENT_FIELDS[ev]:
+            if k not in row:
+                errors.append(f"{path}:{i}: {ev} event missing field {k!r}")
+        if ev == "health":
+            if row.get("kind") not in HEALTH_KINDS:
+                errors.append(f"{path}:{i}: unknown health kind "
+                              f"{row.get('kind')!r}")
+            if row.get("kind") == "hang" and not row.get("stacks"):
+                errors.append(f"{path}:{i}: hang event carries no thread "
+                              "stacks")
+        events.append(row)
+    if not events:
+        errors.append(f"{path}: no events")
+        return errors
+    terminal = [e for e in events if e.get("event") in ("exit", "crash")]
+    if require_exit:
+        if not terminal:
+            errors.append(f"{path}: no terminal event (run still alive or "
+                          "SIGKILLed)")
+        elif terminal[-1]["event"] != "exit":
+            errors.append(f"{path}: terminal event is a crash marker: "
+                          f"{terminal[-1].get('reason')!r}")
+    return errors
+
+
+def check_trace(path: str) -> List[str]:
+    """Validate Trace Event Format structure; empty list = valid."""
+    errors: List[str] = []
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        return [f"{path}: not valid JSON: {e}"]
+    if isinstance(doc, dict):
+        events = doc.get("traceEvents")
+        if not isinstance(events, list):
+            return [f"{path}: object form must carry a traceEvents list"]
+    elif isinstance(doc, list):
+        events = doc
+    else:
+        return [f"{path}: trace must be a JSON array or object"]
+    n_complete = 0
+    for i, e in enumerate(events):
+        if not isinstance(e, dict):
+            errors.append(f"{path}: event[{i}] is not an object")
+            continue
+        if "name" not in e or "ph" not in e:
+            errors.append(f"{path}: event[{i}] missing name/ph")
+            continue
+        if e["ph"] == "X":
+            n_complete += 1
+            for k in ("ts", "dur", "pid", "tid"):
+                if k not in e:
+                    errors.append(
+                        f"{path}: complete event[{i}] "
+                        f"({e['name']!r}) missing {k!r}")
+            if e.get("dur", 0) < 0:
+                errors.append(f"{path}: event[{i}] negative duration")
+    if n_complete == 0:
+        errors.append(f"{path}: no complete ('X') span events")
+    return errors
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("journals", nargs="+", help="journal JSONL path(s)")
+    p.add_argument("--trace", default=None, metavar="PATH",
+                   help="also validate this Chrome trace JSON")
+    p.add_argument("--require-exit", action="store_true",
+                   help="fail unless the journal ends in a clean exit "
+                        "event (the obs-smoke gate)")
+    args = p.parse_args(argv)
+
+    errors: List[str] = []
+    for path in args.journals:
+        errs = check_journal(path, require_exit=args.require_exit)
+        errors += errs
+        if not errs:
+            from deep_vision_tpu.obs.journal import read_journal
+
+            counts: dict = {}
+            for e in read_journal(path):
+                counts[e["event"]] = counts.get(e["event"], 0) + 1
+            print(f"OK {path}: " + " ".join(
+                f"{k}x{n}" for k, n in sorted(counts.items())))
+    if args.trace:
+        errs = check_trace(args.trace)
+        errors += errs
+        if not errs:
+            with open(args.trace) as f:
+                doc = json.load(f)
+            events = doc["traceEvents"] if isinstance(doc, dict) else doc
+            names = sorted({e["name"] for e in events if e.get("ph") == "X"})
+            print(f"OK {args.trace}: {len(events)} events, "
+                  f"spans: {', '.join(names)}")
+    for e in errors:
+        print("FAIL " + e, file=sys.stderr)
+    return 1 if errors else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,17 +1,23 @@
 """Render a run journal (obs/journal.py JSONL) into a per-run summary table.
 
     PYTHONPATH=. python tools/obs_report.py runs/resnet50.journal.jsonl [...]
+    PYTHONPATH=. python tools/obs_report.py run.jsonl --trace run.trace.json
 
 One table row block per run_id found in the files: manifest identity,
 step-time/data-wait/examples-per-sec statistics (mean/p50/p90 from the
 per-step events), recompile and HBM peaks, eval/checkpoint/bench events,
-and the terminal marker (clean exit vs crash vs still-running). This is
-the diff surface for BENCH_* rounds: two journals from different PRs
+health findings (obs/health.py: non-finite steps, loss spikes, watchdog
+hang dumps), and the terminal marker (clean exit vs crash vs
+still-running). With `--trace`, a per-span time summary of the matching
+Chrome trace (obs/trace.py) follows: total/mean/max wall ms per span
+name — the "where did the time go" table without opening Perfetto. This
+is the diff surface for BENCH_* rounds: two journals from different PRs
 summarize into directly comparable tables.
 """
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import sys
 from typing import Dict, List, Optional
@@ -68,6 +74,7 @@ def summarize_run(events: List[dict]) -> dict:
         out["hbm_peak_gb"] = max(hbm) / 1e9
     out["epochs"] = [e for e in events if e.get("event") == "epoch"]
     out["evals"] = [e for e in events if e.get("event") == "eval"]
+    out["health"] = [e for e in events if e.get("event") == "health"]
     out["checkpoints"] = sum(
         1 for e in events if e.get("event") == "checkpoint" and e.get("saved"))
     out["benches"] = [e for e in events if e.get("event") == "bench"]
@@ -129,6 +136,33 @@ def render(summary: dict) -> str:
         parts = " ".join(f"{k}={v}" for k, v in res.items()
                          if isinstance(v, (int, float)))
         rows.append((f"bench {e.get('name')}", parts))
+    # health findings: one row per event, aggregated counts first so a
+    # 10k-spike run stays readable (only the first few render verbatim)
+    health = summary.get("health", [])
+    if health:
+        by_kind: Dict[str, int] = {}
+        for e in health:
+            by_kind[e.get("kind", "?")] = by_kind.get(e.get("kind", "?"), 0) + 1
+        rows.append(("health", " ".join(
+            f"{k}x{n}" for k, n in sorted(by_kind.items()))))
+        for e in health[:8]:
+            kind = e.get("kind", "?")
+            where = (f"step {e['step']}" if "step" in e
+                     else f"epoch {e['epoch']}" if "epoch" in e else "")
+            detail = ""
+            if kind == "non_finite":
+                detail = "fields=" + ",".join(e.get("fields", []))
+            elif kind in ("loss_spike", "divergence"):
+                detail = (f"loss={e.get('loss', 0):.4g} "
+                          f"z={e.get('z', 0):.1f} "
+                          f"streak={e.get('streak', '?')}")
+            elif kind == "hang":
+                detail = (f"stalled {e.get('stalled_s', '?')}s "
+                          f"(deadline {e.get('timeout_s', '?')}s), "
+                          f"{len(e.get('stacks', {}))} thread stacks dumped")
+            rows.append((f"  {kind}", f"{where} {detail}".strip()))
+        if len(health) > 8:
+            rows.append(("  ...", f"{len(health) - 8} more health events"))
     width = max(len(k) for k, _ in rows)
     lines = ["=" * (width + 46)]
     lines += [f"{k:<{width}}  {v}" for k, v in rows]
@@ -136,9 +170,49 @@ def render(summary: dict) -> str:
     return "\n".join(lines)
 
 
+def summarize_trace(path: str) -> List[dict]:
+    """Per-span-name aggregate over a Chrome trace (obs/trace.py output):
+    count, total/mean/max duration ms, sorted by total descending."""
+    with open(path) as f:
+        doc = json.load(f)
+    events = doc["traceEvents"] if isinstance(doc, dict) else doc
+    agg: Dict[str, dict] = {}
+    for e in events:
+        if e.get("ph") != "X":
+            continue  # metadata / instant events carry no duration
+        name = e.get("name", "?")
+        dur_ms = float(e.get("dur", 0.0)) / 1e3
+        a = agg.setdefault(name, {"name": name, "count": 0,
+                                  "total_ms": 0.0, "max_ms": 0.0})
+        a["count"] += 1
+        a["total_ms"] += dur_ms
+        a["max_ms"] = max(a["max_ms"], dur_ms)
+    out = sorted(agg.values(), key=lambda a: -a["total_ms"])
+    for a in out:
+        a["mean_ms"] = a["total_ms"] / a["count"]
+    return out
+
+
+def render_trace(spans: List[dict], path: str) -> str:
+    if not spans:
+        return f"trace {path}: no complete spans"
+    w = max(len(s["name"]) for s in spans)
+    lines = [f"-- span time summary: {path} --",
+             f"{'span':<{w}}  {'count':>6}  {'total ms':>10}  "
+             f"{'mean ms':>9}  {'max ms':>9}"]
+    for s in spans:
+        lines.append(f"{s['name']:<{w}}  {s['count']:>6}  "
+                     f"{s['total_ms']:>10.1f}  {s['mean_ms']:>9.2f}  "
+                     f"{s['max_ms']:>9.1f}")
+    return "\n".join(lines)
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("journals", nargs="+", help="journal JSONL path(s)")
+    p.add_argument("--trace", default=None, metavar="PATH",
+                   help="also render a per-span time summary of this "
+                        "Chrome trace JSON (train.py --trace output)")
     args = p.parse_args(argv)
 
     by_run: Dict[str, List[dict]] = {}
@@ -150,6 +224,8 @@ def main(argv=None) -> int:
         return 1
     for run_id, events in by_run.items():
         print(render(summarize_run(events)))
+    if args.trace:
+        print(render_trace(summarize_trace(args.trace), args.trace))
     return 0
 
 
