@@ -34,11 +34,22 @@ train-fg:
 test:
 	python -m pytest tests/ -x -q
 
+# static analysis (lint/): the review-time teeth behind the obs/ runtime
+# signals — fails on any non-baselined DV001-DV005 finding. Runs first in
+# verify: it is the cheapest gate (~3s, no jax import of the hot paths)
+lint:
+	python -m deep_vision_tpu.lint
+
+# accept the current findings into the checked-in baseline (use after an
+# intentional change; review the diff of .jaxlint-baseline.json like code)
+lint-baseline:
+	python -m deep_vision_tpu.lint --write-baseline
+
 # the tier-1 gate, verbatim from ROADMAP.md: run before shipping any PR
-# (bash, not sh: the command uses pipefail and PIPESTATUS); obs-smoke
-# first — the telemetry artifacts must validate before the tests count
+# (bash, not sh: the command uses pipefail and PIPESTATUS); lint, then
+# obs-smoke — the telemetry artifacts must validate before the tests count
 verify: SHELL := /bin/bash
-verify: obs-smoke
+verify: lint obs-smoke
 	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
 
 # observability smoke: a tiny CPU train with tracing + health guard on,
@@ -52,7 +63,7 @@ obs-smoke:
 	  --trace artifacts/obs_smoke/trace.json \
 	  --health-policy warn --watchdog-timeout 300
 	python tools/check_journal.py artifacts/obs_smoke/journal.jsonl \
-	  --trace artifacts/obs_smoke/trace.json --require-exit
+	  --trace artifacts/obs_smoke/trace.json --strict
 	python tools/obs_report.py artifacts/obs_smoke/journal.jsonl \
 	  --trace artifacts/obs_smoke/trace.json
 
@@ -93,4 +104,4 @@ ps:
 native:
 	$(MAKE) -C native
 
-.PHONY: train resume train-fg test verify obs-smoke bench bench-evidence demo demo-gan demo-real dryrun tb ps native
+.PHONY: train resume train-fg test lint lint-baseline verify obs-smoke bench bench-evidence demo demo-gan demo-real dryrun tb ps native

@@ -1,0 +1,25 @@
+"""Shared CLI exit-status contract for the repo's gate tools.
+
+sysexits.h-style: callers (and make) can tell a bad input file from a
+bad invocation. Used by `python -m deep_vision_tpu.lint` and
+`tools/check_journal.py` — one definition so the two contracts cannot
+drift.
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+
+EXIT_OK = 0
+EXIT_INVALID = 2
+EXIT_USAGE = 64
+
+
+class UsageErrorParser(argparse.ArgumentParser):
+    """argparse exits 2 on bad usage, which collides with 'invalid file';
+    remap to EX_USAGE (64)."""
+
+    def error(self, message):
+        self.print_usage(sys.stderr)
+        print(f"{self.prog}: error: {message}", file=sys.stderr)
+        raise SystemExit(EXIT_USAGE)

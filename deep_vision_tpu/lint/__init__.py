@@ -1,0 +1,32 @@
+"""jaxlint: JAX/TPU-aware static analysis for this framework.
+
+The review-time teeth behind the obs/ runtime telemetry: an AST-based
+rule engine (stdlib `ast`, no dependencies) that enforces the
+performance and correctness contracts the hot paths rely on — no host
+syncs or impurity inside jit, no reused PRNG keys, donated train-step
+state, no jit-in-loop recompiles. Run as `python -m deep_vision_tpu.lint`
+or `make lint`; see lint/README.md for the rule catalog.
+"""
+from deep_vision_tpu.lint.engine import (
+    lint_paths,
+    lint_source,
+    parse_suppressions,
+)
+from deep_vision_tpu.lint.findings import (
+    Finding,
+    load_baseline,
+    save_baseline,
+    split_baselined,
+)
+from deep_vision_tpu.lint.rules import RULES
+
+__all__ = [
+    "Finding",
+    "RULES",
+    "lint_paths",
+    "lint_source",
+    "load_baseline",
+    "parse_suppressions",
+    "save_baseline",
+    "split_baselined",
+]

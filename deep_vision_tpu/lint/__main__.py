@@ -1,0 +1,198 @@
+"""jaxlint CLI: `python -m deep_vision_tpu.lint` / `make lint`.
+
+    python -m deep_vision_tpu.lint [paths...]
+        [--format human|json] [--baseline PATH | --no-baseline]
+        [--write-baseline] [--select DV001,DV002] [--disable DV006]
+        [--fail-on-warn] [--list-rules]
+
+Exit status: 0 = clean (or every error is baselined), 1 = new findings,
+2 = invalid file (unreadable baseline), 64 = usage error — the same
+contract as tools/check_journal.py. With no paths, the [tool.jaxlint]
+section of pyproject.toml supplies them (defaults: deep_vision_tpu/,
+tools/, train.py).
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+from typing import List, Optional
+
+from deep_vision_tpu.cli import EXIT_INVALID, EXIT_USAGE, UsageErrorParser
+from deep_vision_tpu.lint.config import (
+    find_pyproject,
+    load_config,
+    resolve_paths,
+)
+from deep_vision_tpu.lint.engine import lint_paths
+from deep_vision_tpu.lint.findings import (
+    load_baseline,
+    save_baseline,
+    split_baselined,
+)
+from deep_vision_tpu.lint.rules import RULES
+
+
+def _codes(raw: Optional[str]) -> Optional[List[str]]:
+    if raw is None:
+        return None
+    return [c.strip().upper() for c in raw.split(",") if c.strip()]
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = UsageErrorParser(
+        prog="python -m deep_vision_tpu.lint",
+        description="JAX/TPU-aware static analysis for deep_vision_tpu",
+    )
+    p.add_argument("paths", nargs="*",
+                   help="files/dirs to lint (default: [tool.jaxlint] paths)")
+    p.add_argument("--format", choices=("human", "json"), default="human")
+    p.add_argument("--baseline", default=None, metavar="PATH",
+                   help="baseline file (default: [tool.jaxlint] baseline)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="ignore any baseline file")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="accept all current findings into the baseline "
+                        "and exit 0")
+    p.add_argument("--select", default=None, metavar="CODES",
+                   help="comma-separated rule codes to run (default: all)")
+    p.add_argument("--disable", default=None, metavar="CODES",
+                   help="comma-separated rule codes to skip")
+    p.add_argument("--fail-on-warn", action="store_true",
+                   help="non-baselined warnings also fail the gate")
+    p.add_argument("--config", default=None, metavar="PYPROJECT",
+                   help="explicit pyproject.toml (default: nearest upward)")
+    p.add_argument("--list-rules", action="store_true")
+    args = p.parse_args(argv)
+
+    if args.list_rules:
+        for code, (name, severity, _, doc) in sorted(RULES.items()):
+            print(f"{code}  {name:<24} [{severity}]  {doc}")
+        return 0
+
+    # a typo'd code would otherwise run zero rules and report "clean"
+    unknown = sorted({c for c in (_codes(args.select) or []) +
+                      (_codes(args.disable) or []) if c not in RULES})
+    if unknown:
+        print(f"jaxlint: unknown rule code(s): {', '.join(unknown)} "
+              f"(known: {', '.join(sorted(RULES))})", file=sys.stderr)
+        return EXIT_USAGE
+
+    pyproject = args.config or find_pyproject(
+        args.paths[0] if args.paths else os.getcwd())
+    try:
+        cfg = load_config(pyproject)
+    except ValueError as e:  # tomllib.TOMLDecodeError subclasses ValueError
+        print(f"jaxlint: invalid [tool.jaxlint] config in {pyproject}: {e}",
+              file=sys.stderr)
+        return EXIT_INVALID
+    paths = resolve_paths(cfg, args.paths)
+    disable = {c.upper() for c in cfg["disable"]} | \
+        set(_codes(args.disable) or [])
+    bad_cfg = sorted(disable - set(RULES))
+    if bad_cfg:
+        print(f"jaxlint: unknown rule code(s) in [tool.jaxlint] disable: "
+              f"{', '.join(bad_cfg)}", file=sys.stderr)
+        return EXIT_INVALID
+    # --select DV001 --disable DV001 would run zero rules and exit 0
+    if not (set(_codes(args.select) or RULES) - disable):
+        print("jaxlint: --select/--disable leave no rules enabled",
+              file=sys.stderr)
+        return EXIT_USAGE
+
+    findings, suppressed, n_files = lint_paths(
+        paths,
+        root=cfg.get("root"),
+        select=_codes(args.select),
+        disable=disable or None,
+        exclude=cfg["exclude"],
+    )
+
+    baseline_path = args.baseline or os.path.join(
+        cfg.get("root", os.getcwd()), cfg["baseline"])
+    if args.write_baseline:
+        # the baseline file holds the full-rule acceptance set: writing it
+        # from a partial run would drop every other rule's accepted entries
+        if args.select or args.disable:
+            print("jaxlint: --write-baseline must run with all rules "
+                  "enabled (drop --select/--disable)", file=sys.stderr)
+            return EXIT_USAGE
+        # same hazard as a partial rule run: findings outside the given
+        # paths would be dropped from the acceptance set
+        if args.paths:
+            print("jaxlint: --write-baseline must run over the full "
+                  "[tool.jaxlint] path set (drop the explicit paths)",
+                  file=sys.stderr)
+            return EXIT_USAGE
+        # DV000 means the lint run itself is broken (missing path, syntax
+        # error, unreadable file) — baselining it would permanently silence
+        # the guard that exists to catch exactly that
+        broken = [f for f in findings if f.code == "DV000"]
+        if broken:
+            for f in broken:
+                print(f.render(), file=sys.stderr)
+            print("jaxlint: refusing to write a baseline over DV000 "
+                  "config/parse errors — fix them first", file=sys.stderr)
+            return 1
+        if n_files == 0:
+            # an empty path set would silently truncate the acceptance
+            # set to nothing and report success
+            print("jaxlint: refusing to write a baseline: no Python "
+                  "files were linted — check [tool.jaxlint] "
+                  "paths/exclude", file=sys.stderr)
+            return 1
+        save_baseline(baseline_path, findings)
+        print(f"jaxlint: baseline written to {baseline_path} "
+              f"({len(findings)} finding(s) accepted)")
+        return 0
+
+    if n_files == 0:
+        missing = [pt for pt in paths if not os.path.exists(pt)]
+        detail = (f"path does not exist: {', '.join(missing)}" if missing
+                  else f"no Python files found under {', '.join(paths)}")
+        print(f"jaxlint: {detail} — check [tool.jaxlint] paths",
+              file=sys.stderr)
+        return 1
+
+    if args.no_baseline:
+        fresh, accepted = findings, []
+    else:
+        try:
+            baseline = load_baseline(baseline_path)
+        except (ValueError, json.JSONDecodeError) as e:
+            print(f"jaxlint: unreadable baseline: {e}; regenerate with "
+                  "`make lint-baseline`", file=sys.stderr)
+            return EXIT_INVALID
+        fresh, accepted = split_baselined(findings, baseline)
+
+    errors = [f for f in fresh if f.severity == "error"]
+    warnings = [f for f in fresh if f.severity == "warning"]
+    failed = bool(errors) or (args.fail_on_warn and bool(warnings))
+
+    if args.format == "json":
+        print(json.dumps({
+            "version": 1,
+            "findings": [f.to_dict() for f in fresh],
+            "baselined": [f.to_dict() for f in accepted],
+            "summary": {
+                "files": n_files,
+                "errors": len(errors),
+                "warnings": len(warnings),
+                "baselined": len(accepted),
+                "suppressed": len(suppressed),
+                "failed": failed,
+            },
+        }, indent=2))
+    else:
+        for f in fresh:
+            print(f.render())
+        tail = (f"jaxlint: {len(errors)} error(s), {len(warnings)} "
+                f"warning(s) in {n_files} files "
+                f"({len(accepted)} baselined, {len(suppressed)} suppressed)")
+        print(tail, file=sys.stderr if failed else sys.stdout)
+
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

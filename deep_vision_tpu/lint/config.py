@@ -1,0 +1,90 @@
+"""[tool.jaxlint] config from pyproject.toml.
+
+Python 3.10 has no tomllib, and the container must not grow a toml dep,
+so when tomllib is unavailable we fall back to a minimal section parser
+that understands exactly the value shapes jaxlint's keys use: strings
+and (possibly multi-line) string arrays — both of which are also valid
+Python literals.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import List, Optional
+
+DEFAULTS = {
+    "paths": ["deep_vision_tpu", "tools", "train.py"],
+    "exclude": [],
+    "baseline": ".jaxlint-baseline.json",
+    "disable": [],
+}
+
+
+def find_pyproject(start: str) -> Optional[str]:
+    cur = os.path.abspath(start)
+    if os.path.isfile(cur):
+        cur = os.path.dirname(cur)
+    while True:
+        candidate = os.path.join(cur, "pyproject.toml")
+        if os.path.isfile(candidate):
+            return candidate
+        parent = os.path.dirname(cur)
+        if parent == cur:
+            return None
+        cur = parent
+
+
+def _parse_section_fallback(text: str) -> dict:
+    m = re.search(r"^\[tool\.jaxlint\]\s*$", text, re.M)
+    if m is None:
+        return {}
+    body = text[m.end():]
+    stop = re.search(r"^\[", body, re.M)
+    if stop is not None:
+        body = body[:stop.start()]
+    out = {}
+    # join multi-line arrays, strip full-line comments
+    lines = [ln for ln in body.splitlines()
+             if not ln.lstrip().startswith("#")]
+    joined = "\n".join(lines)
+    for key, raw in re.findall(
+            r"^([A-Za-z_][\w-]*)\s*=\s*((?:\[[^\]]*\])|(?:\"[^\"]*\")|"
+            r"(?:'[^']*'))", joined, re.M | re.S):
+        try:
+            out[key] = ast.literal_eval(raw)
+        except (ValueError, SyntaxError):
+            # silently falling back to defaults would make the same bad
+            # config lint differently per Python version (tomllib raises)
+            raise ValueError(
+                f"unparseable value for {key!r}: {raw.strip()!r}") from None
+    return out
+
+
+def load_config(pyproject_path: Optional[str]) -> dict:
+    cfg = dict(DEFAULTS)
+    if pyproject_path is None or not os.path.isfile(pyproject_path):
+        return cfg
+    with open(pyproject_path, "rb") as f:
+        raw = f.read()
+    section = {}
+    try:
+        import tomllib  # py311+
+
+        section = tomllib.loads(raw.decode()).get("tool", {}).get(
+            "jaxlint", {})
+    except ModuleNotFoundError:
+        section = _parse_section_fallback(raw.decode())
+    for key in DEFAULTS:
+        if key in section:
+            cfg[key] = section[key]
+    cfg["root"] = os.path.dirname(os.path.abspath(pyproject_path))
+    return cfg
+
+
+def resolve_paths(cfg: dict, explicit: List[str]) -> List[str]:
+    """CLI paths win; otherwise config paths, relative to the config root."""
+    if explicit:
+        return explicit
+    root = cfg.get("root", os.getcwd())
+    return [os.path.join(root, p) for p in cfg["paths"]]

@@ -1,0 +1,210 @@
+"""jaxlint engine: walk files, run rules, apply suppressions + baseline.
+
+Per file: parse once, resolve the jit context once (lint/jitctx.py),
+then every enabled rule runs over the shared ModuleCtx. Findings are
+filtered through inline suppressions (`# jaxlint: disable=DVnnn`) and
+then the checked-in baseline; only what survives both gates the exit
+code.
+"""
+from __future__ import annotations
+
+import ast
+import io
+import os
+import re
+import tokenize
+from typing import Dict, Iterable, List, Optional, Set
+
+from deep_vision_tpu.lint.findings import Finding
+from deep_vision_tpu.lint.jitctx import JitContext, jax_random_aliases
+from deep_vision_tpu.lint.rules import RULES
+
+# `# jaxlint: disable=DV001` / `disable=DV001,DV005` / `disable=all`,
+# optionally followed by `-- reason` (the reason is required by review
+# convention, not enforced by the parser)
+_SUPPRESS_RE = re.compile(
+    r"#\s*jaxlint:\s*disable=([A-Za-z0-9,_ ]+|all)(?:\s*--\s*(.*))?")
+
+
+class ModuleCtx:
+    """Everything the rules need about one parsed file."""
+
+    def __init__(self, relpath: str, source: str, tree: ast.Module):
+        self.relpath = relpath
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = tree
+        self.jit = JitContext(tree)
+        self.jax_random_aliases = jax_random_aliases(tree)
+        self._symbols: Dict[int, str] = {}
+        self._index_symbols(tree, "")
+
+    def _index_symbols(self, node: ast.AST, qual: str) -> None:
+        # every node maps to its innermost enclosing def/class qualname
+        for child in ast.iter_child_nodes(node):
+            self._symbols[id(child)] = qual
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                self._index_symbols(
+                    child, f"{qual}.{child.name}" if qual else child.name)
+            else:
+                self._index_symbols(child, qual)
+
+    def symbol_at(self, node: ast.AST) -> str:
+        return self._symbols.get(id(node), "")
+
+    def top_level_functions(self):
+        """Function scopes that are not nested inside another function
+        (methods included); nested defs are analyzed as part of their
+        enclosing scope so closures share PRNG-key state."""
+        out = []
+
+        def rec(node, in_function: bool):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    if not in_function:
+                        out.append(child)
+                    rec(child, True)
+                else:
+                    rec(child, in_function)
+
+        rec(self.tree, False)
+        return out
+
+
+def parse_suppressions(source: str) -> Dict[int, Set[str]]:
+    """line number -> set of suppressed codes ('all' suppresses any).
+
+    Tokenized, not line-scanned: a docstring that merely QUOTES the pragma
+    syntax must not register a live suppression and punch a hole in the
+    gate."""
+    out: Dict[int, Set[str]] = {}
+    try:
+        tokens = list(tokenize.generate_tokens(io.StringIO(source).readline))
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        return out  # unparseable files already fail the gate via DV000
+    for tok in tokens:
+        if tok.type != tokenize.COMMENT:
+            continue
+        m = _SUPPRESS_RE.search(tok.string)
+        if not m:
+            continue
+        raw = m.group(1).strip()
+        if raw == "all":
+            codes = {"all"}
+        else:
+            codes = {c.strip().upper() for c in raw.split(",") if c.strip()}
+        i = tok.start[0]
+        out.setdefault(i, set()).update(codes)
+        # a pragma on its own line acknowledges the statement BELOW it; a
+        # trailing pragma covers only its own line, so a new violation
+        # added under it still fails the gate
+        if not tok.line[:tok.start[1]].strip():
+            out.setdefault(i + 1, set()).update(codes)
+    return out
+
+
+def _suppressed(f: Finding, supp: Dict[int, Set[str]]) -> bool:
+    codes = supp.get(f.line)
+    return bool(codes) and ("all" in codes or f.code in codes)
+
+
+def lint_source(source: str, relpath: str,
+                select: Optional[Iterable[str]] = None,
+                disable: Optional[Iterable[str]] = None):
+    """-> (findings, suppressed_findings). Parse errors come back as a
+    single DV000 error finding so a syntax-broken file fails the gate
+    rather than silently passing it."""
+    try:
+        tree = ast.parse(source, filename=relpath)
+    except SyntaxError as e:
+        return [Finding("DV000", f"file does not parse: {e.msg}", relpath,
+                        e.lineno or 0, (e.offset or 0), "error")], []
+    ctx = ModuleCtx(relpath, source, tree)
+    enabled = set(select) if select else set(RULES)
+    if disable:
+        enabled -= set(disable)
+    findings: List[Finding] = []
+    for code in sorted(enabled):
+        if code not in RULES:
+            continue
+        _, _, check, _ = RULES[code]
+        findings.extend(check(ctx))
+    findings.sort(key=lambda f: (f.line, f.col, f.code))
+    supp = parse_suppressions(source)
+    kept = [f for f in findings if not _suppressed(f, supp)]
+    dropped = [f for f in findings if _suppressed(f, supp)]
+    return kept, dropped
+
+
+def iter_python_files(paths: Iterable[str],
+                      exclude: Iterable[str] = (),
+                      root: Optional[str] = None) -> List[str]:
+    """Expand files/dirs into a sorted .py file list, skipping caches and
+    any path whose `root`-relative form starts with an exclude prefix
+    (so `tools` excludes tools/ but not deep_vision_tpu/tools/)."""
+    out: List[str] = []
+    root = os.path.abspath(root or os.getcwd())
+    exclude = tuple(os.path.normpath(e).replace(os.sep, "/")
+                    for e in exclude)
+
+    def excluded(p: str) -> bool:
+        rel = os.path.relpath(os.path.abspath(p), root).replace(os.sep, "/")
+        return any(rel == e or rel.startswith(e + "/") for e in exclude)
+
+    for path in paths:
+        if os.path.isfile(path):
+            if path.endswith(".py") and not excluded(path):
+                out.append(path)
+            continue
+        for dirpath, dirnames, filenames in os.walk(path):
+            dirnames[:] = [d for d in sorted(dirnames)
+                           if d != "__pycache__" and not d.startswith(".")]
+            if excluded(dirpath):
+                dirnames[:] = []
+                continue
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    full = os.path.join(dirpath, fn)
+                    if not excluded(full):
+                        out.append(full)
+    return out
+
+
+def lint_paths(paths: Iterable[str], root: Optional[str] = None,
+               select: Optional[Iterable[str]] = None,
+               disable: Optional[Iterable[str]] = None,
+               exclude: Iterable[str] = ()):
+    """-> (findings, suppressed, n_files). Paths in findings are relative
+    to `root` (default cwd) with forward slashes, so baselines are
+    machine-portable."""
+    root = os.path.abspath(root or os.getcwd())
+    findings: List[Finding] = []
+    suppressed: List[Finding] = []
+    for p in paths:
+        if not os.path.exists(p):
+            # a typo'd [tool.jaxlint] path must not silently disable the
+            # gate by linting zero files
+            rel = os.path.relpath(os.path.abspath(p), root).replace(
+                os.sep, "/")
+            findings.append(Finding(
+                "DV000", "configured lint path does not exist", rel, 0, 0,
+                "error"))
+    files = iter_python_files(paths, exclude, root=root)
+    for path in files:
+        rel = os.path.relpath(os.path.abspath(path), root).replace(
+            os.sep, "/")
+        try:
+            with open(path, encoding="utf-8") as f:
+                source = f.read()
+        except (OSError, UnicodeDecodeError) as e:
+            findings.append(Finding("DV000", f"unreadable: {e}", rel, 0, 0,
+                                    "error"))
+            continue
+        kept, dropped = lint_source(source, rel, select=select,
+                                    disable=disable)
+        findings.extend(kept)
+        suppressed.extend(dropped)
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.code))
+    return findings, suppressed, len(files)

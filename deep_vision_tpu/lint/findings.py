@@ -1,0 +1,87 @@
+"""Finding record + baseline file format.
+
+A baseline entry deliberately carries no line number: line drift from
+unrelated edits must not resurrect an accepted finding, so matching is
+on (code, path, symbol, message) with multiplicity — two identical
+findings in one function need two baseline entries.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+from collections import Counter
+from typing import Dict, List, Tuple
+
+BASELINE_VERSION = 1
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    code: str  # "DV001"
+    message: str
+    path: str  # repo-relative, forward slashes
+    line: int
+    col: int
+    severity: str  # "error" | "warning"
+    symbol: str = ""  # enclosing function qualname, "" at module level
+
+    def key(self) -> Tuple[str, str, str, str]:
+        return (self.code, self.path, self.symbol, self.message)
+
+    def to_dict(self) -> Dict:
+        return dataclasses.asdict(self)
+
+    def render(self) -> str:
+        where = f" (in {self.symbol})" if self.symbol else ""
+        return (f"{self.path}:{self.line}:{self.col}: {self.code} "
+                f"[{self.severity}] {self.message}{where}")
+
+
+def load_baseline(path: str) -> Counter:
+    """Baseline file -> Counter of finding keys (missing file = empty)."""
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except FileNotFoundError:
+        return Counter()
+    except json.JSONDecodeError as e:
+        raise ValueError(f"{path}: baseline is not valid JSON: {e}")
+    if not isinstance(doc, dict) or doc.get("version") != BASELINE_VERSION:
+        raise ValueError(f"{path}: not a v{BASELINE_VERSION} jaxlint baseline")
+    counts: Counter = Counter()
+    for i, row in enumerate(doc.get("findings", [])):
+        if not isinstance(row, dict) or \
+                any(k not in row for k in ("code", "path", "message")):
+            raise ValueError(
+                f"{path}: findings[{i}] is missing code/path/message; "
+                "regenerate with `make lint-baseline`")
+        counts[(row["code"], row["path"], row.get("symbol", ""),
+                row["message"])] += 1
+    return counts
+
+
+def save_baseline(path: str, findings: List[Finding]) -> None:
+    rows = [
+        {"code": f.code, "path": f.path, "symbol": f.symbol,
+         "message": f.message}
+        for f in sorted(findings, key=lambda f: (f.path, f.line, f.code))
+    ]
+    with open(path, "w") as f:
+        json.dump({"version": BASELINE_VERSION, "findings": rows}, f,
+                  indent=2, sort_keys=False)
+        f.write("\n")
+
+
+def split_baselined(findings: List[Finding],
+                    baseline: Counter) -> Tuple[List[Finding], List[Finding]]:
+    """-> (new findings, baselined findings); consumes baseline entries so
+    N accepted occurrences admit exactly N findings."""
+    budget = Counter(baseline)
+    fresh, accepted = [], []
+    for f in findings:
+        if budget[f.key()] > 0:
+            budget[f.key()] -= 1
+            accepted.append(f)
+        else:
+            fresh.append(f)
+    return fresh, accepted

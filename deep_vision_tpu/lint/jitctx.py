@@ -1,0 +1,245 @@
+"""Jit-context resolver: which functions in a module end up traced.
+
+The rules (DV001 host-sync, DV005 impurity, DV006 python-branch) only
+apply *inside* code that XLA traces — and in this codebase the jit
+boundary is rarely a decorator. The Trainer jits bound methods
+(`jax.jit(self._train_step_impl, donate_argnums=0)`), inference jits
+partials (`jax.jit(functools.partial(yolo_detect, ...))`), the parallel
+layer hands bodies to `jax.shard_map`, and checkify wraps the step
+before the jit sees it. This module resolves all of those shapes to the
+`ast.FunctionDef`s whose bodies are traced, plus the list of jit
+*binding sites* (with their donation kwargs) that DV003 audits.
+
+Resolution is intra-module by design: a name passed to `jax.jit` is
+looked up among the module's function defs (at any nesting depth) after
+chasing simple aliases (`x = f`, `x = functools.partial(f, ...)`,
+`x = checkify.checkify(f)`). Cross-module calls from inside a traced
+body are not followed — the rules stay local and predictable, and the
+suppression syntax covers the rare miss.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, List, Optional, Set, Union
+
+FunctionNode = Union[ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda]
+
+# a call/decorator whose last dotted component is one of these IS the
+# jit boundary (jax.jit, pjit, flax.linen.jit, bare `jit` import)
+JIT_NAMES = {"jit", "pjit"}
+
+# transforms that trace their callable argument without being a jit
+# binding site of their own (no donation contract to audit)
+TRACER_CONSUMERS = {
+    "grad", "value_and_grad", "vmap", "pmap", "checkpoint", "remat",
+    "shard_map", "scan", "while_loop", "cond", "fori_loop", "map",
+    "switch", "associative_scan", "custom_vjp", "custom_jvp", "checkify",
+}
+
+# consumer names that collide with Python builtins/common identifiers: as a
+# BARE name (`map(fn, xs)`) they are almost certainly not JAX — require the
+# dotted form (`jax.lax.map`, `lax.scan`, `jax.checkpoint`) to count
+AMBIGUOUS_BARE = {"map", "checkpoint", "cond", "scan", "switch"}
+
+# wrappers that forward their first argument's body into the trace
+PASSTHROUGH = {"partial", "checkify", "named_call", "wraps"}
+
+
+def is_consumer_expr(node: ast.AST) -> bool:
+    name = last_name(node)
+    if name not in TRACER_CONSUMERS:
+        return False
+    if isinstance(node, ast.Name) and name in AMBIGUOUS_BARE:
+        return False
+    return True
+
+
+def jax_random_aliases(tree: ast.Module) -> set:
+    """Local names bound to the jax.random module (`from jax import random`,
+    `import jax.random as jr`), so rules can recognize `random.normal(...)`
+    as a JAX sampler rather than stdlib impurity."""
+    out = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module == "jax":
+            for a in node.names:
+                if a.name == "random":
+                    out.add(a.asname or a.name)
+        elif isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "jax.random" and a.asname:
+                    out.add(a.asname)
+    return out
+
+
+def last_name(node: ast.AST) -> Optional[str]:
+    """foo -> 'foo'; a.b.jit -> 'jit'; anything else -> None."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def root_name(node: ast.AST) -> Optional[str]:
+    """np.random.normal -> 'np'; foo -> 'foo'."""
+    while isinstance(node, ast.Attribute):
+        node = node.value
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def is_jit_expr(node: ast.AST) -> bool:
+    return last_name(node) in JIT_NAMES
+
+
+def has_donation(call: ast.Call) -> bool:
+    return any(
+        kw.arg in ("donate_argnums", "donate_argnames") for kw in call.keywords
+    )
+
+
+@dataclasses.dataclass
+class JitSite:
+    """One place a function is bound to jax.jit/pjit."""
+
+    node: ast.AST  # the Call or decorator expression (has lineno/col)
+    target: Optional[FunctionNode]  # resolved def, if intra-module
+    target_name: str  # best-effort name of what was jitted
+    donated: bool  # donate_argnums/donate_argnames present
+
+
+class JitContext:
+    """Per-module map of traced functions and jit binding sites."""
+
+    def __init__(self, tree: ast.Module):
+        self.tree = tree
+        self.sites: List[JitSite] = []
+        self.traced: Set[FunctionNode] = set()
+        self._defs: Dict[str, List[FunctionNode]] = {}
+        self._aliases: Dict[str, ast.AST] = {}
+        self._collect_defs()
+        self._collect_aliases()
+        self._scan()
+
+    # -- indexing ----------------------------------------------------------
+    def _collect_defs(self) -> None:
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._defs.setdefault(node.name, []).append(node)
+
+    def _collect_aliases(self) -> None:
+        # simple single-target assigns: x = f / x = partial(f, ...) /
+        # x = checkify.checkify(f). Last write wins; good enough for the
+        # straight-line jit wiring these modules use.
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                t = node.targets[0]
+                if isinstance(t, ast.Name):
+                    self._aliases[t.id] = node.value
+
+    def _unwrap(self, node: ast.AST, depth: int = 0):
+        """Chase an expression to ('name', str) | ('lambda', node) | None."""
+        if depth > 6 or node is None:
+            return None
+        if isinstance(node, ast.Lambda):
+            return ("lambda", node)
+        if isinstance(node, ast.Name):
+            aliased = self._aliases.get(node.id)
+            if aliased is not None and not isinstance(aliased, ast.Name):
+                resolved = self._unwrap(aliased, depth + 1)
+                if resolved is not None:
+                    return resolved
+            elif isinstance(aliased, ast.Name) and aliased.id != node.id:
+                return self._unwrap(aliased, depth + 1)
+            return ("name", node.id)
+        if isinstance(node, ast.Attribute):
+            # self._train_step_impl / module.fn: match by trailing name
+            return ("name", node.attr)
+        if isinstance(node, ast.Call) and last_name(node.func) in PASSTHROUGH:
+            if node.args:
+                return self._unwrap(node.args[0], depth + 1)
+        return None
+
+    def _resolve(self, node: ast.AST):
+        """-> (target FunctionNode or None, display name)."""
+        resolved = self._unwrap(node)
+        if resolved is None:
+            return None, last_name(node) or "<expr>"
+        kind, val = resolved
+        if kind == "lambda":
+            return val, "<lambda>"
+        defs = self._defs.get(val, [])
+        return (defs[-1] if defs else None), val
+
+    # -- site + consumer scan ----------------------------------------------
+    def _mark(self, target: Optional[FunctionNode]) -> None:
+        if target is not None:
+            self.traced.add(target)
+
+    def _scan(self) -> None:
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Call):
+                fname = last_name(node.func)
+                if fname in JIT_NAMES and node.args:
+                    target, name = self._resolve(node.args[0])
+                    self._mark(target)
+                    self.sites.append(
+                        JitSite(node, target, name, has_donation(node))
+                    )
+                elif node.args and is_consumer_expr(node.func):
+                    target, _ = self._resolve(node.args[0])
+                    if target is None and fname in ("scan", "while_loop",
+                                                    "cond", "fori_loop",
+                                                    "switch", "map"):
+                        # lax control flow takes the callable at varying
+                        # positions; try every argument
+                        for arg in node.args:
+                            t, _ = self._resolve(arg)
+                            self._mark(t)
+                    else:
+                        self._mark(target)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._scan_decorators(node)
+
+    def _scan_decorators(self, fn) -> None:
+        for dec in fn.decorator_list:
+            if is_jit_expr(dec):
+                self.traced.add(fn)
+                self.sites.append(JitSite(dec, fn, fn.name, False))
+            elif isinstance(dec, ast.Call):
+                if is_jit_expr(dec.func):
+                    self.traced.add(fn)
+                    self.sites.append(
+                        JitSite(dec, fn, fn.name, has_donation(dec))
+                    )
+                elif last_name(dec.func) == "partial" and dec.args and \
+                        is_jit_expr(dec.args[0]):
+                    # @functools.partial(jax.jit, static_argnums=...)
+                    self.traced.add(fn)
+                    self.sites.append(
+                        JitSite(dec, fn, fn.name, has_donation(dec))
+                    )
+                elif is_consumer_expr(dec.func):
+                    self.traced.add(fn)
+            elif is_consumer_expr(dec):
+                self.traced.add(fn)
+
+    # -- queries ------------------------------------------------------------
+    def traced_functions(self) -> List[FunctionNode]:
+        """Traced bodies, outermost first; nested defs inside a traced
+        function are covered by walking the parent subtree, so they are
+        not listed twice."""
+        covered: Set[int] = set()
+        out: List[FunctionNode] = []
+        for fn in sorted(self.traced, key=lambda n: (n.lineno,
+                                                     n.col_offset)):
+            if id(fn) in covered:
+                continue
+            out.append(fn)
+            for sub in ast.walk(fn):
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                    ast.Lambda)):
+                    covered.add(id(sub))
+        return out

@@ -1,0 +1,663 @@
+"""The DV rule set: each rule is `check(ctx) -> list[Finding]`.
+
+Codes map 1:1 onto the runtime signals the obs/ layer already exposes —
+the linter catches at review time what the telemetry catches after the
+TPU hours are spent (DV001 <-> dispatch-time breakdown, DV004 <->
+recompile counter, DV005/DV002 <-> irreproducible runs the journal can
+only record). See lint/README.md for the full catalog with fix recipes.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import List, Optional
+
+from deep_vision_tpu.lint.findings import Finding
+from deep_vision_tpu.lint.jitctx import last_name, root_name
+
+NUMPY_ROOTS = {"np", "numpy", "onp"}
+
+
+def _finding(ctx, code: str, node: ast.AST, message: str,
+             severity: str = "error") -> Finding:
+    return Finding(
+        code=code,
+        message=message,
+        path=ctx.relpath,
+        line=getattr(node, "lineno", 0),
+        col=getattr(node, "col_offset", 0) + 1,
+        severity=severity,
+        symbol=ctx.symbol_at(node),
+    )
+
+
+def _positional_params(fn) -> List[str]:
+    """Positional parameter names, minus self/cls. Keyword-only params are
+    excluded on purpose: in this codebase those are static config threaded
+    through functools.partial (causal=..., axis_name=...), not traced
+    arrays."""
+    args = fn.args
+    names = [a.arg for a in args.posonlyargs + args.args]
+    return [n for n in names if n not in ("self", "cls")]
+
+
+# -- DV001 host-sync-in-jit --------------------------------------------------
+
+_CASTS = {"float", "int", "bool"}
+_STATIC_ATTRS = {"shape", "ndim", "size", "dtype"}
+
+
+def _is_static_expr(node: ast.AST) -> bool:
+    """True when the expression is shape/metadata arithmetic (static under
+    trace) rather than a device value: literals, `.shape`/`.ndim`/len().
+    Every leaf must be static — `float(x.mean() * x.shape[0])` is still a
+    per-step sync even though shape metadata appears in it."""
+    if isinstance(node, ast.Constant):
+        return True
+    if isinstance(node, ast.Attribute):
+        return node.attr in _STATIC_ATTRS
+    if isinstance(node, ast.Subscript):
+        # indexing metadata (x.shape[0], x.shape[i]) stays metadata
+        return _is_static_expr(node.value)
+    if isinstance(node, ast.Call):
+        return last_name(node.func) == "len"
+    if isinstance(node, ast.Name):
+        return False
+    children = [c for c in ast.iter_child_nodes(node)
+                if isinstance(c, ast.expr)]
+    return bool(children) and all(_is_static_expr(c) for c in children)
+
+
+def check_dv001(ctx) -> List[Finding]:
+    """Host synchronization inside a traced function."""
+    out: List[Finding] = []
+    for fn in ctx.jit.traced_functions():
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if isinstance(f, ast.Attribute):
+                if f.attr == "item" and not node.args:
+                    out.append(_finding(
+                        ctx, "DV001", node,
+                        ".item() inside a jitted function forces a "
+                        "device->host sync every step; return the array and "
+                        "fetch on the host"))
+                elif f.attr == "block_until_ready":
+                    out.append(_finding(
+                        ctx, "DV001", node,
+                        "block_until_ready inside a jitted function stalls "
+                        "the dispatch pipeline; fence outside the jit "
+                        "boundary"))
+                elif f.attr == "device_get" and root_name(f) == "jax":
+                    out.append(_finding(
+                        ctx, "DV001", node,
+                        "jax.device_get inside a jitted function "
+                        "materializes on host; fetch after the step "
+                        "returns"))
+                elif f.attr in ("asarray", "array") and \
+                        root_name(f) in NUMPY_ROOTS and node.args and \
+                        not _is_static_expr(node.args[0]):
+                    # constant tables built from literals are folded at
+                    # trace time and legal; only a traced value breaks out
+                    out.append(_finding(
+                        ctx, "DV001", node,
+                        f"np.{f.attr} on a traced value pulls it to host "
+                        "and breaks the trace; use jnp." + f.attr))
+            elif isinstance(f, ast.Name):
+                if f.id == "print" and not all(
+                        _is_static_expr(a) for a in node.args):
+                    # print("literal") is a harmless trace-time log;
+                    # only printing something traced is the hazard
+                    out.append(_finding(
+                        ctx, "DV001", node,
+                        "print of a traced value runs at trace time (once), "
+                        "not per step; use jax.debug.print"))
+                elif f.id in _CASTS and node.args and \
+                        not _is_static_expr(node.args[0]):
+                    out.append(_finding(
+                        ctx, "DV001", node,
+                        f"{f.id}() on a traced value is a concretization "
+                        "error or hidden sync; keep it an array (casts on "
+                        ".shape/.ndim are fine)"))
+    return out
+
+
+# -- DV002 prng-key-reuse ----------------------------------------------------
+
+_KEY_MAKERS = {"PRNGKey", "key", "wrap_key_data"}
+_KEY_DERIVERS = {"split", "fold_in", "clone"}
+
+
+def _jax_random_callee(call: ast.Call,
+                       aliases: frozenset = frozenset()) -> Optional[str]:
+    """'normal' for jax.random.normal(...) — also through a local alias of
+    the jax.random module (`from jax import random`) — else None."""
+    f = call.func
+    if isinstance(f, ast.Attribute):
+        if isinstance(f.value, ast.Attribute) and f.value.attr == "random" \
+                and root_name(f) == "jax":
+            return f.attr
+        if isinstance(f.value, ast.Name) and f.value.id in aliases:
+            return f.attr
+    return None
+
+
+def _is_key_origin(value: ast.AST, aliases: frozenset = frozenset()) -> bool:
+    """Does this assigned expression mint or derive a PRNG key? Top-level
+    only: `state = create_train_state(..., PRNGKey(0))` consumes a key, it
+    does not produce one, so nested calls must not count."""
+    if isinstance(value, ast.IfExp):
+        return _is_key_origin(value.body, aliases) or \
+            _is_key_origin(value.orelse, aliases)
+    if isinstance(value, (ast.Tuple, ast.List)):
+        return any(_is_key_origin(e, aliases) for e in value.elts)
+    if isinstance(value, ast.Call):
+        return _jax_random_callee(value, aliases) in (
+            _KEY_MAKERS | _KEY_DERIVERS)
+    return False
+
+
+def _key_name(expr: ast.AST) -> Optional[str]:
+    """'rng' for a bare name, 'r[6]' for a constant-indexed subscript of
+    one (the split-then-index idiom — two uses of r[6] are as correlated
+    as two uses of rng); None for anything else."""
+    if isinstance(expr, ast.Name):
+        return expr.id
+    if isinstance(expr, ast.Subscript) and \
+            isinstance(expr.value, ast.Name) and \
+            isinstance(expr.slice, ast.Constant):
+        return f"{expr.value.id}[{expr.slice.value!r}]"
+    return None
+
+
+def _key_base(name: str) -> str:
+    return name.split("[", 1)[0]
+
+
+def _bare_names(expr: ast.AST) -> List[str]:
+    """Names passed directly (not through attribute access, and not inside
+    nested calls — those get their own consumption event). Constant-indexed
+    subscripts count under their 'r[6]' spelling."""
+    out: List[str] = []
+
+    def rec(n):
+        if isinstance(n, ast.Subscript):
+            kn = _key_name(n)
+            if kn is not None:
+                out.append(kn)
+            return
+        if isinstance(n, (ast.Call, ast.Attribute, ast.Lambda)):
+            return
+        if isinstance(n, ast.Name):
+            out.append(n.id)
+            return
+        for child in ast.iter_child_nodes(n):
+            rec(child)
+
+    rec(expr)
+    return out
+
+
+def _assigned_names(node) -> List[str]:
+    targets = []
+    if isinstance(node, ast.Assign):
+        targets = node.targets
+    elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+        targets = [node.target]
+    elif isinstance(node, (ast.For, ast.AsyncFor)):
+        targets = [node.target]
+    names: List[str] = []
+    for t in targets:
+        for sub in ast.walk(t):
+            if isinstance(sub, ast.Name):
+                names.append(sub.id)
+    return names
+
+
+def check_dv002(ctx) -> List[Finding]:
+    """The same PRNG key consumed twice without a split/fold_in between."""
+    out: List[Finding] = []
+    for scope in ctx.top_level_functions():
+        out.extend(_dv002_scope(ctx, scope))
+    return out
+
+
+def _dv002_scope(ctx, scope) -> List[Finding]:
+    aliases = frozenset(getattr(ctx, "jax_random_aliases", ()))
+    parents = {}
+    for parent in ast.walk(scope):
+        for child in ast.iter_child_nodes(parent):
+            parents[id(child)] = parent
+
+    def loops_of(node) -> frozenset:
+        loops, cur = [], node
+        while id(cur) in parents:
+            cur = parents[id(cur)]
+            if isinstance(cur, (ast.For, ast.While, ast.AsyncFor)):
+                loops.append(id(cur))
+        return frozenset(loops)
+
+    def arms_of(node) -> frozenset:
+        """(if-node, arm) pairs enclosing this node: two consumes in
+        opposite arms of the same if never both execute, so they are one
+        use each, not a reuse. Code after an if whose taken arm always
+        returns/raises belongs to the other arm in effect."""
+        arms, cur = [], node
+        while id(cur) in parents:
+            parent = parents[id(cur)]
+            if isinstance(parent, (ast.If, ast.IfExp)):
+                body = parent.body if isinstance(parent.body, list) \
+                    else [parent.body]
+                orelse = parent.orelse if isinstance(parent.orelse, list) \
+                    else [parent.orelse]
+                if cur in body:
+                    arms.append((id(parent), "body"))
+                elif cur in orelse:
+                    arms.append((id(parent), "orelse"))
+            def after_if(prev):
+                # recurse through elif chains: code after `if: return /
+                # elif: return` is exclusive with every terminal arm
+                if _terminal(prev.body):
+                    arms.append((id(prev), "orelse"))
+                    for s in prev.orelse:
+                        if isinstance(s, ast.If):
+                            after_if(s)
+                elif _terminal(prev.orelse):
+                    arms.append((id(prev), "body"))
+                    for s in prev.body:
+                        if isinstance(s, ast.If):
+                            after_if(s)
+
+            for field in ("body", "orelse", "finalbody"):
+                block = getattr(parent, field, None)
+                if isinstance(block, list) and cur in block:
+                    for prev in block[:block.index(cur)]:
+                        if isinstance(prev, ast.If):
+                            after_if(prev)
+            cur = parent
+        return frozenset(arms)
+
+    events = []  # (line, col, kind, name, node)
+    for node in ast.walk(scope):
+        if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign,
+                             ast.For, ast.AsyncFor)):
+            value = getattr(node, "value", None) or getattr(node, "iter", None)
+            origin = value is not None and _is_key_origin(value, aliases)
+            # the binding takes effect AFTER the RHS runs: sort the assign
+            # event past the value's end so `key = fold_in(key, i)` charges
+            # the RHS consume to the OLD binding, not the fresh one
+            if value is not None and getattr(value, "end_lineno", None):
+                pos = (value.end_lineno, (value.end_col_offset or 0) + 1)
+            else:
+                pos = (node.lineno, node.col_offset)
+            for name in _assigned_names(node):
+                events.append((pos[0], pos[1],
+                               "key_assign" if origin else "assign",
+                               name, node))
+        elif isinstance(node, ast.Call):
+            jr = _jax_random_callee(node, aliases)
+            if jr is not None and jr not in _KEY_MAKERS:
+                # sampler or split/fold_in: consumes its first argument.
+                # Derivers are tagged: `fold_in(key, i)` inside a loop is
+                # the per-iteration idiom, not a reuse.
+                kn = _key_name(node.args[0]) if node.args else None
+                if kn is not None:
+                    kind = "derive" if jr in _KEY_DERIVERS else "consume"
+                    events.append((node.lineno, node.col_offset, kind,
+                                   kn, node))
+            elif jr is None:
+                # generic call: a tracked key passed through (model apply,
+                # helper fn, rngs={...}) is consumed by the callee. One use
+                # per call even if the key appears twice in its arguments.
+                argexprs = list(node.args) + [kw.value for kw in node.keywords]
+                seen = set()
+                for expr in argexprs:
+                    for name in _bare_names(expr):
+                        if name not in seen:
+                            seen.add(name)
+                            events.append((node.lineno, node.col_offset,
+                                           "use", name, node))
+    events.sort(key=lambda e: (e[0], e[1]))
+
+    out: List[Finding] = []
+    tracked = {}  # name -> {'paths': [arm-sets], 'assign_loops': frozenset}
+    consumed_keys = {}  # names seen as jax.random sampler args (implicit)
+    derives = {}  # name -> [(fingerprint, arm-set)] of split/fold_in calls
+
+    def invalidate(name):
+        # rebinding `r` also retires every tracked `r[i]` subkey
+        for store in (tracked, consumed_keys, derives):
+            store.pop(name, None)
+            for k in [k for k in store if _key_base(k) == name]:
+                del store[k]
+
+    def implicit(name):
+        # a subscripted use inherits its split's loop context: `r[6]`
+        # consumed in a loop that `r = split(...)` sits outside is a reuse
+        base = tracked.get(_key_base(name))
+        loops = base["assign_loops"] if base else loops_of(scope)
+        return consumed_keys.setdefault(
+            name, {"paths": [], "assign_loops": loops})
+
+    for line, col, kind, name, node in events:
+        if kind == "key_assign":
+            assign_loops = loops_of(node)
+            if isinstance(node, (ast.For, ast.AsyncFor)):
+                # `for k in jax.random.split(...)` binds a fresh key per
+                # iteration: the For is the key's own loop, not a reuse site
+                assign_loops |= {id(node)}
+            invalidate(name)
+            tracked[name] = {"paths": [], "assign_loops": assign_loops}
+        elif kind == "assign":
+            invalidate(name)
+        elif kind == "derive":
+            # split/fold_in are the sanctioned reuse forms: deriving the
+            # same key twice is only a bug when the data arguments are
+            # identical (split(key) twice yields identical subkeys) —
+            # fold_in(key, 0) / fold_in(key, 1) is the canonical per-index
+            # idiom and must not flag. No loop check either: fold_in(key, i)
+            # inside the loop is the per-iteration fix.
+            fp = _derive_fingerprint(node)
+            prior = derives.setdefault(name, [])
+            use_arms = arms_of(node)
+            if any(f == fp and not _arms_exclusive(a, use_arms)
+                   for f, a in prior):
+                out.append(_finding(
+                    ctx, "DV002", node,
+                    f"PRNG key '{name}' is derived again with identical "
+                    "arguments: split/fold_in of the same key with the "
+                    "same inputs yields identical keys"))
+            prior.append((fp, use_arms))
+        elif kind == "consume":
+            # the textbook bug: sampling from a key AFTER splitting it —
+            # the parent's stream is correlated with its subkeys, so the
+            # parent must be discarded (or rebound: `key, sub = split(key)`)
+            use_arms = arms_of(node)
+            if any(not _arms_exclusive(a, use_arms)
+                   for _, a in derives.get(name, [])):
+                out.append(_finding(
+                    ctx, "DV002", node,
+                    f"PRNG key '{name}' is consumed after being "
+                    "split/folded; the parent key is correlated with its "
+                    "subkeys — use a derived key instead"))
+            # parameter or untracked name used directly as a key: start
+            # implicit tracking so a second sampler use flags
+            rec = tracked.get(name) or implicit(name)
+            _dv002_use(ctx, out, rec, name, node, loops_of(node),
+                       use_arms)
+        elif kind == "use":
+            rec = tracked.get(name) or consumed_keys.get(name)
+            if rec is None and "[" in name and _key_base(name) in tracked:
+                # r[6] passed to a generic call with `r` a tracked split:
+                # each subkey gets one use, a second one is the gan.py bug
+                rec = implicit(name)
+            if rec is not None:
+                _dv002_use(ctx, out, rec, name, node, loops_of(node),
+                           arms_of(node))
+    return out
+
+
+def _terminal(stmts) -> bool:
+    """Does this statement block always leave the enclosing scope/block?"""
+    return bool(stmts) and isinstance(
+        stmts[-1], (ast.Return, ast.Raise, ast.Break, ast.Continue))
+
+
+def _arms_exclusive(a: frozenset, b: frozenset) -> bool:
+    """Two events sit in opposite arms of the same if: at most one runs."""
+    flip = {"body": "orelse", "orelse": "body"}
+    return any((if_id, flip[arm]) in b for if_id, arm in a)
+
+
+def _derive_fingerprint(call: ast.Call) -> tuple:
+    """Identity of a split/fold_in call minus its key argument: two derives
+    of one key collide only when every data argument is identical. A bare
+    split(key) is normalized to its num=2 default so split(key) and
+    split(key, 2) collide."""
+    f = call.func
+    fn = f.attr if isinstance(f, ast.Attribute) else getattr(f, "id", "")
+    data = tuple(ast.dump(a) for a in call.args[1:])
+    kws = tuple(sorted((kw.arg or "", ast.dump(kw.value))
+                       for kw in call.keywords))
+    if fn == "split" and not data and not kws:
+        data = (ast.dump(ast.Constant(2)),)
+    return (fn, data, kws)
+
+
+def _dv002_use(ctx, out, rec, name, node, use_loops, use_arms) -> None:
+    fresh_loops = use_loops - rec["assign_loops"]
+    # a prior consume on a branch path that can co-execute with this one
+    # is a reuse; consumes in mutually exclusive if/else arms are not
+    reuse = any(not _arms_exclusive(prev, use_arms)
+                for prev in rec["paths"])
+    rec["paths"].append(use_arms)
+    if reuse:
+        out.append(_finding(
+            ctx, "DV002", node,
+            f"PRNG key '{name}' is consumed again without an intervening "
+            "jax.random.split/fold_in: correlated randomness"))
+    elif fresh_loops:
+        out.append(_finding(
+            ctx, "DV002", node,
+            f"PRNG key '{name}' is consumed inside a loop but derived "
+            "outside it: every iteration sees the same randomness; "
+            "fold_in the iteration index"))
+
+
+# -- DV003 missing-donation --------------------------------------------------
+
+_DV003_TARGET = re.compile(r"step|update|train", re.I)
+_DV003_EXCLUDE = re.compile(
+    r"eval|infer|predict|sample|generate|forward|fwd|decode|loss|apply", re.I)
+_STATEFUL_PARAM = re.compile(r"(^|_)(state|params)$|^(opt|g|d)_?state")
+
+
+def check_dv003(ctx) -> List[Finding]:
+    """Jitted train/update steps that never donate their state buffers."""
+    out: List[Finding] = []
+    for site in ctx.jit.sites:
+        if site.donated:
+            continue
+        name = site.target_name or ""
+        if not _DV003_TARGET.search(name) or _DV003_EXCLUDE.search(name):
+            continue
+        if site.target is not None and not isinstance(site.target,
+                                                      ast.Lambda):
+            params = _positional_params(site.target)
+            if not any(_STATEFUL_PARAM.search(p) for p in params):
+                continue
+        out.append(_finding(
+            ctx, "DV003", site.node,
+            f"jitted step '{name}' takes a params/opt-state pytree but "
+            "declares no donate_argnums/donate_argnames: the old state "
+            "stays resident and doubles peak HBM"))
+    return out
+
+
+# -- DV004 jit-in-loop -------------------------------------------------------
+
+def check_dv004(ctx) -> List[Finding]:
+    """jax.jit constructed (or re-applied) inside a loop body."""
+    out: List[Finding] = []
+
+    def _is_jax_jit(func: ast.AST) -> bool:
+        # bare `jit(...)` is almost certainly `from jax import jit`;
+        # an attribute call must root at jax (or the pjit module) so a
+        # non-JAX `.jit()` method (self.jit, compiler.jit) isn't flagged
+        if isinstance(func, ast.Name):
+            return func.id in ("jit", "pjit")
+        if isinstance(func, ast.Attribute):
+            return func.attr in ("jit", "pjit") and \
+                root_name(func) in ("jax", "pjit")
+        return False
+
+    def scan(node, in_loop: bool):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                # the def's body runs later, but its decorators run now
+                if in_loop:
+                    for dec in child.decorator_list:
+                        dt = dec if not isinstance(dec, ast.Call) \
+                            else dec.func
+                        if _is_jax_jit(dt):
+                            out.append(_finding(
+                                ctx, "DV004", dec,
+                                "a jit-decorated function defined inside a "
+                                "loop builds a fresh jit (and cache) every "
+                                "iteration; hoist the definition"))
+                scan(child, False)  # body executes when called, not per-iter
+                continue
+            if isinstance(child, ast.Lambda):
+                continue
+            if isinstance(child, ast.Call) and in_loop and \
+                    _is_jax_jit(child.func) and \
+                    (child.args or child.keywords):
+                out.append(_finding(
+                    ctx, "DV004", child,
+                    "jax.jit(...) inside a loop creates a new compiled "
+                    "function (and recompile) every iteration; hoist it "
+                    "out of the loop"))
+            scan(child, in_loop or isinstance(
+                child, (ast.For, ast.While, ast.AsyncFor)))
+
+    scan(ctx.tree, False)
+    return out
+
+
+# -- DV005 impure-jit --------------------------------------------------------
+
+_IMPURE_TIME = {"time", "perf_counter", "monotonic", "process_time",
+                "time_ns", "perf_counter_ns"}
+
+
+def check_dv005(ctx) -> List[Finding]:
+    """Side effects inside a traced function: silently frozen at trace time."""
+    out: List[Finding] = []
+    for fn in ctx.jit.traced_functions():
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                for t in targets:
+                    if isinstance(t, ast.Attribute) and \
+                            isinstance(t.value, ast.Name) and \
+                            t.value.id in ("self", "cls"):
+                        out.append(_finding(
+                            ctx, "DV005", node,
+                            f"assignment to {t.value.id}.{t.attr} inside a "
+                            "jitted function runs once at trace time, not "
+                            "per step; return the value instead"))
+            elif isinstance(node, (ast.Global, ast.Nonlocal)):
+                out.append(_finding(
+                    ctx, "DV005", node,
+                    f"{'global' if isinstance(node, ast.Global) else 'nonlocal'}"
+                    " write inside a jitted function is a trace-time side "
+                    "effect; thread the value through the return"))
+            elif isinstance(node, ast.Call):
+                f = node.func
+                if not isinstance(f, ast.Attribute):
+                    continue
+                root = root_name(f)
+                if root == "time" and f.attr in _IMPURE_TIME:
+                    out.append(_finding(
+                        ctx, "DV005", node,
+                        f"time.{f.attr}() inside a jitted function is "
+                        "evaluated once at trace time; time on the host "
+                        "around the step"))
+                elif root in NUMPY_ROOTS and isinstance(f.value,
+                                                        ast.Attribute) \
+                        and f.value.attr == "random":
+                    out.append(_finding(
+                        ctx, "DV005", node,
+                        f"np.random.{f.attr} inside a jitted function "
+                        "freezes one host sample into the trace; use "
+                        "jax.random with an explicit key"))
+                elif root == "random" and isinstance(f.value, ast.Name) \
+                        and f.value.id not in getattr(
+                            ctx, "jax_random_aliases", ()):
+                    out.append(_finding(
+                        ctx, "DV005", node,
+                        f"random.{f.attr} inside a jitted function freezes "
+                        "one host sample into the trace; use jax.random"))
+    return out
+
+
+# -- DV006 untraced-python-branch -------------------------------------------
+
+def _naked_param_refs(test: ast.AST, params) -> List[str]:
+    refs: List[str] = []
+
+    def rec(n):
+        if isinstance(n, ast.Attribute):
+            return  # x.shape, state.batch_stats: static structure
+        if isinstance(n, ast.Call):
+            return  # isinstance/len/... treated as static predicates
+        if isinstance(n, ast.Compare) and any(
+                isinstance(op, (ast.Is, ast.IsNot, ast.In, ast.NotIn))
+                for op in n.ops):
+            return  # `x is None` / `"k" in d`: argument-structure checks
+        if isinstance(n, ast.Name):
+            if n.id in params:
+                refs.append(n.id)
+            return
+        if isinstance(n, ast.Subscript):
+            if isinstance(n.value, ast.Name) and n.value.id in params:
+                refs.append(n.value.id)
+                return
+            rec(n.value)
+            return
+        for child in ast.iter_child_nodes(n):
+            rec(child)
+
+    rec(test)
+    return refs
+
+
+def check_dv006(ctx) -> List[Finding]:
+    """Python `if`/`while` on a traced argument (heuristic, warn-level)."""
+    out: List[Finding] = []
+    for fn in ctx.jit.traced_functions():
+        if isinstance(fn, ast.Lambda):
+            continue
+        # closures over the jitted function's arguments are traced too, so
+        # nested defs are checked against the union of positional params
+        params = set(_positional_params(fn))
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node is not fn:
+                params |= set(_positional_params(node))
+        if not params:
+            continue
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.If, ast.While)):
+                refs = _naked_param_refs(node.test, params)
+                if refs:
+                    kw = "while" if isinstance(node, ast.While) else "if"
+                    out.append(_finding(
+                        ctx, "DV006", node,
+                        f"Python `{kw}` on traced argument "
+                        f"'{refs[0]}' fails or retraces under jit; use "
+                        "jax.lax.cond/select (static config branches: "
+                        "suppress with a reason)",
+                        severity="warning"))
+    return out
+
+
+# -- registry ----------------------------------------------------------------
+
+RULES = {
+    "DV001": ("host-sync-in-jit", "error", check_dv001,
+              "device->host synchronization inside a traced function"),
+    "DV002": ("prng-key-reuse", "error", check_dv002,
+              "a PRNG key consumed twice without split/fold_in"),
+    "DV003": ("missing-donation", "error", check_dv003,
+              "jitted train/update step without donate_argnums"),
+    "DV004": ("jit-in-loop", "error", check_dv004,
+              "jax.jit constructed inside a loop body"),
+    "DV005": ("impure-jit", "error", check_dv005,
+              "host side effects inside a traced function"),
+    "DV006": ("untraced-python-branch", "warning", check_dv006,
+              "Python control flow on a traced argument"),
+}

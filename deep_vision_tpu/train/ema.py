@@ -19,11 +19,17 @@ and evaluates with the averaged weights.
 """
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 
 
-@jax.jit
+# donate the incoming EMA tree (DV003): update() immediately rebinds
+# self.params to the return value, so the old shadow buffer is dead the
+# moment this is called — donation lets XLA update it in place instead of
+# holding a second full-precision copy of the params in HBM
+@functools.partial(jax.jit, donate_argnums=0)
 def _ema_update(ema, params, decay):
     # debiasing handled by the warmup decay schedule below, not a division:
     # keeps the update a single fused pass with no extra state

@@ -118,18 +118,21 @@ class DcganTrainer:
 
     def _step_impl(self, g_state: TrainState, d_state: TrainState, real):
         rng = jax.random.fold_in(g_state.rng, g_state.step)
-        z_rng, g_rng, d_rng = jax.random.split(rng, 3)
+        # one subkey per network application (DV002): the discriminator runs
+        # three times here (G's adversarial pass, D on real, D on fake) and
+        # its dropout masks must be independent draws, not one mask reused
+        z_rng, g_rng, dg_rng, dr_rng, df_rng = jax.random.split(rng, 5)
         noise = jax.random.normal(z_rng, (real.shape[0], self.latent_dim))
 
         def g_loss_fn(g_params):
             fake, g_bs = _apply(g_state.replace(params=g_params), noise, g_rng)
-            fake_logits, _ = _apply(d_state, fake, d_rng)
+            fake_logits, _ = _apply(d_state, fake, dg_rng)
             return bce_generator_loss(fake_logits), (g_bs, fake)
 
         def d_loss_fn(d_params, fake):
             ds = d_state.replace(params=d_params)
-            real_logits, d_bs = _apply(ds, real, d_rng)
-            fake_logits, _ = _apply(ds, fake, d_rng)
+            real_logits, d_bs = _apply(ds, real, dr_rng)
+            fake_logits, _ = _apply(ds, fake, df_rng)
             return bce_discriminator_loss(real_logits, fake_logits), d_bs
 
         (g_loss, (g_bs, fake)), g_grads = jax.value_and_grad(
@@ -263,18 +266,20 @@ class CycleGanTrainer:
 
     # generator step: one grad over BOTH generators (train.py:150-205)
     def _g_step_impl(self, gab: TrainState, gba: TrainState, da, db, real_a, real_b):
-        rng = jax.random.fold_in(gab.rng, gab.step)
+        # eight network applications -> eight subkeys (DV002): subscripts of
+        # one split, so each dropout draw is independent
+        r = jax.random.split(jax.random.fold_in(gab.rng, gab.step), 8)
 
         def loss_fn(params):
             gab_p, gba_p = params
-            fake_b, gab_bs = _apply(gab.replace(params=gab_p), real_a, rng)
-            fake_a, gba_bs = _apply(gba.replace(params=gba_p), real_b, rng)
-            cycled_a, _ = _apply(gba.replace(params=gba_p), fake_b, rng)
-            cycled_b, _ = _apply(gab.replace(params=gab_p), fake_a, rng)
-            same_a, _ = _apply(gba.replace(params=gba_p), real_a, rng)
-            same_b, _ = _apply(gab.replace(params=gab_p), real_b, rng)
-            logits_fake_b, _ = _apply(db, fake_b, rng)
-            logits_fake_a, _ = _apply(da, fake_a, rng)
+            fake_b, gab_bs = _apply(gab.replace(params=gab_p), real_a, r[0])
+            fake_a, gba_bs = _apply(gba.replace(params=gba_p), real_b, r[1])
+            cycled_a, _ = _apply(gba.replace(params=gba_p), fake_b, r[2])
+            cycled_b, _ = _apply(gab.replace(params=gab_p), fake_a, r[3])
+            same_a, _ = _apply(gba.replace(params=gba_p), real_a, r[4])
+            same_b, _ = _apply(gab.replace(params=gab_p), real_b, r[5])
+            logits_fake_b, _ = _apply(db, fake_b, r[6])
+            logits_fake_a, _ = _apply(da, fake_a, r[7])
             adv = lsgan_generator_loss(logits_fake_b) + lsgan_generator_loss(
                 logits_fake_a
             )
@@ -306,14 +311,15 @@ class CycleGanTrainer:
 
     def _d_step_impl(self, da: TrainState, db: TrainState, real_a, real_b,
                      fake_a, fake_b):
-        rng = jax.random.fold_in(da.rng, da.step)
+        # four discriminator applications -> four subkeys (DV002)
+        r = jax.random.split(jax.random.fold_in(da.rng, da.step), 4)
 
         def loss_fn(params):
             da_p, db_p = params
-            ra, da_bs = _apply(da.replace(params=da_p), real_a, rng)
-            fa, _ = _apply(da.replace(params=da_p), fake_a, rng)
-            rb, db_bs = _apply(db.replace(params=db_p), real_b, rng)
-            fb, _ = _apply(db.replace(params=db_p), fake_b, rng)
+            ra, da_bs = _apply(da.replace(params=da_p), real_a, r[0])
+            fa, _ = _apply(da.replace(params=da_p), fake_a, r[1])
+            rb, db_bs = _apply(db.replace(params=db_p), real_b, r[2])
+            fb, _ = _apply(db.replace(params=db_p), fake_b, r[3])
             loss = lsgan_discriminator_loss(ra, fa) + lsgan_discriminator_loss(rb, fb)
             return loss, (da_bs, db_bs)
 

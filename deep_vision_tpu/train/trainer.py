@@ -180,6 +180,7 @@ class Trainer:
             checked = checkify.checkify(
                 self._train_step_impl, errors=checkify.all_checks
             )
+            # jaxlint: disable=DV003 -- checkify debug mode: keep the pre-step state un-donated so a thrown error can be inspected against the exact inputs that produced it
             self._train_step_err = jax.jit(checked)
             self._train_step = None
         else:

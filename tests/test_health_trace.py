@@ -426,7 +426,11 @@ def test_check_journal_rejects_bad_events(tmp_path):
         f.write(json.dumps({"event": "step", "ts": 1.0, "run_id": "r"}) + "\n")
         f.write(json.dumps({"event": "wat", "ts": 1.0, "run_id": "r"}) + "\n")
         f.write(json.dumps({"event": "exit", "ts": 1.0}) + "\n")
-    errs = check_journal(path)
+    # unknown event types are tolerated by default (forward compatibility:
+    # an old checker must accept a newer producer's journals)...
+    assert not any("unknown event type" in e for e in check_journal(path))
+    # ...and violations under --strict
+    errs = check_journal(path, strict=True)
     assert any("step event missing field 'step'" in e for e in errs)
     assert any("unknown event type 'wat'" in e for e in errs)
     assert any("missing envelope field 'run_id'" in e for e in errs)
@@ -438,6 +442,50 @@ def test_check_journal_rejects_bad_events(tmp_path):
     assert check_journal(path2) == []
     assert any("crash marker" in e
                for e in check_journal(path2, require_exit=True))
+
+
+def test_check_journal_cli_exit_codes(tmp_path, capsys):
+    """0 = valid, 2 = invalid file, 64 = usage error — so make targets and
+    wrappers can tell a bad journal from a bad invocation."""
+    from tools.check_journal import EXIT_INVALID, EXIT_USAGE, main
+
+    good = str(tmp_path / "good.jsonl")
+    with RunJournal(good, kind="train") as j:
+        j.step(1, step_time_ms=1.0)
+    assert main([good]) == 0
+
+    bad = str(tmp_path / "bad.jsonl")
+    with open(bad, "w") as f:
+        f.write("{not json}\n" + json.dumps(
+            {"event": "exit", "ts": 1.0, "run_id": "r", "status": "ok"}) + "\n")
+    assert main([bad]) == EXIT_INVALID
+
+    with pytest.raises(SystemExit) as exc:
+        main([])  # journals are required
+    assert exc.value.code == EXIT_USAGE
+    capsys.readouterr()
+
+
+def test_check_journal_cli_strict_flag(tmp_path, capsys):
+    from tools.check_journal import EXIT_INVALID, main
+
+    path = str(tmp_path / "forward.jsonl")
+    rows = [
+        {"event": "from_the_future", "ts": 1.0, "run_id": "r"},
+        {"event": "exit", "ts": 2.0, "run_id": "r", "status": "ok"},
+    ]
+    with open(path, "w") as f:
+        f.writelines(json.dumps(r) + "\n" for r in rows)
+    assert main([path]) == 0  # forward-compatible by default
+    assert main([path, "--strict"]) == EXIT_INVALID
+    # strict also demands the exit marker
+    noexit = str(tmp_path / "alive.jsonl")
+    with open(noexit, "w") as f:
+        f.write(json.dumps({"event": "step", "ts": 1.0, "run_id": "r",
+                            "step": 1}) + "\n")
+    assert main([noexit]) == 0
+    assert main([noexit, "--strict"]) == EXIT_INVALID
+    capsys.readouterr()
 
 
 def test_check_trace_rejects_malformed(tmp_path):

@@ -1,0 +1,1087 @@
+"""jaxlint (deep_vision_tpu/lint): per-rule fixtures, suppressions,
+baseline mechanics, CLI, and the self-lint gate.
+
+Every rule gets at least one positive and one negative fixture — the
+acceptance contract is that introducing any DV001-DV005 violation
+fails `make lint` while the shipped tree stays clean.
+"""
+from __future__ import annotations
+
+import json
+import os
+import textwrap
+from pathlib import Path
+
+import pytest
+
+from deep_vision_tpu.lint import (
+    Finding,
+    lint_source,
+    load_baseline,
+    save_baseline,
+    split_baselined,
+)
+from deep_vision_tpu.lint.engine import iter_python_files
+from deep_vision_tpu.lint.__main__ import main
+
+REPO_ROOT = Path(__file__).resolve().parents[1]
+
+
+def run(src: str, **kw):
+    kept, _ = lint_source(textwrap.dedent(src), "fixture.py", **kw)
+    return kept
+
+
+def codes(src: str, **kw):
+    return [f.code for f in run(src, **kw)]
+
+
+# -- DV001 host-sync-in-jit ---------------------------------------------------
+
+def test_dv001_mixed_static_dynamic_cast_flagged():
+    # shape metadata appearing in the expression must not excuse a traced
+    # leaf: float(x.mean() * x.shape[0]) is still a per-step sync
+    found = run("""
+        import jax
+
+        @jax.jit
+        def step(x):
+            a = float(x.mean() * x.shape[0])   # traced leaf: sync
+            b = float(x.shape[0] / x.size)     # all-metadata: fine
+            c = int(len(x) * 2)                # len is static: fine
+            return a + b + c
+    """, select=["DV001"])
+    assert [(f.code, f.line) for f in found] == [("DV001", 6)]
+
+def test_dv001_item_and_print_in_jit():
+    found = run("""
+        import jax
+
+        @jax.jit
+        def step(state, batch):
+            loss = state.sum()
+            print(loss)
+            return loss.item()
+    """, select=["DV001"])
+    assert [f.code for f in found] == ["DV001", "DV001"]
+    assert "jax.debug.print" in found[0].message
+    assert found[1].symbol == "step"
+
+
+def test_dv001_static_print_is_a_trace_time_log():
+    # print("literal") inside jit runs once at trace time and prints
+    # nothing traced — only printing a traced value is the hazard
+    found = run("""
+        import jax
+
+        @jax.jit
+        def step(x):
+            print("compiling step")
+            return x * 2
+    """, select=["DV001"])
+    assert found == []
+
+
+def test_dv001_inside_associative_scan_callback():
+    # the callback handed to lax.associative_scan is traced like any
+    # other jit consumer (regression: the consumer table had a typo)
+    found = run("""
+        import jax
+
+        def combine(a, b):
+            return a.item() + b
+
+        def scan(xs):
+            return jax.lax.associative_scan(combine, xs)
+    """, select=["DV001"])
+    assert [f.symbol for f in found] == ["combine"]
+
+
+def test_dv001_float_cast_flagged_shape_cast_not():
+    src = """
+        import jax
+
+        @jax.jit
+        def step(state, x):
+            n = int(x.shape[0])      # static: fine
+            lim = float("inf")       # literal: fine
+            return float(x) + n + lim
+    """
+    found = run(src, select=["DV001"])
+    assert [f.code for f in found] == ["DV001"]
+    assert "float()" in found[0].message
+
+
+def test_dv001_np_asarray_and_block_until_ready():
+    assert codes("""
+        import jax, numpy as np
+
+        @jax.jit
+        def step(state):
+            host = np.asarray(state)
+            jax.block_until_ready(state)
+            return host
+    """, select=["DV001"]) == ["DV001", "DV001"]
+
+
+def test_dv001_np_array_constant_table_not_flagged():
+    # np.array over literals is a trace-time constant, not a host pull;
+    # np.asarray of the traced argument on the next line must still flag
+    found = run("""
+        import jax, numpy as np
+
+        @jax.jit
+        def step(x):
+            table = np.array([1.0, 2.0, 4.0])
+            return np.asarray(x) * table.sum()
+    """, select=["DV001"])
+    assert [(f.code, f.line) for f in found] == [("DV001", 7)]
+
+
+def test_dv001_host_code_not_flagged():
+    # the same calls OUTSIDE any jit context are the normal host idiom
+    assert codes("""
+        import jax, numpy as np
+
+        def fetch(fn, x):
+            out = jax.block_until_ready(fn(x))
+            print(out)
+            return float(np.asarray(out).sum())
+    """) == []
+
+
+def test_dv001_resolves_method_reference_jit():
+    # the Trainer pattern: jax.jit(self._step_impl) marks the method traced
+    found = run("""
+        import jax
+
+        class T:
+            def __init__(self):
+                self._fwd = jax.jit(self._fwd_impl)
+
+            def _fwd_impl(self, state):
+                return state.params.item()
+    """)
+    assert [f.code for f in found] == ["DV001"]
+    assert found[0].symbol == "T._fwd_impl"
+
+
+def test_dv001_resolves_partial_wrapped_jit():
+    assert codes("""
+        import functools
+        import jax
+
+        def decode(variables, images):
+            return images.item()
+
+        fn = functools.partial(decode, scale=2)
+        decoder = jax.jit(fn)
+    """) == ["DV001"]
+
+
+# -- DV002 prng-key-reuse -----------------------------------------------------
+
+def test_dv002_sampler_reuse_flagged():
+    found = run("""
+        import jax
+
+        def f(key):
+            a = jax.random.normal(key, (2,))
+            b = jax.random.uniform(key, (2,))
+            return a + b
+    """)
+    assert [f.code for f in found] == ["DV002"]
+    assert "'key'" in found[0].message
+
+
+def test_dv002_split_keys_not_flagged():
+    assert codes("""
+        import jax
+
+        def f(key):
+            k1, k2 = jax.random.split(key)
+            a = jax.random.normal(k1, (2,))
+            b = jax.random.uniform(k2, (2,))
+            return a + b
+    """) == []
+
+
+def test_dv002_double_split_flagged():
+    # splitting the same base twice yields identical subkeys
+    assert codes("""
+        import jax
+
+        def f(key):
+            k1, k2 = jax.random.split(key)
+            k3, k4 = jax.random.split(key)
+            return k1, k2, k3, k4
+    """) == ["DV002"]
+
+
+def test_dv002_fold_in_distinct_data_not_flagged():
+    # the canonical per-index idiom: two independent streams minted from
+    # one parent via fold_in with distinct data is NOT key reuse
+    assert codes("""
+        import jax
+
+        def f(key):
+            a = jax.random.fold_in(key, 0)
+            b = jax.random.fold_in(key, 1)
+            return jax.random.normal(a, (2,)) + jax.random.normal(b, (2,))
+    """) == []
+
+
+def test_dv002_fold_in_identical_data_flagged():
+    found = run("""
+        import jax
+
+        def f(key):
+            a = jax.random.fold_in(key, 1)
+            b = jax.random.fold_in(key, 1)
+            return a, b
+    """)
+    assert [f.code for f in found] == ["DV002"]
+    assert "identical" in found[0].message
+
+
+def test_dv002_split_default_num_collides_with_explicit_two():
+    # split(key) and split(key, 2) yield the same subkeys
+    assert codes("""
+        import jax
+
+        def f(key):
+            k1, k2 = jax.random.split(key)
+            k3, k4 = jax.random.split(key, 2)
+            return k1, k2, k3, k4
+    """) == ["DV002"]
+
+
+def test_dv002_identical_derive_in_exclusive_arms_not_flagged():
+    assert codes("""
+        import jax
+
+        def f(cond, key):
+            if cond:
+                k = jax.random.fold_in(key, 1)
+            else:
+                k = jax.random.fold_in(key, 1)
+            return jax.random.normal(k, (2,))
+    """) == []
+
+
+def test_dv002_reuse_through_generic_call():
+    # the GAN-trainer bug shape: one derived key feeding two model applies
+    assert codes("""
+        import jax
+
+        def g(model, x, base):
+            rng = jax.random.fold_in(base, 1)
+            y = model.apply(x, rngs={"dropout": rng})
+            z = model.apply(x, rngs={"dropout": rng})
+            return y + z
+    """) == ["DV002"]
+
+
+def test_dv002_key_from_outside_loop_flagged():
+    found = run("""
+        import jax
+
+        def f(key, xs):
+            out = []
+            for x in xs:
+                out.append(jax.random.normal(key, (2,)))
+            return out
+    """)
+    assert [f.code for f in found] == ["DV002"]
+    assert "loop" in found[0].message
+
+
+def test_dv002_fold_in_per_iteration_is_the_fix():
+    # deriving a fresh subkey per iteration is the recommended idiom and
+    # must NOT be flagged, including the deriver's own in-loop consumption
+    assert codes("""
+        import jax
+
+        def f(key, xs):
+            out = []
+            for i, x in enumerate(xs):
+                k = jax.random.fold_in(key, i)
+                out.append(jax.random.normal(k, (2,)))
+            return out
+    """) == []
+
+
+def test_dv002_subscripted_split_not_flagged():
+    # r[0]..r[3] are distinct subkeys of one split
+    assert codes("""
+        import jax
+
+        def f(model, x, base):
+            r = jax.random.split(base, 4)
+            a = model.apply(x, rngs={"dropout": r[0]})
+            b = model.apply(x, rngs={"dropout": r[1]})
+            return a + b
+    """) == []
+
+
+def test_dv002_key_arg_of_state_builder_not_treated_as_key():
+    # `state = build(..., PRNGKey(0))` consumes a key, it does not mint one:
+    # later generic uses of `state` must not count as key reuse
+    assert codes("""
+        import jax
+
+        def f(model, tx, batch):
+            state = build(model, tx, jax.random.PRNGKey(0))
+            state = update(state, batch)
+            state = update(state, batch)
+            return state
+    """) == []
+
+
+def test_dv002_rebinding_fold_in_idiom_not_flagged():
+    # `key = fold_in(key, i)` rebinding: the RHS consumes the OLD binding,
+    # the sampler consumes the NEW one — no reuse either way
+    assert codes("""
+        import jax
+
+        def f(key, xs):
+            out = []
+            for i, x in enumerate(xs):
+                key = jax.random.fold_in(key, i)
+                out.append(jax.random.normal(key, (2,)))
+            return out
+    """) == []
+    assert codes("""
+        import jax
+
+        def g(key):
+            key = jax.random.fold_in(key, 1)
+            return jax.random.normal(key, (2,))
+    """) == []
+
+
+def test_dv002_recognizes_from_jax_import_random():
+    # the `from jax import random` alias form must count as a sampler
+    assert codes("""
+        import jax
+        from jax import random
+
+        def f(key):
+            a = random.normal(key, (2,))
+            b = random.uniform(key, (2,))
+            return a + b
+    """) == ["DV002"]
+
+
+def test_dv002_exclusive_branches_not_flagged():
+    # only one arm ever executes: one consume each is correct code
+    assert codes("""
+        import jax
+
+        def f(cond, rng):
+            if cond:
+                return jax.random.normal(rng, (2,))
+            else:
+                return jax.random.uniform(rng, (2,))
+    """) == []
+    # early-return arm: code after the if is the other arm in effect
+    assert codes("""
+        import jax
+
+        def f(cond, rng):
+            if cond:
+                return jax.random.normal(rng, (2,))
+            return jax.random.uniform(rng, (2,))
+    """) == []
+    # elif chain where every taken arm returns
+    assert codes("""
+        import jax
+
+        def f(mode, rng):
+            if mode == 0:
+                return jax.random.normal(rng, (2,))
+            elif mode == 1:
+                return jax.random.uniform(rng, (2,))
+            return jax.random.bernoulli(rng)
+    """) == []
+
+
+def test_dv002_reuse_across_coexecuting_branch_flagged():
+    # a non-terminal if body falls through: its consume and the one after
+    # the if CAN both run, so this is a real reuse
+    found = run("""
+        import jax
+
+        def f(cond, rng):
+            x = 0
+            if cond:
+                x = jax.random.normal(rng, (2,))
+            return x + jax.random.uniform(rng, (2,))
+    """)
+    assert [(f.code, f.line) for f in found] == [("DV002", 8)]
+    # two consumes inside the SAME arm are still a reuse
+    assert codes("""
+        import jax
+
+        def f(cond, rng):
+            if cond:
+                a = jax.random.normal(rng, (2,))
+                b = jax.random.uniform(rng, (2,))
+                return a + b
+            return jax.random.normal(rng, (2,))
+    """) == ["DV002"]
+
+
+# -- DV003 missing-donation ---------------------------------------------------
+
+def test_dv003_undonated_train_step_flagged():
+    found = run("""
+        import jax
+
+        def train_step(state, batch):
+            return state
+
+        step = jax.jit(train_step)
+    """)
+    assert [f.code for f in found] == ["DV003"]
+    assert "donate_argnums" in found[0].message
+
+
+def test_dv003_donated_train_step_ok():
+    assert codes("""
+        import jax
+
+        def train_step(state, batch):
+            return state
+
+        step = jax.jit(train_step, donate_argnums=0)
+    """) == []
+
+
+def test_dv003_eval_step_exempt():
+    # eval steps REUSE the state across batches; donation would be a bug
+    assert codes("""
+        import jax
+
+        def eval_step(state, batch):
+            return state
+
+        e = jax.jit(eval_step)
+    """) == []
+
+
+def test_dv003_partial_wrapped_step_flagged():
+    assert codes("""
+        import functools
+        import jax
+
+        def train_step(state, batch, aux_weight):
+            return state
+
+        step = jax.jit(functools.partial(train_step, aux_weight=0.1))
+    """) == ["DV003"]
+
+
+def test_dv003_decorator_forms():
+    assert codes("""
+        import jax
+
+        @jax.jit
+        def update_params(params, grads):
+            return params
+    """) == ["DV003"]
+    assert codes("""
+        import functools
+        import jax
+
+        @functools.partial(jax.jit, donate_argnums=0)
+        def update_params(params, grads):
+            return params
+    """) == []
+
+
+def test_dv003_non_state_step_not_flagged():
+    # a "step" over plain arrays has nothing worth donating
+    assert codes("""
+        import jax
+
+        def ray_step(x, dt):
+            return x + dt
+
+        s = jax.jit(ray_step)
+    """) == []
+
+
+def test_dv002_parent_key_consumed_after_split():
+    # the JAX PRNG guide's canonical bug: split, then sample from the
+    # parent — the parent stream is correlated with its subkeys
+    found = run("""
+        import jax
+
+        def f(key, shape):
+            k1, k2 = jax.random.split(key)
+            a = jax.random.uniform(k1, shape)
+            b = jax.random.normal(key, shape)
+            return a + b
+    """, select=["DV002"])
+    assert [f.code for f in found] == ["DV002"]
+    assert "after being split" in found[0].message
+
+
+def test_dv002_rebound_parent_after_split_ok():
+    # `key, sub = split(key)` discards the old parent: consuming the NEW
+    # binding is clean, and repeated fold_in with distinct data is the
+    # sanctioned idiom, not a consumption of the parent
+    assert codes("""
+        import jax
+
+        def f(key, shape):
+            key, sub = jax.random.split(key)
+            a = jax.random.uniform(sub, shape)
+            b = jax.random.normal(key, shape)
+            k0 = jax.random.fold_in(b_key := jax.random.PRNGKey(0), 0)
+            k1 = jax.random.fold_in(b_key, 1)
+            return a + b
+    """, select=["DV002"]) == []
+
+
+# -- DV004 jit-in-loop --------------------------------------------------------
+
+def test_dv004_jit_in_loop_flagged():
+    found = run("""
+        import jax
+
+        def sweep(xs):
+            outs = []
+            for x in xs:
+                f = jax.jit(lambda v: v + x)
+                outs.append(f(x))
+            return outs
+    """)
+    assert [f.code for f in found] == ["DV004"]
+    assert "recompile" in found[0].message
+
+
+def test_dv004_decorated_def_in_loop_flagged():
+    assert codes("""
+        import jax
+
+        def sweep(xs):
+            for x in xs:
+                @jax.jit
+                def f(v):
+                    return v + 1
+                f(x)
+    """) == ["DV004"]
+
+
+def test_dv004_non_jax_jit_method_in_loop_ok():
+    # .jit() on something that isn't jax (a compiler wrapper, self.jit)
+    # is not jax.jit; only jax-rooted calls recompile per iteration
+    assert codes("""
+        import jax
+
+        def sweep(model, xs):
+            outs = []
+            for x in xs:
+                outs.append(model.jit(x))
+            return outs
+    """) == []
+
+
+def test_dv004_module_level_and_calls_in_loop_ok():
+    # calling an already-jitted function in a loop is the POINT of jit
+    assert codes("""
+        import jax
+
+        f = jax.jit(lambda v: v + 1)
+
+        def sweep(xs):
+            return [f(x) for x in xs]
+
+        def sweep2(xs):
+            out = []
+            for x in xs:
+                out.append(f(x))
+            return out
+    """) == []
+
+
+def test_dv004_def_in_loop_with_deferred_jit_ok():
+    # the jit call runs when make() is invoked, not per loop iteration
+    assert codes("""
+        import jax
+
+        def build(xs):
+            makers = []
+            for x in xs:
+                def make(body):
+                    return jax.jit(body)
+                makers.append(make)
+            return makers
+    """) == []
+
+
+# -- DV005 impure-jit ---------------------------------------------------------
+
+def test_dv005_self_write_time_and_np_random():
+    found = run("""
+        import time
+        import jax
+        import numpy as np
+
+        class T:
+            def __init__(self):
+                self._go = jax.jit(self._go_impl)
+
+            def _go_impl(self, state):
+                self.count = 1
+                t0 = time.perf_counter()
+                noise = np.random.rand(3)
+                return state
+    """, select=["DV005"])
+    assert [f.code for f in found] == ["DV005", "DV005", "DV005"]
+    msgs = " ".join(f.message for f in found)
+    assert "self.count" in msgs and "time.perf_counter" in msgs \
+        and "np.random" in msgs
+
+
+def test_dv005_jax_random_and_host_methods_ok():
+    assert codes("""
+        import time
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def step(state, key):
+            return state + jax.random.normal(key, (2,))
+
+        class Host:
+            def tick(self):
+                self.t = time.time()       # host code: fine
+                return np.random.rand(3)
+    """, select=["DV005"]) == []
+
+
+def test_dv005_nonlocal_write_flagged():
+    assert codes("""
+        import jax
+
+        def make():
+            n = 0
+
+            @jax.jit
+            def step(state):
+                nonlocal n
+                n = n + 1
+                return state
+
+            return step
+    """, select=["DV005"]) == ["DV005"]
+
+
+def test_dv005_from_jax_import_random_not_impure():
+    # `from jax import random; random.normal(...)` IS jax.random
+    assert codes("""
+        import jax
+        from jax import random
+
+        @jax.jit
+        def scale(x, key):
+            return x + random.normal(key, (2,))
+    """, select=["DV005"]) == []
+
+
+def test_builtin_map_does_not_mark_callable_traced():
+    # bare `map`/`checkpoint` are Python, not jax.lax: the callable's body
+    # must not be treated as jit context (dotted jax.lax.map still counts)
+    assert codes("""
+        def parse(line):
+            print(line)
+            return float(line)
+
+        def load(f):
+            return list(map(parse, f))
+    """, select=["DV001"]) == []
+    assert codes("""
+        import jax
+
+        def body(x):
+            return x.item()
+
+        def run(xs):
+            return jax.lax.map(body, xs)
+    """, select=["DV001"]) == ["DV001"]
+
+
+# -- DV006 untraced-python-branch --------------------------------------------
+
+def test_dv006_branch_on_traced_arg_warns():
+    found = run("""
+        import jax
+
+        @jax.jit
+        def step(state, x):
+            if x > 0:
+                return state
+            return -state
+    """, select=["DV006"])
+    assert [f.code for f in found] == ["DV006"]
+    assert found[0].severity == "warning"
+    assert "lax.cond" in found[0].message
+
+
+def test_dv006_while_on_traced_arg_warns():
+    assert codes("""
+        import jax
+
+        @jax.jit
+        def iterate(x):
+            while x > 0:
+                x = x - 1
+            return x
+    """) == ["DV006"]
+
+
+def test_dv006_static_tests_not_flagged():
+    # shape arithmetic, pytree structure, None-checks, and keyword-only
+    # config flags are all static under trace
+    assert codes("""
+        import jax
+
+        @jax.jit
+        def step(state, x, mask=None, *, causal=False):
+            if x.shape[0] > 2:
+                x = x[:2]
+            if state.batch_stats:
+                x = x + 1
+            if mask is None:
+                x = x * 2
+            if causal:
+                x = x * 3
+            return x
+    """, select=["DV006"]) == []
+
+
+# -- suppressions -------------------------------------------------------------
+
+def test_inline_suppression_same_line():
+    kept, dropped = lint_source(textwrap.dedent("""
+        import jax
+
+        @jax.jit
+        def step(state):
+            return state.item()  # jaxlint: disable=DV001 -- scalar debug path
+    """), "fixture.py", select=["DV001"])
+    assert kept == []
+    assert [f.code for f in dropped] == ["DV001"]
+
+
+def test_inline_suppression_preceding_line_and_all():
+    kept, dropped = lint_source(textwrap.dedent("""
+        import jax
+
+        @jax.jit
+        def step(state):
+            # jaxlint: disable=all -- fixture
+            return state.item()
+    """), "fixture.py", select=["DV001"])
+    assert kept == [] and len(dropped) == 1
+
+
+def test_trailing_suppression_does_not_cover_next_line():
+    # a trailing pragma acknowledges ITS line only; a fresh violation
+    # added directly below must still fail the gate
+    kept, dropped = lint_source(textwrap.dedent("""
+        import jax
+
+        @jax.jit
+        def step(a, b):
+            x = a.item()  # jaxlint: disable=DV001 -- acknowledged
+            y = b.item()
+            return x + y
+    """), "fixture.py", select=["DV001"])
+    assert [f.line for f in kept] == [7]
+    assert [f.line for f in dropped] == [6]
+
+
+def test_suppression_of_other_code_does_not_mask():
+    kept, _ = lint_source(textwrap.dedent("""
+        import jax
+
+        @jax.jit
+        def step(state):
+            return state.item()  # jaxlint: disable=DV002 -- wrong code
+    """), "fixture.py", select=["DV001", "DV002"])
+    assert [f.code for f in kept] == ["DV001"]
+
+
+def test_syntax_error_is_a_finding():
+    kept, _ = lint_source("def broken(:\n", "fixture.py")
+    assert [f.code for f in kept] == ["DV000"]
+    assert kept[0].severity == "error"
+
+
+# -- baseline -----------------------------------------------------------------
+
+def _two_findings():
+    return [
+        Finding("DV001", "msg-a", "pkg/a.py", 3, 1, "error", "f"),
+        Finding("DV003", "msg-b", "pkg/b.py", 9, 1, "error", "g"),
+    ]
+
+
+def test_baseline_roundtrip(tmp_path):
+    path = str(tmp_path / "baseline.json")
+    save_baseline(path, _two_findings())
+    fresh, accepted = split_baselined(_two_findings(), load_baseline(path))
+    assert fresh == [] and len(accepted) == 2
+
+
+def test_baseline_is_line_drift_proof_but_counts_multiplicity(tmp_path):
+    path = str(tmp_path / "baseline.json")
+    save_baseline(path, _two_findings())
+    moved = [Finding("DV001", "msg-a", "pkg/a.py", 300, 5, "error", "f")]
+    fresh, accepted = split_baselined(moved, load_baseline(path))
+    assert fresh == []  # same (code, path, symbol, message), new line: ok
+    # a SECOND identical finding exceeds the baselined multiplicity
+    fresh, _ = split_baselined(moved + moved, load_baseline(path))
+    assert len(fresh) == 1
+
+
+def test_missing_baseline_is_empty(tmp_path):
+    assert load_baseline(str(tmp_path / "nope.json")) == {}
+
+
+# -- CLI ----------------------------------------------------------------------
+
+BAD_STEP = """\
+import jax
+
+
+def train_step(state, batch):
+    return state
+
+
+step = jax.jit(train_step)
+"""
+
+
+def _project(tmp_path, source=BAD_STEP):
+    (tmp_path / "pyproject.toml").write_text(textwrap.dedent("""
+        [tool.jaxlint]
+        paths = ["mod.py"]
+        baseline = "baseline.json"
+    """))
+    (tmp_path / "mod.py").write_text(source)
+    return str(tmp_path / "pyproject.toml")
+
+
+def test_cli_exit_codes_and_baseline_flow(tmp_path, capsys):
+    pp = _project(tmp_path)
+    assert main(["--config", pp]) == 1  # new DV003
+    assert main(["--config", pp, "--write-baseline"]) == 0
+    assert (tmp_path / "baseline.json").exists()
+    assert main(["--config", pp]) == 0  # baselined now
+    # a NEW violation on top of the baseline still fails
+    (tmp_path / "mod.py").write_text(
+        BAD_STEP + "\n\nstep2 = jax.jit(train_step)\n")
+    assert main(["--config", pp]) == 1
+    capsys.readouterr()
+
+
+def test_cli_select_and_no_baseline(tmp_path, capsys):
+    pp = _project(tmp_path)
+    assert main(["--config", pp, "--select", "DV001"]) == 0  # rule off
+    assert main(["--config", pp, "--no-baseline"]) == 1
+    capsys.readouterr()
+
+
+def test_cli_json_format(tmp_path, capsys):
+    pp = _project(tmp_path)
+    rc = main(["--config", pp, "--format", "json"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert doc["summary"]["errors"] == 1 and doc["summary"]["failed"]
+    f = doc["findings"][0]
+    assert f["code"] == "DV003" and f["path"] == "mod.py" and f["line"] == 8
+
+
+def test_cli_warnings_do_not_fail_without_flag(tmp_path, capsys):
+    pp = _project(tmp_path, source=textwrap.dedent("""
+        import jax
+
+        @jax.jit
+        def scale(x):
+            if x > 0:
+                return x
+            return -x
+    """))
+    assert main(["--config", pp]) == 0  # DV006 is warn-level
+    assert main(["--config", pp, "--fail-on-warn"]) == 1
+    capsys.readouterr()
+
+
+def test_cli_config_fallback_parser_reads_pyproject(tmp_path):
+    from deep_vision_tpu.lint.config import load_config
+
+    pp = tmp_path / "pyproject.toml"
+    pp.write_text(textwrap.dedent("""
+        [tool.other]
+        paths = ["nope"]
+
+        [tool.jaxlint]
+        paths = [
+            "a",
+            "b.py",
+        ]
+        baseline = "bl.json"
+        disable = ["DV006"]
+    """))
+    cfg = load_config(str(pp))
+    assert cfg["paths"] == ["a", "b.py"]
+    assert cfg["baseline"] == "bl.json"
+    assert cfg["disable"] == ["DV006"]
+    assert cfg["root"] == str(tmp_path)
+
+
+def test_cli_nonexistent_path_fails(tmp_path, capsys):
+    # a typo'd paths entry must not silently lint zero files and pass
+    pp = tmp_path / "pyproject.toml"
+    pp.write_text('[tool.jaxlint]\npaths = ["no_such_dir"]\n'
+                  'baseline = "b.json"\n')
+    assert main(["--config", str(pp)]) == 1
+    assert "does not exist" in capsys.readouterr().err
+
+
+def test_cli_write_baseline_refuses_dv000(tmp_path, capsys):
+    # baselining a config/parse error would permanently silence the guard:
+    # a typo'd path or syntax-broken file must fail --write-baseline
+    pp = tmp_path / "pyproject.toml"
+    pp.write_text('[tool.jaxlint]\npaths = ["no_such_dir"]\n'
+                  'baseline = "b.json"\n')
+    assert main(["--config", str(pp), "--write-baseline"]) == 1
+    assert "refusing" in capsys.readouterr().err
+    assert not (tmp_path / "b.json").exists()
+    pp.write_text('[tool.jaxlint]\npaths = ["bad.py"]\n'
+                  'baseline = "b.json"\n')
+    (tmp_path / "bad.py").write_text("def f(:\n")
+    assert main(["--config", str(pp), "--write-baseline"]) == 1
+    assert not (tmp_path / "b.json").exists()
+    capsys.readouterr()
+
+
+def test_cli_write_baseline_refuses_partial_rule_runs(tmp_path, capsys):
+    # the baseline is the full-rule acceptance set: writing it from a
+    # --select/--disable run would drop every other rule's entries
+    pp = _project(tmp_path)
+    assert main(["--config", pp, "--select", "DV002",
+                 "--write-baseline"]) == 64
+    assert "all rules enabled" in capsys.readouterr().err
+    assert not (tmp_path / "baseline.json").exists()
+
+
+def test_cli_config_disable_is_case_insensitive(tmp_path, capsys):
+    # lowercase codes in [tool.jaxlint] disable must match the uppercase
+    # rule registry, same as --disable on the CLI
+    pp = _project(tmp_path)
+    Path(pp).write_text(Path(pp).read_text() + 'disable = ["dv003"]\n')
+    assert main(["--config", pp]) == 0  # the DV003 fixture is disabled
+    capsys.readouterr()
+
+
+def test_cli_unknown_select_code_is_usage_error(tmp_path, capsys):
+    # a typo'd --select must not run zero rules and report "clean"
+    pp = _project(tmp_path)
+    assert main(["--config", pp, "--select", "DV0001"]) == 64
+    assert "unknown rule code" in capsys.readouterr().err
+    assert main(["--config", pp, "--disable", "DV999"]) == 64
+    capsys.readouterr()
+
+
+def test_exclude_is_a_root_relative_prefix(tmp_path):
+    # `tools` must exclude tools/ but NOT pkg/tools/, and must also
+    # drop an explicitly passed tools/file.py
+    (tmp_path / "tools").mkdir()
+    (tmp_path / "tools" / "a.py").write_text("x = 1\n")
+    (tmp_path / "pkg" / "tools").mkdir(parents=True)
+    (tmp_path / "pkg" / "tools" / "b.py").write_text("x = 1\n")
+    got = iter_python_files([str(tmp_path)], exclude=["tools"],
+                            root=str(tmp_path))
+    assert [os.path.relpath(p, tmp_path) for p in got] == [
+        os.path.join("pkg", "tools", "b.py")]
+    got = iter_python_files([str(tmp_path / "tools" / "a.py")],
+                            exclude=["tools"], root=str(tmp_path))
+    assert got == []
+
+
+def test_cli_write_baseline_refuses_partial_paths(tmp_path, capsys):
+    # writing from a path subset would drop every other file's accepted
+    # entries from the baseline, same as a partial rule run
+    pp = _project(tmp_path)
+    assert main(["--config", pp, str(tmp_path / "mod.py"),
+                 "--write-baseline"]) == 64
+    assert "full" in capsys.readouterr().err
+    assert not (tmp_path / "baseline.json").exists()
+
+
+def test_cli_select_disable_conflict_is_usage_error(tmp_path, capsys):
+    # selecting and disabling the same code would run zero rules and
+    # report the repo clean — the gate must refuse instead
+    pp = _project(tmp_path)
+    assert main(["--config", pp, "--select", "DV001",
+                 "--disable", "DV001"]) == 64
+    assert "no rules enabled" in capsys.readouterr().err
+
+
+def test_cli_unknown_config_disable_is_invalid(tmp_path, capsys):
+    # a typo'd code in [tool.jaxlint] disable is a broken config file (2),
+    # not a bad invocation (64)
+    pp = _project(tmp_path)
+    Path(pp).write_text(Path(pp).read_text() + 'disable = ["dv0003"]\n')
+    assert main(["--config", pp]) == 2
+    assert "unknown rule code" in capsys.readouterr().err
+
+
+def test_cli_usage_error_exits_64(capsys):
+    # bad invocation is 64, not argparse's default 2 (reserved for
+    # invalid files, matching tools/check_journal.py)
+    with pytest.raises(SystemExit) as exc:
+        main(["--format", "yaml"])
+    assert exc.value.code == 64
+    capsys.readouterr()
+
+
+def test_cli_corrupt_baseline_is_a_clean_error(tmp_path, capsys):
+    pp = _project(tmp_path)
+    (tmp_path / "baseline.json").write_text("{truncated")
+    assert main(["--config", pp]) == 2
+    assert "unreadable baseline" in capsys.readouterr().err
+    (tmp_path / "baseline.json").write_text('{"version": 99, "findings": []}')
+    assert main(["--config", pp]) == 2
+    # a hand-edited row missing a required field is the same clean exit-2,
+    # not a KeyError traceback
+    (tmp_path / "baseline.json").write_text(
+        '{"version": 1, "findings": [{"path": "mod.py", "message": "m"}]}')
+    assert main(["--config", pp]) == 2
+    assert "findings[0]" in capsys.readouterr().err
+
+
+# -- the gate itself ----------------------------------------------------------
+
+def test_repo_self_lint_clean(capsys):
+    """The shipped tree lints clean under the checked-in baseline: every
+    true positive was fixed, every deliberate exception carries an inline
+    reason. This is `make lint`, as a test."""
+    rc = main(["--config", str(REPO_ROOT / "pyproject.toml")])
+    out = capsys.readouterr().out
+    assert rc == 0, f"jaxlint found new violations:\n{out}"
+
+
+def test_repo_gate_catches_injected_violation(tmp_path, capsys):
+    """End-to-end teeth: the same config, plus one bad file, must fail."""
+    bad = tmp_path / "bad_mod.py"
+    bad.write_text(BAD_STEP)
+    rc = main([str(bad), "--config", str(REPO_ROOT / "pyproject.toml")])
+    capsys.readouterr()
+    assert rc == 1

@@ -1,29 +1,42 @@
 """Validate journal JSONL + Chrome trace JSON against the obs/ schemas.
 
     PYTHONPATH=. python tools/check_journal.py run.jsonl [run2.jsonl ...]
-        [--trace trace.json] [--require-exit]
+        [--trace trace.json] [--require-exit] [--strict]
 
 The CI teeth behind obs/README.md: every event line must parse, carry
-the `event`/`ts`/`run_id` envelope, use a known event type, and carry
-that type's required fields; `--require-exit` additionally demands a
-clean `exit` terminal event (what `make obs-smoke` asserts after its
-tiny train run — a smoke run that crashed is a failure even if every
-line it did write was well-formed). Trace files must be valid JSON in
-Trace Event Format: a `traceEvents` list whose complete events ("ph":
-"X") carry name/ts/dur/pid/tid.
+the `event`/`ts`/`run_id` envelope, and (for known event types) carry
+that type's required fields. Unknown event types are tolerated by
+default — a journal written by a newer producer must stay validatable
+by an older checker — while `--strict` makes them violations AND
+demands a clean `exit` terminal event (what `make obs-smoke` asserts
+after its tiny train run: a smoke run that crashed, or that emitted an
+event this schema has never heard of, is a failure even if every line
+it did write was well-formed). `--require-exit` demands only the
+terminal event. Trace files must be valid JSON in Trace Event Format:
+a `traceEvents` list whose complete events ("ph": "X") carry
+name/ts/dur/pid/tid.
 
-Exit status 0 = all files valid; 1 = any violation (each printed with
-its file:line).
+Exit status 0 = all files valid; 2 = any file invalid (each violation
+printed with its file:line); 64 = usage error.
 """
 from __future__ import annotations
 
-import argparse
 import json
 import os
 import sys
 from typing import List
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from deep_vision_tpu.cli import (  # noqa: E402
+    EXIT_INVALID,
+    EXIT_OK,
+    EXIT_USAGE,
+    UsageErrorParser,
+)
+
+__all__ = ["check_journal", "check_trace", "main",
+           "EXIT_OK", "EXIT_INVALID", "EXIT_USAGE"]
 
 # envelope fields on every line, then per-event required fields
 ENVELOPE = ("event", "ts", "run_id")
@@ -44,8 +57,14 @@ HEALTH_KINDS = {"non_finite", "loss_spike", "divergence", "hang",
                 "watchdog_started"}
 
 
-def check_journal(path: str, require_exit: bool = False) -> List[str]:
-    """Returns a list of violations ('' prefix stripped); empty = valid."""
+def check_journal(path: str, require_exit: bool = False,
+                  strict: bool = False) -> List[str]:
+    """Returns a list of violations ('' prefix stripped); empty = valid.
+
+    strict: unknown event types become violations (default: tolerated for
+    forward compatibility) and a clean terminal `exit` event is required.
+    """
+    require_exit = require_exit or strict
     errors: List[str] = []
     events: List[dict] = []
     try:
@@ -76,7 +95,9 @@ def check_journal(path: str, require_exit: bool = False) -> List[str]:
                 errors.append(f"{path}:{i}: missing envelope field {k!r}")
         ev = row.get("event")
         if ev not in EVENT_FIELDS:
-            errors.append(f"{path}:{i}: unknown event type {ev!r}")
+            if strict:
+                errors.append(f"{path}:{i}: unknown event type {ev!r}")
+            events.append(row)
             continue
         for k in EVENT_FIELDS[ev]:
             if k not in row:
@@ -142,18 +163,22 @@ def check_trace(path: str) -> List[str]:
 
 
 def main(argv=None) -> int:
-    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p = UsageErrorParser(description=__doc__.splitlines()[0])
     p.add_argument("journals", nargs="+", help="journal JSONL path(s)")
     p.add_argument("--trace", default=None, metavar="PATH",
                    help="also validate this Chrome trace JSON")
     p.add_argument("--require-exit", action="store_true",
                    help="fail unless the journal ends in a clean exit "
                         "event (the obs-smoke gate)")
+    p.add_argument("--strict", action="store_true",
+                   help="unknown event types are violations too, and a "
+                        "clean exit marker is required")
     args = p.parse_args(argv)
 
     errors: List[str] = []
     for path in args.journals:
-        errs = check_journal(path, require_exit=args.require_exit)
+        errs = check_journal(path, require_exit=args.require_exit,
+                             strict=args.strict)
         errors += errs
         if not errs:
             from deep_vision_tpu.obs.journal import read_journal
@@ -175,7 +200,7 @@ def main(argv=None) -> int:
                   f"spans: {', '.join(names)}")
     for e in errors:
         print("FAIL " + e, file=sys.stderr)
-    return 1 if errors else 0
+    return EXIT_INVALID if errors else EXIT_OK
 
 
 if __name__ == "__main__":
