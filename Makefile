@@ -47,9 +47,10 @@ lint-baseline:
 
 # the tier-1 gate, verbatim from ROADMAP.md: run before shipping any PR
 # (bash, not sh: the command uses pipefail and PIPESTATUS); lint, then
-# obs-smoke — the telemetry artifacts must validate before the tests count
+# obs-smoke and chaos-smoke — the telemetry artifacts must validate and
+# the resilience contracts must hold before the tests count
 verify: SHELL := /bin/bash
-verify: lint obs-smoke
+verify: lint obs-smoke chaos-smoke
 	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
 
 # observability smoke: a tiny CPU train with tracing + health guard on,
@@ -66,6 +67,13 @@ obs-smoke:
 	  --trace artifacts/obs_smoke/trace.json --strict
 	python tools/obs_report.py artifacts/obs_smoke/journal.jsonl \
 	  --trace artifacts/obs_smoke/trace.json
+
+# resilience smoke: a record-backed CPU train under injected faults
+# (skipped bad records within budget, SIGKILL mid-checkpoint-save,
+# quarantine-and-fall-back resume), journals validated --strict, plus a
+# no-fault overhead probe on the injection points (tools/chaos_run.py)
+chaos-smoke:
+	JAX_PLATFORMS=cpu python tools/chaos_run.py --workdir artifacts/chaos_smoke
 
 bench:
 	python bench.py
@@ -104,4 +112,4 @@ ps:
 native:
 	$(MAKE) -C native
 
-.PHONY: train resume train-fg test lint lint-baseline verify obs-smoke bench bench-evidence demo demo-gan demo-real dryrun tb ps native
+.PHONY: train resume train-fg test lint lint-baseline verify obs-smoke chaos-smoke bench bench-evidence demo demo-gan demo-real dryrun tb ps native

@@ -38,7 +38,11 @@ same boundaries.
 Resilience: the timing loop retries transient runtime/transport failures
 (the round-2 driver run died to a single tunnel hiccup, `BENCH_r02.json`)
 by rebuilding the jitted step and replaying the window; the JSON line is
-ALWAYS emitted, degraded if necessary, with an `error` field. Two hard
+ALWAYS emitted, degraded if necessary, with an `error` field. The retry
+budget, classification, and backoff schedule come from the shared
+`deep_vision_tpu.resilience.RetryPolicy` (this file's bespoke loop was
+its prototype); the rebuild-replay choreography around it stays local
+because it is bench-specific (donated buffers die with the failure). Two hard
 wall-clock guards make that promise hold even against a HUNG (not erroring)
 backend — the round-4 failure mode, where a dead relay tunnel blocks the
 main thread in socket recv and no exception ever fires (`BENCH_r04.json`:
@@ -71,6 +75,8 @@ import time
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from deep_vision_tpu.resilience import RetryPolicy
 
 A100_IMG_PER_SEC = 2900.0
 TARGET_PER_CHIP = 0.9 * A100_IMG_PER_SEC
@@ -434,9 +440,28 @@ def build_bench(batch_per_chip: int, multistep: int):
     return step, state, batch, batch_size, n_chips, devices
 
 
+def _retry_policy() -> RetryPolicy:
+    """The bench retry policy, built per call so a monkeypatched
+    MAX_RETRIES (tests) is honored. retry_on=Exception: jax wraps tunnel
+    failures in RuntimeError, and everything this loop runs is a replayable
+    pure computation, so any Exception here is worth one more attempt."""
+    # max_attempts counts the first try too: MAX_RETRIES retries on top
+    return RetryPolicy(name="bench.window", max_attempts=MAX_RETRIES + 1,
+                       base_delay_s=2.0, multiplier=2.0, max_delay_s=15.0,
+                       jitter=0.25, retry_on=Exception)
+
+
+#: the policy the live _timed_windows session is driving; _recover_backend
+#: sleeps ITS backoff so the jitter RNG advances per draw (a fresh policy
+#: here would re-seed and produce the same "jittered" delay every retry)
+#: and counters/journal stay on one object
+_ACTIVE_POLICY = None
+
+
 def _recover_backend(attempt: int) -> None:
-    """Best-effort client-side reset between retries of a dead tunnel."""
-    time.sleep(min(15.0, 2.0 * attempt))
+    """Best-effort client-side reset between retries of a dead tunnel:
+    the shared policy's backoff, then a cache clear on later attempts."""
+    (_ACTIVE_POLICY or _retry_policy()).backoff(attempt)
     if attempt >= 2:
         try:
             jax.clear_caches()
@@ -488,6 +513,8 @@ def _timed_windows(batch_per_chip: int, multistep: int):
     """
     dispatches = max(1, math.ceil(TIMED_STEPS / multistep))
     steps_per_window = dispatches * multistep
+    global _ACTIVE_POLICY
+    policy = _ACTIVE_POLICY = _retry_policy()
     errors = []
     window_dts = []
     stale_dts = []  # pre-failure windows: degraded fallback only
@@ -544,12 +571,14 @@ def _timed_windows(batch_per_chip: int, multistep: int):
             attempt += 1
             errors.append(f"{type(e).__name__}: {e}")
             _log(f"transient failure #{attempt} ({errors[-1][:200]})")
+            retrying = policy.should_retry(attempt, e)
+            policy.note(attempt, e, "retrying" if retrying else "gave_up")
             if window_dts:
                 stale_dts = window_dts
                 window_dts = []  # discard pre-failure windows: one healthy
                                  # session only feeds the median
                 _WINDOWS_DONE = 0  # keep the watchdog's count honest
-            if attempt > MAX_RETRIES:
+            if not retrying:
                 _log("retry budget exhausted")
                 break
             built = None  # rebuild: donated/invalid buffers are gone

@@ -11,15 +11,37 @@ Semantics preserved from the reference (SURVEY.md §2.6):
 TPU-native mechanism: orbax async checkpointing of the TrainState pytree,
 step-indexed directories, plus a small JSON sidecar for host-side state
 (metric history, plateau-scheduler state) that must never enter jit.
+
+Storage is treated as unreliable by design (Check-N-Run, NSDI '22): the
+sidecar is written tmp+fsync+rename with an embedded crc32c so a crash
+mid-write can never leave a half-written JSON that breaks `resume()`,
+writes retry transient I/O errors through the shared
+`resilience.RetryPolicy`, and `restore()` walks a fallback chain — a
+step whose arrays fail to restore, whose sidecar is corrupt, or whose
+sidecar is missing while sibling steps have one (the
+killed-between-array-commit-and-sidecar signature) is QUARANTINED (moved
+to `<dir>/quarantine/`, typed `ckpt_quarantine` journal event) and the
+newest remaining valid step is restored instead of crashing the run.
+`resilience.faults` injection points (`ckpt.save`, `ckpt.restore`,
+`ckpt.sidecar` incl. the after-write torn window) make every one of
+those paths testable on CPU.
 """
 from __future__ import annotations
 
 import json
 import os
-from typing import Any, Optional
+import re
+import sys
+from typing import Any, Callable, List, Optional, Tuple
 
+import google_crc32c
 import jax
 import orbax.checkpoint as ocp
+
+from deep_vision_tpu.resilience import RetryPolicy, faults
+
+_SIDECAR_RE = re.compile(r"host_state_(\d+)\.json$")
+_SIDECAR_FORMAT = 1
 
 
 def state_arrays(state) -> dict:
@@ -35,6 +57,12 @@ def state_arrays(state) -> dict:
     }
 
 
+class CheckpointCorruptError(RuntimeError):
+    """An explicitly requested step failed validation (corrupt sidecar or
+    unrestorable arrays). The latest-step path never raises this — it
+    quarantines and falls back instead."""
+
+
 class CheckpointManager:
     def __init__(
         self,
@@ -43,22 +71,224 @@ class CheckpointManager:
         save_interval_steps: int = 1,
         best_mode: Optional[str] = None,  # None | 'min' | 'max'
         best_metric: Optional[str] = None,
+        journal=None,  # obs.RunJournal: ckpt_quarantine / retry events
+        retry: Optional[RetryPolicy] = None,
     ):
         self.directory = os.path.abspath(directory)
         os.makedirs(self.directory, exist_ok=True)
         self._best_mode = best_mode
         self._best_metric = best_metric
         self._best_value = None
-        options = ocp.CheckpointManagerOptions(
+        self.journal = journal
+        self._retry = retry or RetryPolicy(
+            name="ckpt.sidecar", max_attempts=4, base_delay_s=0.05,
+            max_delay_s=2.0, journal=journal,
+        )
+        # array restores retry transient I/O before the fallback chain may
+        # judge a step corrupt: quarantining the newest good step over one
+        # network-FS hiccup would be an irreversible answer to a
+        # retryable question
+        self._restore_retry = RetryPolicy(
+            name="ckpt.restore", max_attempts=3, base_delay_s=0.2,
+            max_delay_s=5.0, journal=journal,
+        )
+        self._options = ocp.CheckpointManagerOptions(
             max_to_keep=max_to_keep,
             save_interval_steps=save_interval_steps,
             enable_async_checkpointing=True,
         )
-        self._mgr = ocp.CheckpointManager(self.directory, options=options)
+        self._mgr = ocp.CheckpointManager(self.directory, options=self._options)
 
     # -- host-side sidecar -------------------------------------------------
     def _sidecar_path(self, step: int) -> str:
         return os.path.join(self.directory, f"host_state_{step}.json")
+
+    def _sidecar_steps(self) -> List[int]:
+        out = []
+        for name in os.listdir(self.directory):
+            m = _SIDECAR_RE.match(name)
+            if m:
+                out.append(int(m.group(1)))
+        return out
+
+    def _write_sidecar(self, step: int, host_state: dict) -> None:
+        """Atomic, checksummed, retried sidecar write.
+
+        The payload crc travels inside the file: a torn write (crash between
+        the first byte and the rename — impossible now, but the file may
+        also rot on disk or be fed through a corrupting transport) is
+        detected at read time instead of surfacing as a JSONDecodeError
+        inside resume()."""
+        self._retry.call(self._write_sidecar_once, step, host_state)
+
+    def _write_sidecar_once(self, step: int, host_state: dict) -> None:
+        faults.fire("ckpt.sidecar")
+        payload = json.dumps(host_state, sort_keys=True)
+        doc = json.dumps({
+            "__sidecar_format__": _SIDECAR_FORMAT,
+            "crc32c": int(google_crc32c.value(payload.encode())),
+            "payload": host_state,
+        }, sort_keys=True)
+        # the corrupt fault flips bytes AFTER checksumming — simulating rot
+        # the checksum must catch, never corruption it would vouch for
+        data = faults.transform("ckpt.sidecar", doc.encode())
+        path = self._sidecar_path(step)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        try:
+            with open(tmp, "wb") as f:
+                f.write(data)
+                f.flush()
+                os.fsync(f.fileno())
+            faults.fire("ckpt.sidecar", stage="after_write")
+            os.replace(tmp, path)
+        finally:
+            if os.path.exists(tmp):
+                try:
+                    os.remove(tmp)
+                except OSError:
+                    pass
+
+    def _read_sidecar(self, step: int) -> Tuple[Optional[dict], Optional[str]]:
+        """(host_state, error). (None, None) = no sidecar on disk;
+        (None, reason) = a sidecar exists but failed validation."""
+        path = self._sidecar_path(step)
+        if not os.path.exists(path):
+            return None, None
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, json.JSONDecodeError, UnicodeDecodeError) as e:
+            return None, f"sidecar unreadable: {type(e).__name__}: {e}"
+        if not isinstance(doc, dict):
+            return None, "sidecar is not a JSON object"
+        if "__sidecar_format__" not in doc:
+            return doc, None  # pre-checksum legacy sidecar: accept as-is
+        payload = doc.get("payload")
+        want = doc.get("crc32c")
+        got = int(google_crc32c.value(
+            json.dumps(payload, sort_keys=True).encode()))
+        if want != got:
+            return None, f"sidecar checksum mismatch (want {want}, got {got})"
+        return payload, None
+
+    def _gc_sidecars(self) -> None:
+        """Drop sidecars whose array step was pruned by max_to_keep (they
+        would otherwise accumulate forever AND make every pruned step look
+        like an incomplete save to the fallback chain)."""
+        keep = set(self._mgr.all_steps())
+        if not keep:
+            return
+        for s in self._sidecar_steps():
+            if s not in keep:
+                try:
+                    os.remove(self._sidecar_path(s))
+                except OSError:
+                    pass
+
+    # -- quarantine + fallback restore -------------------------------------
+
+    def _reload(self) -> None:
+        try:
+            self._mgr.reload()
+        except Exception:  # older orbax: rebuild from the stored options
+            self._mgr = ocp.CheckpointManager(
+                self.directory, options=self._options)
+
+    def _quarantine(self, step: int, reason: str) -> None:
+        """Move a failed step (array dir + sidecar) under quarantine/ so the
+        operator can post-mortem it, and make the manager forget it.
+
+        Only process 0 moves files (same single-writer rule as the sidecar
+        writes): the validation that CONDEMNED the step is deterministic
+        over shared on-disk bytes, so every process walks to the same
+        surviving step; letting each of them race os.replace on a shared
+        checkpoint dir would not be."""
+        qdir = os.path.join(self.directory, "quarantine")
+
+        def unique(dst: str) -> str:
+            out, n = dst, 1
+            while os.path.exists(out):
+                out = f"{dst}.{n}"
+                n += 1
+            return out
+
+        moved = []
+        if jax.process_index() == 0:
+            os.makedirs(qdir, exist_ok=True)
+            for src in (os.path.join(self.directory, str(step)),
+                        self._sidecar_path(step)):
+                if os.path.exists(src):
+                    dst = unique(os.path.join(qdir, os.path.basename(src)))
+                    try:
+                        os.replace(src, dst)
+                        moved.append(dst)
+                    except OSError as e:
+                        reason += f"; quarantine move failed: {e}"
+        print(f"checkpoint: QUARANTINED step {step} ({reason}); "
+              f"falling back to the newest valid step", file=sys.stderr)
+        try:
+            from deep_vision_tpu.obs.registry import get_registry
+
+            get_registry().counter(
+                "ckpt_quarantine_total", "checkpoint steps quarantined").inc()
+        except Exception:
+            pass
+        if self.journal is not None:
+            self.journal.write("ckpt_quarantine", step=int(step),
+                               reason=reason, moved_to=moved)
+        self._reload()
+
+    def _restore_with_fallback(
+        self, do_restore: Callable[[int], Any], step: Optional[int]
+    ) -> Tuple[Optional[int], Any, Optional[dict]]:
+        """(restored_step, value, host_state); (None, None, None) when no
+        valid checkpoint remains. Explicit `step` = validate-or-raise (the
+        operator pinned it; silently restoring a different one would be
+        worse than failing); `step=None` = newest valid, quarantining
+        losers along the way."""
+        def attempt(s: int):
+            # transient I/O (OSError family) is retried here, so only a
+            # failure that SURVIVES the retry budget can condemn a step
+            def once():
+                faults.fire("ckpt.restore")
+                return do_restore(s)
+
+            return self._restore_retry.call(once)
+
+        if step is not None:
+            if step not in set(self._mgr.all_steps()):
+                # fail BEFORE orbax sees the doomed restore: besides the
+                # clearer error, a failed typed restore on a fresh manager
+                # poisons its item-structure registry for later saves
+                raise FileNotFoundError(
+                    f"no checkpoint step {step} in {self.directory!r}")
+            host_state, err = self._read_sidecar(step)
+            if err is not None:
+                raise CheckpointCorruptError(
+                    f"checkpoint step {step} in {self.directory!r}: {err}")
+            return step, attempt(step), host_state
+        sidecar_steps = set(self._sidecar_steps())
+        for s in sorted(self._mgr.all_steps(), reverse=True):
+            host_state, err = self._read_sidecar(s)
+            if (err is None and host_state is None
+                    and sidecar_steps - {s}):
+                # arrays committed, sidecar never landed, while sibling
+                # steps do carry one: the process died between the array
+                # commit and the sidecar rename — an incomplete save
+                err = ("sidecar missing while other steps have one "
+                       "(save died before the sidecar landed)")
+            if err is None:
+                try:
+                    return s, attempt(s), host_state
+                except (KeyboardInterrupt, SystemExit):
+                    raise
+                except Exception as e:
+                    err = f"array restore failed: {type(e).__name__}: {e}"
+            self._quarantine(s, err)
+            sidecar_steps.discard(s)
+        return None, None, None
+
+    # -- save/restore API ---------------------------------------------------
 
     def save(self, step: int, state, host_state: Optional[dict] = None, metrics=None):
         """Save TrainState (async) + JSON host state. Returns True if saved."""
@@ -72,6 +302,7 @@ class CheckpointManager:
             if not better:
                 return False
             self._best_value = v
+        faults.fire("ckpt.save")
         saved = self._mgr.save(
             step, args=ocp.args.StandardSave(state_arrays(state))
         )
@@ -82,52 +313,51 @@ class CheckpointManager:
         # restore. With per-host local directories they would see
         # host_state=None and resume with divergent plateau/LR state.
         if saved and host_state is not None and jax.process_index() == 0:
-            with open(self._sidecar_path(step), "w") as f:
-                json.dump(host_state, f)
+            self._write_sidecar(step, host_state)
+        if saved and jax.process_index() == 0:
+            self._gc_sidecars()
         return saved
 
     def restore(self, state, step: Optional[int] = None):
-        """Restore into the structure of `state`; returns (state, host_state)."""
-        step = step if step is not None else self._mgr.latest_step()
-        if step is None:
-            return state, None
+        """Restore into the structure of `state`; returns (state, host_state).
+
+        With `step=None`, walks the fallback chain: corrupt/incomplete
+        steps are quarantined and the newest valid one wins. When nothing
+        valid remains, returns the input state untouched (fresh start)."""
         template = state_arrays(state)
-        restored = self._mgr.restore(
-            step, args=ocp.args.StandardRestore(template)
+        found, restored, host_state = self._restore_with_fallback(
+            lambda s: self._mgr.restore(
+                s, args=ocp.args.StandardRestore(template)),
+            step,
         )
-        state = state.replace(**restored)
-        host_state = None
-        sidecar = self._sidecar_path(step)
-        if os.path.exists(sidecar):
-            with open(sidecar) as f:
-                host_state = json.load(f)
-        return state, host_state
+        if found is None:
+            return state, None
+        return state.replace(**restored), host_state
 
     def save_tree(self, step: int, tree, host_state: Optional[dict] = None):
         """Save an arbitrary array pytree (multi-model trainers: the GAN
         trainers save {'g': ..., 'd': ...} of per-state array dicts — the
         tf.train.Checkpoint(generator.., discriminator..) analog at
         CycleGAN/tensorflow/train.py:133-148)."""
+        faults.fire("ckpt.save")
         saved = self._mgr.save(step, args=ocp.args.StandardSave(tree))
         if saved and host_state is not None and jax.process_index() == 0:
-            with open(self._sidecar_path(step), "w") as f:
-                json.dump(host_state, f)
+            self._write_sidecar(step, host_state)
+        if saved and jax.process_index() == 0:
+            self._gc_sidecars()
         return saved
 
     def restore_tree(self, template, step: Optional[int] = None):
         """Restore a pytree saved by `save_tree` into `template`'s structure;
-        returns (tree, host_state) or (None, None) when nothing is saved."""
-        step = step if step is not None else self._mgr.latest_step()
-        if step is None:
-            return None, None
-        restored = self._mgr.restore(
-            step, args=ocp.args.StandardRestore(template)
+        returns (tree, host_state) or (None, None) when nothing valid is
+        saved (same quarantine-and-fall-back semantics as `restore`)."""
+        found, restored, host_state = self._restore_with_fallback(
+            lambda s: self._mgr.restore(
+                s, args=ocp.args.StandardRestore(template)),
+            step,
         )
-        host_state = None
-        sidecar = self._sidecar_path(step)
-        if os.path.exists(sidecar):
-            with open(sidecar) as f:
-                host_state = json.load(f)
+        if found is None:
+            return None, None
         return restored, host_state
 
     def restore_variables(self, step: Optional[int] = None) -> dict:
@@ -141,6 +371,7 @@ class CheckpointManager:
         step = step if step is not None else self._mgr.latest_step()
         if step is None:
             raise FileNotFoundError(f"no checkpoint in {self.directory!r}")
+        faults.fire("ckpt.restore")
         restored = self._mgr.restore(step)
         out = {"params": restored["params"]}
         if restored.get("batch_stats"):

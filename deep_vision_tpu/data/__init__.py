@@ -7,7 +7,9 @@ ResNet/tensorflow/train.py:148-214). One layer, shared by every model:
 
 - `records` / `example_codec`: TFRecord-compatible container + tf.train.Example
   wire codec, implemented natively (no TensorFlow dependency) so the same
-  shard files the reference's converters produced remain readable;
+  shard files the reference's converters produced remain readable; strict
+  readers raise on corruption, `read_records_tolerant` + `BadRecordBudget`
+  skip-and-dead-letter it under a bound (README: "Surviving bad data");
 - `datasets`: MNIST idx, ImageNet folder, and record-backed datasets with the
   reference's Example schemas (ImageNet 9-field, VOC/COCO boxes, MPII joints);
 - `transforms`: the hand-written numpy/PIL augmentation set
@@ -18,8 +20,11 @@ ResNet/tensorflow/train.py:148-214). One layer, shared by every model:
 """
 from deep_vision_tpu.data.example_codec import decode_example, encode_example
 from deep_vision_tpu.data.records import (
+    BadRecordBudget,
+    BadRecordBudgetExceeded,
     RecordWriter,
     read_records,
+    read_records_tolerant,
     record_iterator,
     write_records,
 )
@@ -32,10 +37,13 @@ from deep_vision_tpu.data import transforms
 from deep_vision_tpu.data.pipeline import DataLoader, Compose
 
 __all__ = [
+    "BadRecordBudget",
+    "BadRecordBudgetExceeded",
     "decode_example",
     "encode_example",
     "RecordWriter",
     "read_records",
+    "read_records_tolerant",
     "record_iterator",
     "write_records",
     "ImageFolderDataset",

@@ -21,6 +21,7 @@ import numpy as np
 
 from deep_vision_tpu.data.example_codec import decode_example
 from deep_vision_tpu.data.records import expand_shards, read_records
+from deep_vision_tpu.resilience import faults
 
 
 def decode_image(data: bytes, channels: int = 3) -> np.ndarray:
@@ -195,6 +196,13 @@ class RecordDataset:
 
     Streams (no random access — record files are sequential by design);
     reshuffles shard order per epoch when `shuffle_shards`.
+
+    With `bad_record_budget` (a `records.BadRecordBudget`), corrupt records
+    and failing decodes are SKIPPED under the budget's bound and
+    dead-lettered with file + offset instead of killing the epoch — the
+    bounded-data-loss mode production runs want against bit rot. The
+    budget path uses the Python tolerant reader (the native C++ reader
+    keeps strict-raise parity with `read_records`).
     """
 
     def __init__(
@@ -205,11 +213,13 @@ class RecordDataset:
         seed: int = 0,
         shard_index: int = 0,
         num_shards: int = 1,
+        bad_record_budget=None,
     ):
         self.files = expand_shards(pattern)[shard_index::num_shards]
         self.schema = SCHEMAS[schema] if isinstance(schema, str) else schema
         self.shuffle_shards = shuffle_shards
         self.seed = seed
+        self.bad_record_budget = bad_record_budget
         self._epoch = 0
 
     def set_epoch(self, epoch: int) -> None:
@@ -226,17 +236,45 @@ class RecordDataset:
         out.schema = self.schema
         out.shuffle_shards = self.shuffle_shards
         out.seed = self.seed + 1000003 * index
+        out.bad_record_budget = self.bad_record_budget
         out._epoch = self._epoch
         return out
+
+    def _decode(self, raw: bytes) -> dict:
+        faults.fire("data.decode")
+        return self.schema(decode_example(raw))
 
     def __iter__(self) -> Iterator[dict]:
         files = list(self.files)
         if self.shuffle_shards:
             np.random.RandomState(self.seed + self._epoch).shuffle(files)
         self._epoch += 1
-        from deep_vision_tpu.data.records import best_reader
+        budget = self.bad_record_budget
+        if budget is None:
+            from deep_vision_tpu.data.records import best_reader
 
-        reader = best_reader()
+            reader = best_reader()
+            for path in files:
+                for raw in reader(path):
+                    yield self._decode(raw)
+            return
+        from deep_vision_tpu.data.records import (
+            BadRecordBudgetExceeded,
+            read_records_tolerant,
+        )
+
         for path in files:
-            for raw in reader(path):
-                yield self.schema(decode_example(raw))
+            for offset, raw in read_records_tolerant(path, budget):
+                try:
+                    sample = self._decode(raw)
+                except (KeyboardInterrupt, SystemExit,
+                        BadRecordBudgetExceeded):
+                    raise
+                except Exception as e:
+                    # undecodable-but-CRC-clean records (writer bug, schema
+                    # drift) burn the same budget as corrupt ones
+                    budget.record_bad(
+                        path, offset,
+                        f"decode failed: {type(e).__name__}: {e}")
+                    continue
+                yield sample

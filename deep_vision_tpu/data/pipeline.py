@@ -60,12 +60,18 @@ def _buffer_shuffle(samples: Iterable[dict], buffer: int,
     yield from buf
 
 
-def _proc_worker(dataset, transform, epoch_seed, wid, out_q, stop_evt):
+def _proc_worker(dataset, transform, epoch_seed, wid, out_q, stop_evt,
+                 skip: int = 0):
     """Worker-process body: stream, transform, and ship samples.
 
     Runs in a spawned child; `dataset` is this worker's disjoint slice.
     Samples cross the process boundary via the queue's pickling — keep
-    images uint8 until the last transform to halve that traffic.
+    images uint8 until the last transform to halve that traffic. Samples
+    ship tagged `(wid, sample)` so the parent can count per-worker
+    deliveries; a replacement worker for a dead one is started with
+    `skip` = that count and fast-forwards past the already-delivered
+    prefix of its slice (the slice iterates deterministically — the
+    parent never advances the original dataset object it re-pickles).
     """
     def put(item) -> bool:
         """Bounded put that keeps observing stop_evt (an abandoned consumer
@@ -83,9 +89,11 @@ def _proc_worker(dataset, transform, epoch_seed, wid, out_q, stop_evt):
         for k, sample in enumerate(dataset):
             if stop_evt.is_set():
                 break
+            if k < skip:
+                continue  # already delivered by the worker this one replaces
             if transform is not None:
                 sample = transform(sample, rng)
-            if not put(sample):
+            if not put((wid, sample)):
                 break
     except BaseException as e:  # noqa: BLE001 - surfaced in the parent
         put(("__error__", repr(e)))
@@ -122,6 +130,8 @@ class DataLoader:
         prefetch: int = 2,
         num_procs: int = 0,
         name: str = "default",
+        worker_restarts: int = 1,
+        worker_poll_s: float = 10.0,
     ):
         self.dataset = dataset
         self.name = name  # labels this loader's obs metrics (train vs val)
@@ -135,6 +145,12 @@ class DataLoader:
         self.collate_fn = collate_fn
         self.prefetch = prefetch
         self.num_procs = num_procs
+        # times a dead worker PROCESS (OOM-killed, segfaulted) is replaced
+        # and its undelivered samples resubmitted before the loader gives up;
+        # worker_poll_s is the dead-worker check cadence while the queue is
+        # quiet (tests shrink it — a liveness probe, not a correctness knob)
+        self.worker_restarts = worker_restarts
+        self.worker_poll_s = worker_poll_s
         if num_procs > 0 and not hasattr(dataset, "split"):
             raise TypeError(
                 f"num_procs={num_procs} needs a dataset with .split(i, n); "
@@ -210,8 +226,32 @@ class DataLoader:
         out_q: "mp.Queue" = ctx.Queue(maxsize=self.num_procs * 64)
         stop = ctx.Event()
         procs = []
-        saved = {k: os.environ.get(k) for k in ("JAX_PLATFORMS",)}
-        os.environ["JAX_PLATFORMS"] = "cpu"
+        shards = []
+
+        def spawn(wid: int, skip: int = 0):
+            """Start (or restart) worker `wid` on its pre-built slice; the
+            env override pins any jax import in the child to CPU."""
+            saved = {k: os.environ.get(k) for k in ("JAX_PLATFORMS",)}
+            os.environ["JAX_PLATFORMS"] = "cpu"
+            try:
+                p = ctx.Process(
+                    target=_proc_worker,
+                    args=(shards[wid], self.transform, epoch_seed, wid,
+                          out_q, stop, skip),
+                    daemon=True,
+                )
+                p.start()
+                return p
+            finally:
+                for k, v in saved.items():
+                    if v is None:
+                        os.environ.pop(k, None)
+                    else:
+                        os.environ[k] = v
+
+        # Spawn, not fork (see docstring). Build every slice up front: a
+        # replacement worker re-pickles the SAME slice object, which the
+        # parent never iterates, so its replay order is deterministic.
         try:
             for i in range(self.num_procs):
                 shard = self.dataset.split(i, self.num_procs)
@@ -220,13 +260,8 @@ class DataLoader:
                 # propagate the loader's epoch into each slice explicitly
                 if hasattr(shard, "set_epoch"):
                     shard.set_epoch(epoch)
-                p = ctx.Process(
-                    target=_proc_worker,
-                    args=(shard, self.transform, epoch_seed, i, out_q, stop),
-                    daemon=True,
-                )
-                p.start()
-                procs.append(p)
+                shards.append(shard)
+                procs.append(spawn(i))
         except BaseException:
             # a failed start (EAGAIN at high num_procs) must not leak the
             # already-live workers for the process's lifetime
@@ -236,37 +271,91 @@ class DataLoader:
                 if p.is_alive():
                     p.terminate()
             raise
-        finally:
-            for k, v in saved.items():
-                if v is None:
-                    os.environ.pop(k, None)
-                else:
-                    os.environ[k] = v
         done: set = set()
+        delivered = [0] * self.num_procs  # samples consumed per worker id
+        restarts = [0] * self.num_procs
+
+        def classify(item):
+            """-> ('done', wid) | ('sample', wid, sample); raises on error."""
+            if isinstance(item, tuple) and len(item) == 2:
+                tag = item[0]
+                if tag == "__done__":
+                    return ("done", item[1])
+                if tag == "__error__":
+                    raise RuntimeError(f"data worker failed: {item[1]}")
+                return ("sample", tag, item[1])
+            return ("sample", None, item)
+
         try:
-            while len(done) < len(procs):
+            while len(done) < self.num_procs:
                 try:
-                    item = out_q.get(timeout=10)
+                    item = out_q.get(timeout=self.worker_poll_s)
                 except queue.Empty:
                     # watchdog: a SIGKILL'd/segfaulted worker writes no done
-                    # marker; without this the loader would hang forever
+                    # marker; without this the loader would hang forever.
                     failed = [
                         i for i, p in enumerate(procs)
                         if i not in done and not p.is_alive()
                     ]
-                    if failed and out_q.empty():
-                        raise RuntimeError(
-                            f"data worker(s) {failed} died without a done "
-                            "marker (OOM-killed or crashed in native code)"
-                        )
-                    continue
-                if isinstance(item, tuple) and len(item) == 2:
-                    if item[0] == "__done__":
-                        done.add(item[1])
+                    if not failed:
                         continue
-                    if item[0] == "__error__":
-                        raise RuntimeError(f"data worker failed: {item[1]}")
-                yield item
+                    # Drain what the dead worker(s) already shipped BEFORE
+                    # deciding the resubmission point: anything still in the
+                    # queue would otherwise be replayed twice. A dead
+                    # producer adds nothing, so get_nowait-until-Empty is a
+                    # consistent snapshot of its output.
+                    while True:
+                        try:
+                            extra = out_q.get_nowait()
+                        except queue.Empty:
+                            break
+                        kind = classify(extra)
+                        if kind[0] == "done":
+                            done.add(kind[1])
+                            continue
+                        _, wid, sample = kind
+                        if wid is not None:
+                            delivered[wid] += 1
+                        yield sample
+                    for wid in failed:
+                        if wid in done:
+                            continue  # its done marker was in the drain
+                        if restarts[wid] >= self.worker_restarts:
+                            raise RuntimeError(
+                                f"data worker {wid} died without a done "
+                                f"marker {restarts[wid] + 1}x (OOM-killed or "
+                                "crashed in native code); restart budget "
+                                f"({self.worker_restarts}) spent"
+                            )
+                        restarts[wid] += 1
+                        print(
+                            f"data: worker {wid} died (OOM-killed or crashed "
+                            f"in native code); restarting it and resubmitting "
+                            f"its in-flight samples (delivered "
+                            f"{delivered[wid]}, restart {restarts[wid]}/"
+                            f"{self.worker_restarts})", flush=True,
+                        )
+                        try:
+                            from deep_vision_tpu.obs.registry import (
+                                get_registry,
+                            )
+
+                            get_registry().counter(
+                                "data_worker_restarts_total",
+                                "dead data workers replaced",
+                                labels={"loader": self.name}).inc()
+                        except Exception:
+                            pass
+                        procs[wid] = spawn(wid, skip=delivered[wid])
+                    continue
+                kind = classify(item)
+                if kind[0] == "done":
+                    done.add(kind[1])
+                    continue
+                _, wid, sample = kind
+                if wid is not None:
+                    delivered[wid] += 1
+                yield sample
         finally:
             stop.set()
             # drain so children blocked in put() can observe the stop

@@ -8,16 +8,34 @@ The shard files every reference converter writes
 
 crc32c comes from `google_crc32c` (C extension) so the Python reader sustains
 record throughput; a C++ reader (`native/`) is the fast path for training.
+
+Degradation contract (the Varuna/Check-N-Run posture: at production scale
+SOME shard always has a rotten byte): `read_records` keeps its strict
+raise-on-corruption semantics (native-reader parity), while
+`read_records_tolerant` + `BadRecordBudget` skip bad records under a
+bounded budget — each skip is appended to a dead-letter JSONL with
+file + byte offset + reason, and the run aborts with a clear
+`BadRecordBudgetExceeded` once the budget is spent. Because a record's
+data CRC sits behind an intact length header, data corruption is
+resyncable (skip exactly that record); a corrupt *header* loses the
+framing, so the shard remainder is dead-lettered as one event rather
+than risking garbage frames.
 """
 from __future__ import annotations
 
 import glob as _glob
+import json
 import os
 import random
 import struct
-from typing import Iterable, Iterator, List, Optional, Sequence, Union
+import sys
+import threading
+import time
+from typing import Iterable, Iterator, List, Optional, Sequence, Tuple, Union
 
 import google_crc32c
+
+from deep_vision_tpu.resilience import RetryPolicy, faults
 
 _MASK_DELTA = 0xA282EAD8
 
@@ -64,7 +82,10 @@ def write_records(path: str, records: Iterable[bytes]) -> int:
 
 
 def read_records(path: str, verify: bool = True) -> Iterator[bytes]:
-    """Yield raw record payloads from one file."""
+    """Yield raw record payloads from one file (strict: corruption raises).
+
+    `faults.fire("data.read")` is the chaos-test hook; it costs one global
+    None-check per record when no fault spec is installed."""
     with open(path, "rb") as f:
         while True:
             header = f.read(8)
@@ -82,7 +103,180 @@ def read_records(path: str, verify: bool = True) -> Iterator[bytes]:
             (dcrc,) = struct.unpack("<I", f.read(4))
             if verify and _masked_crc(data) != dcrc:
                 raise IOError(f"corrupt record in {path}")
+            faults.fire("data.read")
             yield data
+
+
+# -- bounded-degradation reading ---------------------------------------------
+
+class BadRecordBudgetExceeded(RuntimeError):
+    """The run's tolerance for bad records is spent; aborting is now the
+    correct behavior (silent unbounded skipping would train on a silently
+    shrinking dataset)."""
+
+
+class BadRecordBudget:
+    """Counts skipped records against a bound and dead-letters each one.
+
+    max_count:     absolute cap on skipped records (None = uncapped).
+    max_fraction:  cap on bad/seen, enforced once `min_seen` records have
+                   been observed (a fraction over 3 records is noise).
+    dead_letter_path: JSONL, one line per skipped record with file, byte
+                   offset, reason, and timestamp. Appended with O_APPEND
+                   per line so worker processes can share one file.
+    journal:       obs.RunJournal for typed `data_skip` events (dropped on
+                   pickling — spawned workers keep the dead-letter file and
+                   counters, the parent keeps the journal).
+
+    Thread-safe; picklable (DataLoader worker processes receive a copy, so
+    with `num_procs > 0` the bound applies per worker — the global worst
+    case is num_procs * budget, documented in the README).
+    """
+
+    def __init__(self, max_count: Optional[int] = None,
+                 max_fraction: Optional[float] = None,
+                 min_seen: int = 100,
+                 dead_letter_path: Optional[str] = None,
+                 journal=None):
+        if max_count is None and max_fraction is None:
+            raise ValueError("budget needs max_count and/or max_fraction")
+        self.max_count = max_count
+        self.max_fraction = max_fraction
+        self.min_seen = min_seen
+        self.dead_letter_path = dead_letter_path
+        self.journal = journal
+        self.bad = 0
+        self.ok = 0
+        self._lock = threading.Lock()
+
+    @classmethod
+    def parse(cls, spec: str, **kw) -> "BadRecordBudget":
+        """CLI form: a value < 1 is a fraction, >= 1 an absolute count."""
+        v = float(spec)
+        if v <= 0:
+            raise ValueError(f"bad-record budget must be positive, got {spec}")
+        if v < 1.0:
+            return cls(max_fraction=v, **kw)
+        return cls(max_count=int(v), **kw)
+
+    def __getstate__(self):
+        d = dict(self.__dict__)
+        d["journal"] = None
+        d["_lock"] = None
+        return d
+
+    def __setstate__(self, d):
+        self.__dict__.update(d)
+        self._lock = threading.Lock()
+
+    def describe(self) -> str:
+        parts = []
+        if self.max_count is not None:
+            parts.append(f"max_count={self.max_count}")
+        if self.max_fraction is not None:
+            parts.append(f"max_fraction={self.max_fraction}")
+        return " ".join(parts)
+
+    def record_ok(self, n: int = 1) -> None:
+        with self._lock:
+            self.ok += n
+
+    def _exceeded(self) -> bool:
+        if self.max_count is not None and self.bad > self.max_count:
+            return True
+        seen = self.bad + self.ok
+        return (self.max_fraction is not None and seen >= self.min_seen
+                and self.bad / seen > self.max_fraction)
+
+    def record_bad(self, path: str, offset: int, reason: str) -> None:
+        """Account one skipped record; raises once the budget is spent."""
+        with self._lock:
+            self.bad += 1
+            bad = self.bad
+        row = {"ts": round(time.time(), 3), "path": path,
+               "offset": int(offset), "reason": reason}
+        if self.dead_letter_path:
+            d = os.path.dirname(self.dead_letter_path)
+            if d:
+                os.makedirs(d, exist_ok=True)
+            with open(self.dead_letter_path, "a") as f:
+                f.write(json.dumps(row) + "\n")
+        try:
+            from deep_vision_tpu.obs.registry import get_registry
+
+            get_registry().counter(
+                "data_bad_records_total", "records skipped as bad").inc()
+        except Exception:
+            pass
+        if self.journal is not None:
+            self.journal.write("data_skip", **row)
+        # first few loudly, then every 100th: a rotting dataset must be
+        # visible in the log without drowning it
+        if bad <= 5 or bad % 100 == 0:
+            print(f"data: SKIPPED bad record #{bad} at {path}:{offset} "
+                  f"({reason})"
+                  + (f" -> {self.dead_letter_path}"
+                     if self.dead_letter_path else ""),
+                  file=sys.stderr)
+        if self._exceeded():
+            raise BadRecordBudgetExceeded(
+                f"bad-record budget exceeded ({self.describe()}): "
+                f"{self.bad} bad of {self.bad + self.ok} seen; last: "
+                f"{path}:{offset} ({reason})"
+                + (f"; full list in {self.dead_letter_path}"
+                   if self.dead_letter_path else ""))
+
+
+# shard opens retry transient I/O (flaky network filesystems); corruption
+# inside the file is the budget's job, not the retry's
+_OPEN_RETRY = RetryPolicy(name="data.open", max_attempts=3,
+                          base_delay_s=0.2, max_delay_s=2.0)
+
+
+def read_records_tolerant(
+    path: str, budget: BadRecordBudget, verify: bool = True
+) -> Iterator[Tuple[int, bytes]]:
+    """Yield (byte_offset, payload), skipping bad records under `budget`.
+
+    Data-CRC corruption is resyncable (the length header framed the record)
+    and skips exactly one record; a corrupt/truncated header loses the
+    framing, so the shard remainder is dead-lettered as ONE budget event.
+    `BadRecordBudgetExceeded` propagates to the caller — that is the abort.
+    """
+    with _OPEN_RETRY.call(open, path, "rb") as f:
+        while True:
+            offset = f.tell()
+            header = f.read(8)
+            if not header:
+                return
+            if len(header) < 8:
+                budget.record_bad(path, offset, "truncated record header")
+                return
+            (length,) = struct.unpack("<Q", header)
+            hcrc_b = f.read(4)
+            if len(hcrc_b) < 4 or (
+                    verify and _masked_crc(header) != struct.unpack(
+                        "<I", hcrc_b)[0]):
+                budget.record_bad(
+                    path, offset,
+                    "corrupt record header (framing lost; skipping the "
+                    "shard remainder)")
+                return
+            data = f.read(length)
+            dcrc_b = f.read(4)
+            if len(data) < length or len(dcrc_b) < 4:
+                budget.record_bad(path, offset, "truncated record")
+                return
+            if verify and _masked_crc(data) != struct.unpack("<I", dcrc_b)[0]:
+                budget.record_bad(path, offset, "corrupt record data")
+                continue
+            try:
+                faults.fire("data.read")
+            except IOError as e:
+                budget.record_bad(path, offset, f"read fault: {e}")
+                continue
+            yield offset, data
+            budget.record_ok()
 
 
 def best_reader():

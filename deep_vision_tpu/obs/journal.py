@@ -12,6 +12,10 @@ Event types (full schema in obs/README.md):
                 loss_spike, divergence, hang with thread stacks)
   profile       profiler trace start/stop
   bench         one benchmark measurement (tools/bench_*.py)
+  retry         one retried/abandoned I/O attempt (resilience/retry.py)
+  fault         an injected fault fired (resilience/faults.py)
+  data_skip     a bad record skipped under the bad-record budget
+  ckpt_quarantine  a corrupt/incomplete checkpoint step quarantined
   note          free-form annotation
   crash         atexit marker: the process died without close()
   exit          clean close, with status
@@ -67,6 +71,7 @@ class RunJournal:
         # watchdog, data prefetch errors): one lock keeps lines whole
         self._lock = threading.Lock()
         self._f = None
+        self.dropped_lines = 0  # lines lost to journal I/O errors
         if self._primary:
             d = os.path.dirname(path)
             if d:
@@ -127,11 +132,36 @@ class RunJournal:
         row = {"event": event, "ts": round(time.time(), 3),
                "run_id": self.run_id}
         row.update({k: _jsonable(v) for k, v in fields.items()})
-        with self._lock:
-            if self._f is None:
-                return
-            self._f.write(json.dumps(row) + "\n")
-            self._f.flush()
+        # the fault hook sits OUTSIDE the lock: an injected fault that
+        # journals its own `fault` event re-enters write(), and the lock is
+        # not reentrant (the injector skips journaling for this one point,
+        # but the ordering keeps the invariant structural, not behavioral)
+        try:
+            from deep_vision_tpu.resilience import faults
+
+            faults.fire("journal.flush")
+            with self._lock:
+                if self._f is None:
+                    return
+                self._f.write(json.dumps(row) + "\n")
+                self._f.flush()
+        except OSError as e:
+            # telemetry must degrade, never kill the training it observes:
+            # a failed journal write drops the line, counts it, and the
+            # first drop is loud on stderr
+            self.dropped_lines += 1
+            if self.dropped_lines == 1:
+                print(f"journal: WRITE FAILED ({type(e).__name__}: {e}); "
+                      "dropping lines (journal_dropped_lines_total counts "
+                      "them)", file=sys.stderr)
+            try:
+                from deep_vision_tpu.obs.registry import get_registry
+
+                get_registry().counter(
+                    "journal_dropped_lines_total",
+                    "journal lines lost to I/O errors").inc()
+            except Exception:
+                pass
 
     def manifest(self, config: Optional[dict] = None, **extra) -> None:
         """The run's identity card: everything needed to interpret (or

@@ -1,0 +1,51 @@
+"""Resilience primitives: retrying I/O + deterministic fault injection.
+
+The layer that lets the trainer treat storage and transport as unreliable
+by design (ROADMAP north star: survive production traffic, not just a
+clean lab run):
+
+- `retry`:  `RetryPolicy` — exponential backoff + jitter, deadline,
+  retryable-exception classification; decorator / driver / attempt-loop
+  forms; typed `retry` journal events and metrics counters. Shared by
+  bench.py's rebuild-replay loop, the checkpoint sidecar writer, and
+  shard opens in the tolerant record reader.
+- `faults`: `FaultInjector` — seeded, deterministic faults driven by a
+  `--fault-spec` string, with named injection points at every I/O
+  boundary that cost one None-check when disabled. The mechanism behind
+  `make chaos-smoke` and the crash-consistency tests.
+
+Consumers of the skipping/quarantine behaviors these enable live next to
+their data: the bad-record budget + dead-letter writer in
+`data/records.py`, checkpoint quarantine in `core/checkpoint.py`.
+
+jax-free at import (like obs/registry) so spawned data workers can use
+both without dragging in a backend.
+"""
+from deep_vision_tpu.resilience.faults import (
+    ENV_SEED,
+    ENV_SPEC,
+    FaultInjected,
+    FaultInjector,
+    FaultSpecError,
+    fire,
+    install,
+    install_spec,
+    installed,
+    transform,
+)
+from deep_vision_tpu.resilience.retry import DEFAULT_RETRY_ON, RetryPolicy
+
+__all__ = [
+    "DEFAULT_RETRY_ON",
+    "ENV_SEED",
+    "ENV_SPEC",
+    "FaultInjected",
+    "FaultInjector",
+    "FaultSpecError",
+    "RetryPolicy",
+    "fire",
+    "install",
+    "install_spec",
+    "installed",
+    "transform",
+]

@@ -1,0 +1,282 @@
+"""Deterministic, seeded fault injection at the repo's I/O boundaries.
+
+Storage-failure handling is only trustworthy if it is *testable on CPU*
+— Check-N-Run and Varuna both validate their recovery paths with
+injected failures, not by waiting for real ones. This module is the
+repo's switchboard: named injection points sit at every I/O boundary
+(record read, sample decode, checkpoint save/restore, sidecar write,
+journal flush) and compile to a single module-global None-check when no
+spec is installed, so production runs pay nothing.
+
+Spec grammar (the `--fault-spec` CLI string)::
+
+    point:kind[@when][;point:kind[@when]...]
+
+    data.read:io_error@0.01      # each record read fails w.p. 0.01
+    ckpt.sidecar:crash_after_write   # SIGKILL after the 1st tmp write
+    ckpt.sidecar:corrupt@2       # flip bytes in the 2nd sidecar written
+    journal.flush:io_error@5     # exactly the 5th journal line errors
+
+`when` is a probability when it parses as a float < 1, and "fire exactly
+on the Nth hit of this point, once" when it is an integer >= 1 (the
+deterministic form every test and the chaos smoke use). Omitted, it
+means `1` (first hit). Kinds:
+
+    io_error           raise FaultInjected (an IOError subclass) at the point
+    crash              SIGKILL the current process at the point
+    crash_after_write  SIGKILL at the point's after-write stage (between a
+                       tmp-file write and its atomic rename — the torn-write
+                       window)
+    corrupt            deterministically flip bytes in data passed through
+                       `transform()` at the point (e.g. the sidecar payload)
+
+Rate faults draw from a per-rule `random.Random` seeded from
+(seed, point, kind), so a given seed reproduces the exact same fault
+sequence run over run. Installation also exports DVT_FAULT_SPEC /
+DVT_FAULT_SEED to the environment so spawned data-loader worker
+processes (data/pipeline.py spawn context) inherit the spec: this module
+auto-installs from those variables at import time. Fired faults emit a
+typed `fault` journal event (in the parent process, when a journal is
+attached) and bump `fault_injected_total{point=,kind=}`.
+"""
+from __future__ import annotations
+
+import os
+import random
+import signal
+import sys
+from typing import List, Optional
+
+ENV_SPEC = "DVT_FAULT_SPEC"
+ENV_SEED = "DVT_FAULT_SEED"
+
+#: the registered injection points; parse() rejects unknown ones so a
+#: typo'd spec fails loudly instead of silently injecting nothing
+POINTS = (
+    "data.read",      # one framed record read from a shard
+    "data.decode",    # Example decode + schema application
+    "ckpt.save",      # orbax array-tree save enqueue
+    "ckpt.restore",   # orbax array-tree restore
+    "ckpt.sidecar",   # host-state JSON sidecar write (has after_write stage)
+    "journal.flush",  # one journal line write+flush
+)
+KINDS = ("io_error", "crash", "crash_after_write", "corrupt")
+
+
+class FaultInjected(IOError):
+    """The injected transient I/O error; an IOError so every real handler
+    (retry policies, bad-record budgets) treats it exactly like the
+    genuine article, while tests can still tell it apart by type."""
+
+
+class FaultSpecError(ValueError):
+    """Unparseable --fault-spec string."""
+
+
+class _Rule:
+    def __init__(self, point: str, kind: str, when: float, seed: int):
+        self.point = point
+        self.kind = kind
+        # float in (0, 1): per-hit probability; int >= 1: fire exactly on
+        # the Nth hit, once
+        self.probability = when if when < 1.0 else None
+        self.nth = int(when) if when >= 1.0 else None
+        self.hits = 0
+        self.fired = 0
+        self._rng = random.Random(f"{seed}:{point}:{kind}")
+
+    def triggers(self) -> bool:
+        self.hits += 1
+        if self.nth is not None:
+            if self.hits == self.nth:
+                self.fired += 1
+                return True
+            return False
+        if self._rng.random() < self.probability:
+            self.fired += 1
+            return True
+        return False
+
+    def __repr__(self):
+        when = self.nth if self.nth is not None else f"@{self.probability}"
+        return f"_Rule({self.point}:{self.kind}@{when}, fired={self.fired})"
+
+
+class FaultInjector:
+    """Holds the parsed rules; `fire`/`transform` are its two hooks."""
+
+    def __init__(self, rules: List[_Rule], seed: int = 0, journal=None,
+                 registry=None):
+        self.rules = rules
+        self.seed = seed
+        self.journal = journal
+        self._registry = registry
+        self.spec = ";".join(
+            f"{r.point}:{r.kind}@{r.nth if r.nth is not None else r.probability}"
+            for r in rules
+        )
+
+    @classmethod
+    def parse(cls, spec: str, seed: int = 0, journal=None,
+              registry=None) -> "FaultInjector":
+        rules: List[_Rule] = []
+        for part in (spec or "").split(";"):
+            part = part.strip()
+            if not part:
+                continue
+            try:
+                point, rest = part.split(":", 1)
+            except ValueError:
+                raise FaultSpecError(
+                    f"fault spec entry {part!r} is not 'point:kind[@when]'")
+            if "@" in rest:
+                kind, when_s = rest.split("@", 1)
+                try:
+                    when = float(when_s)
+                except ValueError:
+                    raise FaultSpecError(
+                        f"fault spec {part!r}: '@{when_s}' is neither a "
+                        "probability (<1) nor an Nth-hit integer (>=1)")
+                if when <= 0:
+                    raise FaultSpecError(
+                        f"fault spec {part!r}: '@{when_s}' must be positive")
+            else:
+                kind, when = rest, 1.0
+            point, kind = point.strip(), kind.strip()
+            if point not in POINTS:
+                raise FaultSpecError(
+                    f"unknown injection point {point!r}; have {POINTS}")
+            if kind not in KINDS:
+                raise FaultSpecError(
+                    f"unknown fault kind {kind!r}; have {KINDS}")
+            rules.append(_Rule(point, kind, when, seed))
+        return cls(rules, seed=seed, journal=journal, registry=registry)
+
+    # -- bookkeeping ---------------------------------------------------------
+
+    def set_journal(self, journal) -> None:
+        """Attach the run journal after install (the CLI installs faults
+        before it builds the journal so data-loader construction is already
+        covered)."""
+        self.journal = journal
+
+    def _note(self, point: str, kind: str, stage: Optional[str]) -> None:
+        try:
+            reg = self._registry
+            if reg is None:
+                from deep_vision_tpu.obs.registry import get_registry
+
+                reg = get_registry()
+            reg.counter("fault_injected_total", "injected faults fired",
+                        labels={"point": point, "kind": kind}).inc()
+        except Exception:
+            pass
+        # journal.flush faults must not journal themselves: RunJournal.write
+        # is the caller one frame up and its re-entry would deadlock on the
+        # journal lock (and recurse through this very injection point)
+        if self.journal is not None and point != "journal.flush":
+            self.journal.write("fault", point=point, kind=kind,
+                               **({"stage": stage} if stage else {}))
+
+    # -- the two hooks -------------------------------------------------------
+
+    def fire(self, point: str, stage: Optional[str] = None) -> None:
+        """Raise/crash if a rule for `point` (at `stage`) triggers.
+
+        stage=None is a point's primary position (io_error/crash rules);
+        stage="after_write" is the post-tmp-write position only
+        crash_after_write rules match — the torn-write window.
+        """
+        for r in self.rules:
+            if r.point != point:
+                continue
+            if (r.kind == "crash_after_write") != (stage == "after_write"):
+                continue
+            if r.kind == "corrupt":
+                continue  # corrupt rules act in transform(), not fire()
+            if not r.triggers():
+                continue
+            self._note(point, r.kind, stage)
+            if r.kind == "io_error":
+                raise FaultInjected(
+                    f"injected io_error at {point}"
+                    + (f" (stage={stage})" if stage else ""))
+            # crash / crash_after_write: die the way real preemption does —
+            # no handlers, no atexit, no flushed buffers
+            sys.stderr.write(
+                f"faults: injected {r.kind} at {point} — SIGKILL\n")
+            sys.stderr.flush()
+            os.kill(os.getpid(), signal.SIGKILL)
+
+    def transform(self, point: str, data: bytes) -> bytes:
+        """Pass `data` through any triggered corrupt rules for `point`:
+        deterministically flip a byte in the middle and truncate the tail
+        (both torn-write signatures a checksum must catch)."""
+        for r in self.rules:
+            if r.point != point or r.kind != "corrupt":
+                continue
+            if not r.triggers():
+                continue
+            self._note(point, "corrupt", None)
+            if not data:
+                return b"\xff"
+            mid = len(data) // 2
+            data = (data[:mid]
+                    + bytes([data[mid] ^ 0xFF])
+                    + data[mid + 1:max(mid + 1, len(data) - 3)])
+        return data
+
+
+# -- module-global hook (the "compiles to a no-op" part) ----------------------
+
+_INSTALLED: Optional[FaultInjector] = None
+
+
+def installed() -> Optional[FaultInjector]:
+    return _INSTALLED
+
+
+def install(inj: Optional[FaultInjector]) -> Optional[FaultInjector]:
+    """Install (or, with None, clear) the process-wide injector."""
+    global _INSTALLED
+    _INSTALLED = inj
+    return inj
+
+
+def install_spec(spec: Optional[str], seed: int = 0, journal=None,
+                 export_env: bool = True) -> Optional[FaultInjector]:
+    """Parse + install a spec string; with export_env, also export it so
+    spawned data workers inherit the same faults (they auto-install from
+    the environment at import). Empty/None spec clears the installation."""
+    if not spec:
+        if export_env:
+            os.environ.pop(ENV_SPEC, None)
+            os.environ.pop(ENV_SEED, None)
+        return install(None)
+    inj = FaultInjector.parse(spec, seed=seed, journal=journal)
+    if export_env:
+        os.environ[ENV_SPEC] = spec
+        os.environ[ENV_SEED] = str(seed)
+    return install(inj)
+
+
+def fire(point: str, stage: Optional[str] = None) -> None:
+    """The hot-path hook: one global load + None check when disabled."""
+    inj = _INSTALLED
+    if inj is not None:
+        inj.fire(point, stage)
+
+
+def transform(point: str, data: bytes) -> bytes:
+    inj = _INSTALLED
+    return data if inj is None else inj.transform(point, data)
+
+
+# spawned worker processes inherit the spec through the environment
+if os.environ.get(ENV_SPEC):
+    try:
+        install_spec(os.environ[ENV_SPEC],
+                     seed=int(os.environ.get(ENV_SEED, "0") or "0"),
+                     export_env=False)
+    except FaultSpecError as e:  # a bad env spec must not break imports
+        sys.stderr.write(f"faults: ignoring {ENV_SPEC}: {e}\n")

@@ -139,7 +139,8 @@ class Trainer:
                 import os as _os
 
                 self._ema_ckpt = type(self.ckpt)(
-                    _os.path.join(self.ckpt.directory, "ema")
+                    _os.path.join(self.ckpt.directory, "ema"),
+                    journal=journal,
                 )
         # base LR for plateau scaling: scale is applied to this absolute value,
         # never compounded onto an already-scaled current LR
@@ -604,24 +605,45 @@ class Trainer:
             self._save_checkpoint(epoch, val_summary)
 
     def resume(self, step: Optional[int] = None) -> int:
-        """Restore state + host loggers/plateau; returns next epoch to run."""
+        """Restore state + host loggers/plateau; returns next epoch to run.
+
+        Rides CheckpointManager's fallback chain: with `step=None` a
+        corrupt/incomplete latest step is quarantined (typed
+        `ckpt_quarantine` journal event) and the newest valid one restores
+        instead — resume() survives a save the crash tore in half. When
+        NOTHING valid remains, returns 0: restarting from scratch is the
+        honest floor of the degradation ladder, and the journal records
+        why."""
         assert self.ckpt is not None, "no CheckpointManager configured"
         with span("checkpoint/restore", step=step if step is not None
                   else -1):
             self.state, host_state = self.ckpt.restore(self.state, step)
+        if self.journal is not None:
+            self.journal.write(
+                "note", note="resumed", step=int(self.state.step),
+                host_state_found=host_state is not None)
         self.state = jax.device_put(self.state, replicated(self.mesh))
         if self.ema is not None:
             restored_ema, ema_host = (None, None)
             if self._ema_ckpt is not None:
-                restored_ema, ema_host = self._ema_ckpt.restore_tree(
-                    dict(self.ema.params), step
-                )
+                # pin the EMA restore to the step the MAIN restore landed
+                # on: after a quarantine fallback the EMA dir's latest can
+                # be newer than the restored params, and a mixed-step
+                # (params, shadow) pair would silently change eval
+                ema_step = step if step is not None else int(self.state.step)
+                try:
+                    restored_ema, ema_host = self._ema_ckpt.restore_tree(
+                        dict(self.ema.params), ema_step
+                    )
+                except Exception:
+                    restored_ema, ema_host = (None, None)
             if restored_ema is not None:
                 self.ema.params = restored_ema
                 self.ema.load_state_dict(ema_host or {})
             else:
-                # checkpoint predates --ema-decay: seed from the restored
-                # weights rather than the fresh init
+                # checkpoint predates --ema-decay, or the EMA shadow for
+                # the restored step is itself missing/corrupt: seed from
+                # the restored weights rather than the fresh init
                 from deep_vision_tpu.train.ema import EmaParams
 
                 self.ema = EmaParams(self.state.params, decay=self.ema.decay,

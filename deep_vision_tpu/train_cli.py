@@ -128,13 +128,20 @@ def _fake_centernet(cfg: ExperimentConfig, n_batches: int):
 
 def build_dataloaders(cfg: ExperimentConfig, data_dir: str, fake: bool,
                       fake_batches: int, num_workers: int,
-                      preprocessing: str = "torch", num_procs: int = 0):
+                      preprocessing: str = "torch", num_procs: int = 0,
+                      bad_record_budget=None):
     """Returns (train_fn, eval_fn) thunks yielding batch dicts per epoch.
 
     `preprocessing` selects the ImageNet normalization chain: "torch" is the
     torchvision-stats chain (ResNet/pytorch/train.py:315-331); "tf" is the
     TF "ResNet preprocessing" 0-255 mean-subtraction variant
     (ResNet/tensorflow/data_load.py:158-193).
+
+    `bad_record_budget` (records.BadRecordBudget) applies only to the
+    record-backed kinds: corrupt/undecodable records are skipped and
+    dead-lettered under its bound instead of killing the epoch. One budget
+    object is shared by the train and eval datasets — the bound is per
+    run, not per split.
     """
     if fake or cfg.dataset.get("kind") == "fake":
         maker = {
@@ -206,9 +213,11 @@ def build_dataloaders(cfg: ExperimentConfig, data_dir: str, fake: bool,
             train_tf = Compose([train_tf, T.SpaceToDepth()])
             eval_tf = Compose([eval_tf, T.SpaceToDepth()])
         if _g.glob(rec_glob):
-            train_ds = RecordDataset(rec_glob, "imagenet", shuffle_shards=True)
+            train_ds = RecordDataset(rec_glob, "imagenet", shuffle_shards=True,
+                                     bad_record_budget=bad_record_budget)
             eval_ds = RecordDataset(
-                os.path.join(data_dir, "tfrecord_val", "*"), "imagenet"
+                os.path.join(data_dir, "tfrecord_val", "*"), "imagenet",
+                bad_record_budget=bad_record_budget,
             )
             train = DataLoader(train_ds, cfg.batch_size, train_tf, shuffle=True,
                                shuffle_buffer=10000, num_workers=num_workers,
@@ -260,9 +269,11 @@ def build_dataloaders(cfg: ExperimentConfig, data_dir: str, fake: bool,
         train_ds = RecordDataset(
             os.path.join(data_dir, cfg.dataset.get("train_glob", "train*")),
             schema, shuffle_shards=True,
+            bad_record_budget=bad_record_budget,
         )
         eval_ds = RecordDataset(
-            os.path.join(data_dir, cfg.dataset.get("val_glob", "val*")), schema
+            os.path.join(data_dir, cfg.dataset.get("val_glob", "val*")), schema,
+            bad_record_budget=bad_record_budget,
         )
         train = DataLoader(train_ds, cfg.batch_size, Compose(train_chain),
                            shuffle=True, num_workers=num_workers,
@@ -361,7 +372,8 @@ def build_trainer(cfg: ExperimentConfig, train_fn, ckpt_dir: Optional[str],
         raise ValueError(f"task {cfg.task!r} uses a GAN trainer, not Trainer")
 
     plateau = ReduceLROnPlateau(**cfg.plateau) if cfg.plateau else None
-    ckpt = CheckpointManager(ckpt_dir) if ckpt_dir else None
+    # journal-wired: quarantines and sidecar retries become typed events
+    ckpt = CheckpointManager(ckpt_dir, journal=journal) if ckpt_dir else None
     sample = jnp.ones((2, *model_input_shape(cfg)), jnp.float32)
     from deep_vision_tpu.core.metrics import MetricLogger
     from deep_vision_tpu.obs.registry import get_registry
@@ -503,7 +515,7 @@ def _maybe_upload(args, ckpt_dir: str) -> None:
     print(f"uploaded checkpoints to {uri}")
 
 
-def _make_journal(args, cfg: ExperimentConfig):
+def _make_journal(args, cfg: ExperimentConfig, budget=None):
     if not args.journal:
         return None
     import dataclasses
@@ -512,6 +524,16 @@ def _make_journal(args, cfg: ExperimentConfig):
 
     journal = RunJournal(args.journal, kind="train")
     journal.manifest(config=dataclasses.asdict(cfg))
+    # late-attach the resilience emitters (both are built before the
+    # journal exists): injected faults and skipped records then show up
+    # as typed `fault`/`data_skip` events next to the steps they hit
+    from deep_vision_tpu.resilience import installed
+
+    inj = installed()
+    if inj is not None:
+        inj.set_journal(journal)
+    if budget is not None:
+        budget.journal = journal
     return journal
 
 
@@ -632,6 +654,25 @@ def main(argv: Optional[List[str]] = None) -> int:
                              "stderr and a 'health' journal event (a hung "
                              "multi-host collective stays diagnosable "
                              "post-mortem)")
+    parser.add_argument("--fault-spec", default=None, metavar="SPEC",
+                        help="inject deterministic faults at named I/O "
+                             "points (resilience/faults.py), e.g. "
+                             "'data.read:io_error@0.01;ckpt.sidecar:"
+                             "crash_after_write'; exported to data-worker "
+                             "processes via the environment")
+    parser.add_argument("--fault-seed", type=int, default=0,
+                        help="seed for probabilistic fault rules (same seed "
+                             "= same fault sequence)")
+    parser.add_argument("--bad-record-budget", default=None, metavar="N|FRAC",
+                        help="skip corrupt/undecodable records instead of "
+                             "crashing, up to this many (>=1) or this "
+                             "fraction (<1) of records seen; each skip is "
+                             "dead-lettered with file+offset, and the run "
+                             "aborts once the budget is spent (per worker "
+                             "process with --num-procs)")
+    parser.add_argument("--dead-letter", default=None, metavar="PATH",
+                        help="dead-letter JSONL for skipped records "
+                             "(default: <ckpt-dir>/dead_letter.jsonl)")
     parser.add_argument("--eval-first", action="store_true",
                         help="epoch-0 sanity validate (ResNet/pytorch/train.py:390)")
     parser.add_argument("--eval-only", action="store_true",
@@ -680,9 +721,29 @@ def main(argv: Optional[List[str]] = None) -> int:
         print("warning: --preprocessing tf only applies to the ImageNet "
               "records/folder pipeline; this run uses its default chain")
 
+    # faults install BEFORE any data/checkpoint object is built so loader
+    # construction is already covered; the journal attaches once it exists
+    if args.fault_spec:
+        from deep_vision_tpu.resilience import install_spec
+
+        install_spec(args.fault_spec, seed=args.fault_seed)
+        print(f"faults: installed spec {args.fault_spec!r} "
+              f"(seed {args.fault_seed})")
+    budget = None
+    if args.bad_record_budget:
+        from deep_vision_tpu.data.records import BadRecordBudget
+
+        default_ckpt = args.ckpt_dir or os.path.join("checkpoints", cfg.name)
+        budget = BadRecordBudget.parse(
+            args.bad_record_budget,
+            dead_letter_path=args.dead_letter or os.path.join(
+                default_ckpt, "dead_letter.jsonl"),
+        )
+
     train_fn, eval_fn = build_dataloaders(
         cfg, args.data_dir, args.fake_data, args.fake_batches, args.num_workers,
         preprocessing=args.preprocessing, num_procs=args.num_procs,
+        bad_record_budget=budget,
     )
 
     if cfg.task in ("dcgan", "cyclegan"):
@@ -695,7 +756,7 @@ def main(argv: Optional[List[str]] = None) -> int:
 
         from deep_vision_tpu.core.summary import count_params
 
-        journal = _make_journal(args, cfg)
+        journal = _make_journal(args, cfg, budget=budget)
         tracer = _make_tracer(args, journal)
         health = _make_health(args, journal)
         trainer = build_gan_trainer(
@@ -742,6 +803,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         gan_ckpt = CheckpointManager(
             ckpt_dir,
             max_to_keep=3 if cfg.task == "dcgan" else None,
+            journal=journal,
         )
         if args.checkpoint:
             start_epoch = trainer.restore(gan_ckpt)
@@ -815,7 +877,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 0
 
     ckpt_dir = args.ckpt_dir or os.path.join("checkpoints", cfg.name)
-    journal = _make_journal(args, cfg)
+    journal = _make_journal(args, cfg, budget=budget)
     tracer = _make_tracer(args, journal)
     health = _make_health(args, journal)
     trainer = build_trainer(cfg, train_fn, ckpt_dir,
@@ -849,7 +911,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         if args.checkpoint != "auto":
             # saves (and the end-of-run upload) follow the resume dir
             ckpt_dir = args.checkpoint
-            trainer.ckpt = type(trainer.ckpt)(ckpt_dir)
+            trainer.ckpt = type(trainer.ckpt)(ckpt_dir, journal=journal)
         start_epoch = trainer.resume()
         print(f"resumed from step {int(trainer.state.step)} -> epoch {start_epoch}")
     if args.eval_only:

@@ -1,0 +1,277 @@
+"""Chaos smoke: a tiny CPU train run under injected faults, then validate.
+
+    PYTHONPATH=. python tools/chaos_run.py [--workdir artifacts/chaos_smoke]
+
+The CI teeth behind the resilience/ contracts (`make chaos-smoke`), the
+way obs-smoke is the teeth behind the obs/ schemas. Three phased runs of
+a record-backed LeNet-scale train (tiny synthetic shards written on the
+fly), each a real `train_cli.main()` subprocess:
+
+  1. bad-data     `data.read:io_error@0.02` with a bad-record budget:
+                  the run must COMPLETE, every skipped record must land
+                  in the dead-letter JSONL with file+offset, the skip
+                  count must sit within budget, and the journal must
+                  pass `check_journal --strict` (typed `fault` +
+                  `data_skip` events included).
+  2. torn-save    `ckpt.sidecar:corrupt@2;ckpt.sidecar:crash_after_write@3`:
+                  epoch 2's sidecar is bit-flipped after checksumming
+                  (storage rot) and epoch 3's save is SIGKILLed inside
+                  the torn-write window. The run must die by SIGKILL —
+                  that is the injected preemption.
+  3. resume       same checkpoint dir, no faults: `resume()` must
+                  QUARANTINE the corrupt/incomplete steps (typed
+                  `ckpt_quarantine` events), fall back to the newest
+                  valid one, and train to completion.
+
+Plus a no-fault overhead probe: with no spec installed, an injection
+point is one module-global load + None check — the probe times it and
+fails if it ever becomes measurable against a step budget.
+
+Exit status 0 = every phase held; 1 = a resilience contract is broken.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import time
+from typing import List, Optional
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+CONFIG = "lenet5_chaos"
+SCHEMA = "chaos_mnist"
+EPOCHS = 3
+TRAIN_RECORDS_PER_SHARD = 96
+TRAIN_SHARDS = 2
+VAL_RECORDS = 48
+# one module-global load + None check; 2us would already be absurd
+MAX_DISABLED_FIRE_NS = 2000.0
+
+
+def register_chaos_config() -> None:
+    """Register the records-backed tiny config + raw-image schema the
+    chaos children train with (kept out of the production registry: only
+    chaos_run processes ever see it)."""
+    import numpy as np
+
+    from deep_vision_tpu.configs import ExperimentConfig, register_config
+    from deep_vision_tpu.data import datasets
+
+    def chaos_mnist_schema(feats):
+        img = np.frombuffer(feats["image/raw"][0], np.uint8).reshape(28, 28, 1)
+        return {"image": img, "label": np.int32(feats["image/class/label"][0])}
+
+    datasets.SCHEMAS.setdefault(SCHEMA, chaos_mnist_schema)
+    if CONFIG not in __import__(
+            "deep_vision_tpu.configs", fromlist=["CONFIG_REGISTRY"]
+    ).CONFIG_REGISTRY:
+        register_config(ExperimentConfig(
+            name=CONFIG, task="classification", model="lenet5",
+            input_shape=(32, 32, 1), num_classes=10, batch_size=16,
+            epochs=EPOCHS,
+            optimizer={"name": "adam", "learning_rate": 1e-3},
+            dataset={"kind": "records", "schema": SCHEMA},
+        ))
+
+
+def child_main(argv: List[str]) -> int:
+    """`chaos_run.py --child <train args...>`: a normal train_cli run with
+    the chaos config registered first."""
+    register_chaos_config()
+    from deep_vision_tpu.train_cli import main
+
+    return main(argv)
+
+
+# -- parent-side helpers ------------------------------------------------------
+
+def write_shards(data_dir: str) -> None:
+    import numpy as np
+
+    from deep_vision_tpu.data.example_codec import encode_example
+    from deep_vision_tpu.data.records import write_records
+
+    os.makedirs(data_dir, exist_ok=True)
+    rng = np.random.RandomState(0)
+
+    def example(label: int) -> bytes:
+        img = rng.randint(0, 256, size=(28, 28, 1), dtype=np.uint8)
+        return encode_example({
+            "image/raw": [img.tobytes()],
+            "image/class/label": [label],
+        })
+
+    for s in range(TRAIN_SHARDS):
+        write_records(
+            os.path.join(data_dir, f"train-{s:05d}"),
+            [example(i % 10) for i in range(TRAIN_RECORDS_PER_SHARD)],
+        )
+    write_records(
+        os.path.join(data_dir, "val-00000"),
+        [example(i % 10) for i in range(VAL_RECORDS)],
+    )
+
+
+def run_child(train_args: List[str], log_path: str,
+              timeout: float = 600.0) -> int:
+    env = dict(os.environ, PYTHONPATH=ROOT, JAX_PLATFORMS="cpu")
+    # a parent-installed spec must never leak into a child that did not
+    # ask for one (phase 3 resumes WITHOUT faults)
+    env.pop("DVT_FAULT_SPEC", None)
+    env.pop("DVT_FAULT_SEED", None)
+    with open(log_path, "w") as log:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--child"]
+            + train_args,
+            cwd=ROOT, env=env, stdout=log, stderr=subprocess.STDOUT,
+            timeout=timeout,
+        )
+    return proc.returncode
+
+
+def read_jsonl(path: str) -> List[dict]:
+    if not os.path.exists(path):
+        return []
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                try:
+                    out.append(json.loads(line))
+                except json.JSONDecodeError:
+                    pass  # a torn final line is the crash phases' signature
+    return out
+
+
+def check_journal_strict(path: str) -> bool:
+    rc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "check_journal.py"),
+         path, "--strict"],
+        cwd=ROOT, env=dict(os.environ, PYTHONPATH=ROOT),
+    ).returncode
+    return rc == 0
+
+
+class Failures:
+    def __init__(self):
+        self.errors: List[str] = []
+
+    def check(self, ok: bool, what: str) -> bool:
+        print(("  ok  " if ok else "  FAIL") + f"  {what}")
+        if not ok:
+            self.errors.append(what)
+        return ok
+
+
+def probe_disabled_overhead() -> float:
+    """ns per faults.fire() call with no spec installed."""
+    from deep_vision_tpu.resilience import faults
+
+    assert faults.installed() is None
+    n = 200_000
+    fire = faults.fire
+    t0 = time.perf_counter()
+    for _ in range(n):
+        fire("data.read")
+    return (time.perf_counter() - t0) / n * 1e9
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if argv and argv[0] == "--child":
+        return child_main(argv[1:])
+
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--workdir", default="artifacts/chaos_smoke")
+    args = p.parse_args(argv)
+
+    work = os.path.abspath(args.workdir)
+    shutil.rmtree(work, ignore_errors=True)
+    os.makedirs(work)
+    data_dir = os.path.join(work, "data")
+    write_shards(data_dir)
+    f = Failures()
+
+    # -- phase 1: bad data under budget ---------------------------------
+    print("phase 1: data.read:io_error@0.02 under a bad-record budget")
+    ckpt1 = os.path.join(work, "ckpt_bad_data")
+    j1 = os.path.join(work, "journal_bad_data.jsonl")
+    dead = os.path.join(work, "dead_letter.jsonl")
+    rc = run_child(
+        ["-m", CONFIG, "--data-dir", data_dir, "--epochs", str(EPOCHS),
+         "--ckpt-dir", ckpt1, "--journal", j1,
+         "--fault-spec", "data.read:io_error@0.02", "--fault-seed", "7",
+         "--bad-record-budget", "50", "--dead-letter", dead],
+        os.path.join(work, "phase1.log"),
+    )
+    f.check(rc == 0, f"bad-data run completed (rc={rc})")
+    skips = read_jsonl(dead)
+    f.check(len(skips) >= 1, f"dead-letter has skipped records ({len(skips)})")
+    f.check(len(skips) <= 50, f"skips within budget ({len(skips)} <= 50)")
+    f.check(all("path" in s and "offset" in s and "reason" in s
+                for s in skips), "dead-letter rows carry path+offset+reason")
+    ev1 = {e.get("event") for e in read_jsonl(j1)}
+    f.check("fault" in ev1 and "data_skip" in ev1,
+            f"journal carries typed fault + data_skip events ({sorted(ev1)})")
+    f.check(check_journal_strict(j1), "check_journal --strict accepts journal")
+
+    # -- phase 2: rot one sidecar, SIGKILL inside the next torn window --
+    print("phase 2: sidecar rot + SIGKILL mid-checkpoint-save")
+    ckpt2 = os.path.join(work, "ckpt_crash")
+    j2 = os.path.join(work, "journal_crash.jsonl")
+    rc = run_child(
+        ["-m", CONFIG, "--data-dir", data_dir, "--epochs", str(EPOCHS),
+         "--ckpt-dir", ckpt2, "--journal", j2,
+         "--fault-spec",
+         "ckpt.sidecar:corrupt@2;ckpt.sidecar:crash_after_write@3"],
+        os.path.join(work, "phase2.log"),
+    )
+    f.check(rc == -signal.SIGKILL,
+            f"run died by injected SIGKILL mid-save (rc={rc})")
+    f.check(any(e.get("event") == "fault" and e.get("kind") == "corrupt"
+                for e in read_jsonl(j2)),
+            "journal recorded the injected sidecar corruption")
+
+    # -- phase 3: resume must quarantine and fall back ------------------
+    print("phase 3: resume quarantines the torn steps and recovers")
+    j3 = os.path.join(work, "journal_resume.jsonl")
+    rc = run_child(
+        ["-m", CONFIG, "--data-dir", data_dir, "--epochs", str(EPOCHS),
+         "--ckpt-dir", ckpt2, "-c", ckpt2, "--journal", j3],
+        os.path.join(work, "phase3.log"),
+    )
+    f.check(rc == 0, f"resume run completed (rc={rc})")
+    ev3 = read_jsonl(j3)
+    quarantined = [e for e in ev3 if e.get("event") == "ckpt_quarantine"]
+    f.check(len(quarantined) >= 1,
+            f"resume quarantined the corrupt step(s) ({len(quarantined)})")
+    f.check(os.path.isdir(os.path.join(ckpt2, "quarantine")),
+            "quarantined artifacts preserved under ckpt/quarantine/")
+    f.check(any(e.get("event") == "note" and e.get("note") == "resumed"
+                and e.get("step", 0) > 0 for e in ev3),
+            "resume restored a non-zero fallback step")
+    f.check(check_journal_strict(j3), "check_journal --strict accepts journal")
+
+    # -- disabled-injection overhead ------------------------------------
+    ns = probe_disabled_overhead()
+    f.check(ns < MAX_DISABLED_FIRE_NS,
+            f"disabled injection point costs {ns:.0f}ns/call "
+            f"(< {MAX_DISABLED_FIRE_NS:.0f}ns)")
+
+    if f.errors:
+        print(f"\nchaos-smoke: {len(f.errors)} contract(s) BROKEN "
+              f"(artifacts in {work})")
+        return 1
+    print(f"\nchaos-smoke: all resilience contracts held (artifacts in {work})")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -49,12 +49,17 @@ EVENT_FIELDS = {
     "health": ("kind",),
     "profile": ("action",),
     "bench": ("name", "result"),
+    "retry": ("name", "attempt", "error", "outcome"),
+    "fault": ("point", "kind"),
+    "data_skip": ("path", "offset", "reason"),
+    "ckpt_quarantine": ("step", "reason"),
     "note": (),
     "exit": ("status",),
     "crash": ("reason",),
 }
 HEALTH_KINDS = {"non_finite", "loss_spike", "divergence", "hang",
                 "watchdog_started"}
+RETRY_OUTCOMES = {"retrying", "gave_up", "recovered"}
 
 
 def check_journal(path: str, require_exit: bool = False,
@@ -109,6 +114,9 @@ def check_journal(path: str, require_exit: bool = False,
             if row.get("kind") == "hang" and not row.get("stacks"):
                 errors.append(f"{path}:{i}: hang event carries no thread "
                               "stacks")
+        if ev == "retry" and row.get("outcome") not in RETRY_OUTCOMES:
+            errors.append(f"{path}:{i}: unknown retry outcome "
+                          f"{row.get('outcome')!r}")
         events.append(row)
     if not events:
         errors.append(f"{path}: no events")
