@@ -53,8 +53,11 @@ verify: SHELL := /bin/bash
 verify: lint obs-smoke chaos-smoke
 	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
 
-# observability smoke: a tiny CPU train with tracing + health guard on,
-# then validate the journal/trace artifacts against the obs/ schemas
+# observability smoke: a tiny CPU train with tracing + health guard +
+# flight recorder + a static profiler window on, then validate the
+# journal/trace artifacts against the obs/ schemas (profile_capture
+# events included) and assert the clean exit left NO flight bundle —
+# the recorder must disarm on a healthy run
 obs-smoke:
 	rm -rf artifacts/obs_smoke
 	mkdir -p artifacts/obs_smoke
@@ -62,11 +65,15 @@ obs-smoke:
 	  --ckpt-dir artifacts/obs_smoke/ckpt \
 	  --journal artifacts/obs_smoke/journal.jsonl \
 	  --trace artifacts/obs_smoke/trace.json \
+	  --flight-dir artifacts/obs_smoke/flight \
+	  --profile-dir artifacts/obs_smoke/prof --profile-window 1:3 \
 	  --health-policy warn --watchdog-timeout 300
 	python tools/check_journal.py artifacts/obs_smoke/journal.jsonl \
 	  --trace artifacts/obs_smoke/trace.json --strict
 	python tools/obs_report.py artifacts/obs_smoke/journal.jsonl \
 	  --trace artifacts/obs_smoke/trace.json
+	@if [ -n "$$(ls -A artifacts/obs_smoke/flight 2>/dev/null)" ]; then \
+	  echo "obs-smoke: clean run left a flight bundle"; exit 1; fi
 
 # resilience smoke: a record-backed CPU train under injected faults
 # (skipped bad records within budget, SIGKILL mid-checkpoint-save,

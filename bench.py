@@ -461,6 +461,14 @@ _ACTIVE_POLICY = None
 def _recover_backend(attempt: int) -> None:
     """Best-effort client-side reset between retries of a dead tunnel:
     the shared policy's backoff, then a cache clear on later attempts."""
+    # flight-recorder breadcrumb (no-op without --flight-dir): repeated
+    # backend recoveries are the context a degraded-result postmortem needs
+    try:
+        from deep_vision_tpu.obs import flight as _flight
+
+        _flight.note("bench_backend_recovery", attempt=attempt)
+    except Exception:
+        pass
     (_ACTIVE_POLICY or _retry_policy()).backoff(attempt)
     if attempt >= 2:
         try:
@@ -880,7 +888,15 @@ if __name__ == "__main__":
     parser.add_argument("--sweep", metavar="OUT_JSON", default=None,
                         help="run the dispatch-overhead/batch sweep and "
                              "write the artifact JSON")
+    parser.add_argument("--flight-dir", default=None, metavar="DIR",
+                        help="flight recorder (obs/flight.py): dump a "
+                             "postmortem bundle under DIR if the bench "
+                             "dies (recovery breadcrumbs included)")
     args = parser.parse_args()
+    if args.flight_dir:
+        from deep_vision_tpu.obs import FlightRecorder, set_flight
+
+        set_flight(FlightRecorder(args.flight_dir))
     if args.data:
         stub = {
             "metric": f"imagenet_pipeline_{args.data}_images_per_sec_per_core",
@@ -914,6 +930,12 @@ if __name__ == "__main__":
         # own try/finally (e.g. a fixture-dir write error in data_main)
         stub["errors"] = stub.get("errors", []) + [f"{type(e).__name__}: {e}"]
         _log(f"fatal: {type(e).__name__}: {e}")
+        try:
+            from deep_vision_tpu.obs import flight as _flight
+
+            _flight.emergency_dump("crash")
+        except Exception:
+            pass
         _emit(stub)
     # hard exit, not fall-through: after a degraded run a wedged jax client
     # thread can hang interpreter teardown past the driver's timeout, which

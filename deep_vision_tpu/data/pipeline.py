@@ -346,6 +346,20 @@ class DataLoader:
                                 labels={"loader": self.name}).inc()
                         except Exception:
                             pass
+                        # flight-recorder breadcrumb: a worker that keeps
+                        # dying is prime postmortem context for the crash
+                        # or hang that often follows (no-op when no
+                        # recorder is installed)
+                        try:
+                            from deep_vision_tpu.obs import flight
+
+                            flight.note(
+                                "data_worker_restart", loader=self.name,
+                                worker=wid, delivered=delivered[wid],
+                                restart=restarts[wid],
+                                budget=self.worker_restarts)
+                        except Exception:
+                            pass
                         procs[wid] = spawn(wid, skip=delivered[wid])
                     continue
                 kind = classify(item)

@@ -17,11 +17,25 @@ records the reference's instrumentation as one examples/sec print):
 - `health`: NaN/Inf guard with warn/skip_step/abort policies, rolling
   z-score divergence detection, and a hang watchdog that dumps thread
   stacks — *why* the run died (`HealthMonitor`, `TrainingHealthError`).
+- `flight`: always-on bounded-memory flight recorder that dumps an
+  atomic crc-checked postmortem bundle on crash/hang/abort/preemption —
+  the black box (`FlightRecorder`, `set_flight`, `validate_bundle`).
+- `autoprof`: anomaly-triggered `jax.profiler` capture with cooldown
+  and budget, plus the configurable static window (`AutoProfiler`).
+- `merge`: per-host journal merge + cross-host straggler detection for
+  multi-host runs (`merge_journal_files`; CLI in tools/obs_merge.py).
 
-All file writers are process-0-only under `jax.process_index()`; metric
-*collection* runs on every host so counters stay meaningful if a
-follower is later asked to dump state.
+Metric/journal/trace writers are process-0-only in single-process runs;
+multi-process runs write per-host `.pN` files (registry.process_suffix)
+that `tools/obs_merge.py` stitches back into one timeline.
 """
+from deep_vision_tpu.obs.autoprof import AutoProfiler
+from deep_vision_tpu.obs.flight import (
+    FlightRecorder,
+    get_flight,
+    set_flight,
+    validate_bundle,
+)
 from deep_vision_tpu.obs.health import (
     HealthMonitor,
     TrainingHealthError,
@@ -43,15 +57,19 @@ from deep_vision_tpu.obs.registry import (
     Registry,
     get_registry,
     is_primary_host,
+    process_suffix,
 )
 from deep_vision_tpu.obs.stepclock import (
     StepClock,
     hbm_bytes_in_use,
+    hbm_stats,
     recompile_count,
 )
 
 __all__ = [
+    "AutoProfiler",
     "Counter",
+    "FlightRecorder",
     "Gauge",
     "HealthMonitor",
     "Histogram",
@@ -61,14 +79,19 @@ __all__ = [
     "Tracer",
     "TrainingHealthError",
     "dump_all_stacks",
+    "get_flight",
     "get_registry",
     "get_tracer",
     "hbm_bytes_in_use",
+    "hbm_stats",
     "is_primary_host",
+    "process_suffix",
     "read_journal",
     "recompile_count",
+    "set_flight",
     "set_tracer",
     "span",
     "trace_event",
     "traced",
+    "validate_bundle",
 ]

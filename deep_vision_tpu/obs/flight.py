@@ -1,0 +1,421 @@
+"""Flight recorder: always-on bounded-memory postmortem capture.
+
+The journal explains a run that finished; this module explains a run
+that *died*. The steady-state obs/ stack (journal, spans, health) leaves
+only a crash marker at the moment that matters most — production TPU
+stacks treat the anomaly itself as the trigger for deep data collection,
+and when host 7 of 32 dies at 3am the bundle that explains it must
+already exist on disk.
+
+A `FlightRecorder` keeps ring buffers (bounded memory, O(1) per event)
+of the recent past:
+
+  steps          the last N per-step journal records (timing + metrics)
+  health         recent health events (non_finite, spikes, hang dumps)
+  journal tail   the last N journal lines of ANY type, in order
+  notes          breadcrumbs from layers without a journal handle
+                 (data-pipeline worker restarts, bench backend recovery)
+  span tail      snapshotted from the active Tracer at dump time
+
+and dumps them as an atomic, crc-checked bundle directory
+
+  <flight_dir>/<run_id>-<reason>/
+      MANIFEST.json     run identity + reason + per-file size/crc32
+      journal_tail.jsonl  steps.jsonl  health.jsonl  notes.jsonl
+      spans.json        Chrome-trace tail (loads in Perfetto)
+      stacks.json       every Python thread's stack at dump time
+      metrics.prom      the metrics registry, Prometheus text format
+
+on any of the ways a run dies:
+
+  crash         process exits without a clean close (atexit, armed)
+  hang          the health watchdog fired (observed via the journal tap)
+  health_abort  the HealthMonitor abort policy tripped
+  preempt       SIGTERM / preemption (multihost.PreemptionGuard hook)
+  injected_crash[_after_write]  resilience fault injection, dumped in
+                the instants before its SIGKILL (faults.fire hook)
+
+Atomicity: the bundle is written into `<final>.tmp-<pid>` with per-file
+fsync, then renamed — a reader never sees a half-written bundle, and a
+SIGKILL that lands mid-dump leaves only a `.tmp-` directory that
+`validate_bundle` ignores. Each file's crc32 is recorded in the
+manifest so storage rot is detectable (`validate_bundle`).
+
+Cost when idle: `observe` is one dict lookup + deque append per journal
+event; layers without a recorder installed pay one module-global
+None-check in `note()`. The chaos smoke probes this against a 2%
+step-time budget.
+"""
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import threading
+import time
+import zlib
+from collections import deque
+from typing import Dict, List, Optional
+
+from deep_vision_tpu.obs.journal import _jsonable
+from deep_vision_tpu.obs.registry import process_suffix
+
+#: the dump reasons check_journal validates; dump() accepts any string
+#: (forward compat) but everything the repo emits is one of these
+REASONS = (
+    "crash",
+    "hang",
+    "health_abort",
+    "preempt",
+    "injected_crash",
+    "injected_crash_after_write",
+    "manual",
+)
+
+#: bundle payload files, in write order (MANIFEST.json is written last,
+#: after every payload crc is known)
+_PAYLOAD_FILES = (
+    "journal_tail.jsonl",
+    "steps.jsonl",
+    "health.jsonl",
+    "notes.jsonl",
+    "spans.json",
+    "stacks.json",
+    "metrics.prom",
+)
+
+
+class FlightRecorder:
+    """Bounded-memory black box for one run.
+
+    Wire-up (what train_cli does):
+
+        flight = FlightRecorder(flight_dir, run_id=journal.run_id)
+        set_flight(flight)              # layers without a journal handle
+        journal.add_tap(flight.observe) # feed the ring buffers
+        ...
+        flight.close()                  # clean exit: disarm, no dump
+
+    Anything that dies in between leaves a bundle: the atexit hook dumps
+    `crash` while armed, the journal tap dumps on hang/abort health
+    events, and the preemption/fault hooks call `emergency_dump`.
+    """
+
+    def __init__(self, flight_dir: str, run_id: Optional[str] = None,
+                 max_steps: int = 512, max_health: int = 256,
+                 max_tail: int = 1024, max_notes: int = 256,
+                 span_tail: int = 512, registry=None):
+        self.flight_dir = flight_dir
+        self.run_id = run_id or f"flight-{os.getpid()}-{int(time.time())}"
+        self.span_tail = int(span_tail)
+        self.registry = registry
+        self.journal = None  # attach() wires the flight_dump event emitter
+        self._steps: deque = deque(maxlen=int(max_steps))
+        self._health: deque = deque(maxlen=int(max_health))
+        self._tail: deque = deque(maxlen=int(max_tail))
+        self._notes: deque = deque(maxlen=int(max_notes))
+        self._lock = threading.Lock()
+        self._dumped: Dict[str, str] = {}  # reason -> bundle dir (latch)
+        self._dumping = False
+        self._armed = True
+        self._closed = False
+        atexit.register(self._atexit)
+
+    # -- feeding the buffers ----------------------------------------------
+
+    def attach(self, journal) -> None:
+        """Tap `journal` and remember it for typed `flight_dump` events."""
+        self.journal = journal
+        journal.add_tap(self.observe)
+
+    def observe(self, row: dict) -> None:
+        """Journal tap: route one event row into the ring buffers, and
+        trigger a dump when the row itself is the emergency (a watchdog
+        hang dump, a health-abort verdict)."""
+        ev = row.get("event")
+        with self._lock:
+            self._tail.append(row)
+            if ev == "step":
+                self._steps.append(row)
+            elif ev == "health":
+                self._health.append(row)
+        if ev == "health" and not self._dumping:
+            if row.get("kind") == "hang":
+                self.dump("hang")
+            elif row.get("action") == "abort":
+                self.dump("health_abort")
+
+    def note(self, category: str, **fields) -> None:
+        """Breadcrumb from a layer without a journal handle (data-pipeline
+        worker restarts, bench backend recovery)."""
+        row = {"ts": round(time.time(), 3), "category": str(category)}
+        row.update({k: _jsonable(v) for k, v in fields.items()})
+        with self._lock:
+            self._notes.append(row)
+
+    # -- dumping -----------------------------------------------------------
+
+    def dump(self, reason: str = "manual") -> Optional[str]:
+        """Write the postmortem bundle for `reason`; returns its path.
+
+        Latched per reason: one stall produces one `hang` bundle, and the
+        crash that may follow still gets its own `crash` bundle. A second
+        dump for an already-dumped reason returns the existing path.
+        """
+        with self._lock:
+            if reason in self._dumped:
+                return self._dumped[reason]
+            if self._dumping:
+                return None  # a dump triggered from inside a dump
+            self._dumping = True
+            steps = list(self._steps)
+            health = list(self._health)
+            tail = list(self._tail)
+            notes = list(self._notes)
+        try:
+            path = self._write_bundle(reason, steps, health, tail, notes)
+            with self._lock:
+                self._dumped[reason] = path
+            self._journal_event(reason, path, outcome="written")
+            return path
+        except Exception as e:
+            # the recorder must never turn a dying run into a different
+            # death; the failed dump is itself journaled when possible
+            self._journal_event(reason, self.flight_dir, outcome="failed",
+                                error=f"{type(e).__name__}: {e}")
+            return None
+        finally:
+            with self._lock:
+                self._dumping = False
+
+    def _journal_event(self, reason: str, path: str, outcome: str,
+                       **extra) -> None:
+        if self.journal is not None:
+            try:
+                self.journal.write("flight_dump", reason=reason, dir=path,
+                                   outcome=outcome, **extra)
+            except Exception:
+                pass
+
+    def _write_bundle(self, reason: str, steps, health, tail,
+                      notes) -> str:
+        # multi-process runs suffix the bundle name with '.pN' (the
+        # journal/trace per-host contract): identically-launched hosts can
+        # share run_id (pid + launch second), and a pod-wide preemption
+        # dumping onto one shared flight dir must not race two hosts'
+        # renames onto the same final path — the loser's bundle is exactly
+        # the postmortem this module exists to keep
+        base = f"{self.run_id}-{reason}{process_suffix()}"
+        final = os.path.join(self.flight_dir, base)
+        n = 2
+        while os.path.exists(final):  # a prior run's bundle: never clobber
+            final = os.path.join(self.flight_dir, f"{base}-{n}")
+            n += 1
+        tmp = f"{final}.tmp-{os.getpid()}"
+        os.makedirs(tmp, exist_ok=True)
+
+        spans = self._span_tail()
+        stacks = _all_stacks()
+        metrics = self._metrics_text()
+        payloads = {
+            "journal_tail.jsonl": _jsonl(tail),
+            "steps.jsonl": _jsonl(steps),
+            "health.jsonl": _jsonl(health),
+            "notes.jsonl": _jsonl(notes),
+            "spans.json": json.dumps({"traceEvents": spans,
+                                      "metadata": {"run_id": self.run_id}}),
+            "stacks.json": json.dumps(stacks, indent=1),
+            "metrics.prom": metrics,
+        }
+        files: Dict[str, dict] = {}
+        for name in _PAYLOAD_FILES:
+            data = payloads[name].encode()
+            files[name] = {"bytes": len(data), "crc32": zlib.crc32(data)}
+            _write_fsync(os.path.join(tmp, name), data)
+        manifest = {
+            "run_id": self.run_id,
+            "reason": reason,
+            "ts": round(time.time(), 3),
+            "pid": os.getpid(),
+            "process_index": _proc_index(),
+            "files": files,
+        }
+        _write_fsync(os.path.join(tmp, "MANIFEST.json"),
+                     json.dumps(manifest, indent=1).encode())
+        os.rename(tmp, final)
+        _fsync_dir(self.flight_dir)
+        return final
+
+    def _span_tail(self) -> List[dict]:
+        try:
+            from deep_vision_tpu.obs.trace import get_tracer
+
+            t = get_tracer()
+            return t.tail(self.span_tail) if t is not None else []
+        except Exception:
+            return []
+
+    def _metrics_text(self) -> str:
+        try:
+            reg = self.registry
+            if reg is None:
+                from deep_vision_tpu.obs.registry import get_registry
+
+                reg = get_registry()
+            return reg.to_prometheus()
+        except Exception:
+            return ""
+
+    # -- lifecycle ---------------------------------------------------------
+
+    @property
+    def dumped(self) -> Dict[str, str]:
+        """reason -> bundle path for every dump this run produced."""
+        with self._lock:
+            return dict(self._dumped)
+
+    def disarm(self) -> None:
+        """A clean exit is not an emergency: no crash bundle at atexit."""
+        self._armed = False
+
+    def close(self) -> None:
+        """Clean-exit epilogue: disarm and detach (idempotent)."""
+        if self._closed:
+            return
+        self._closed = True
+        self.disarm()
+        atexit.unregister(self._atexit)
+        if get_flight() is self:
+            set_flight(None)
+
+    def _atexit(self) -> None:
+        if self._armed:
+            self.dump("crash")
+
+
+# -- bundle validation --------------------------------------------------------
+
+def validate_bundle(path: str) -> List[str]:
+    """Structural + crc validation of one bundle dir; empty list = valid.
+
+    The CI teeth behind the dump format (chaos-smoke, tests): the
+    manifest must parse and carry the envelope, and every listed file
+    must exist with the recorded size and crc32 — a torn or rotted
+    bundle fails loudly instead of lying quietly at 3am.
+    """
+    errors: List[str] = []
+    man_path = os.path.join(path, "MANIFEST.json")
+    try:
+        with open(man_path) as f:
+            manifest = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        return [f"{man_path}: unreadable manifest: {e}"]
+    for k in ("run_id", "reason", "ts", "files"):
+        if k not in manifest:
+            errors.append(f"{man_path}: missing field {k!r}")
+    for name, meta in (manifest.get("files") or {}).items():
+        fpath = os.path.join(path, name)
+        try:
+            with open(fpath, "rb") as f:
+                data = f.read()
+        except OSError as e:
+            errors.append(f"{fpath}: listed in manifest but unreadable: {e}")
+            continue
+        if len(data) != meta.get("bytes"):
+            errors.append(f"{fpath}: size {len(data)} != manifest "
+                          f"{meta.get('bytes')}")
+        if zlib.crc32(data) != meta.get("crc32"):
+            errors.append(f"{fpath}: crc32 mismatch (bundle rotted or torn)")
+    return errors
+
+
+def find_bundles(flight_dir: str) -> List[str]:
+    """Complete bundle dirs under `flight_dir` (in-flight `.tmp-` dirs and
+    stray files are excluded), sorted by name."""
+    try:
+        entries = sorted(os.listdir(flight_dir))
+    except OSError:
+        return []
+    out = []
+    for e in entries:
+        full = os.path.join(flight_dir, e)
+        if os.path.isdir(full) and ".tmp-" not in e:
+            out.append(full)
+    return out
+
+
+# -- process-wide active recorder ---------------------------------------------
+
+_active: Optional[FlightRecorder] = None
+
+
+def set_flight(recorder: Optional[FlightRecorder]) -> None:
+    """Install (or clear, with None) the process-wide recorder that the
+    module-level `note`/`emergency_dump` report to."""
+    global _active
+    _active = recorder
+
+
+def get_flight() -> Optional[FlightRecorder]:
+    return _active
+
+
+def note(category: str, **fields) -> None:
+    """Breadcrumb on the active recorder; one global load + None check
+    when no recorder is installed (same contract as trace.span)."""
+    fr = _active
+    if fr is not None:
+        fr.note(category, **fields)
+
+
+def emergency_dump(reason: str) -> Optional[str]:
+    """Dump the active recorder's bundle NOW (fault injection's pre-SIGKILL
+    hook, the preemption guard's SIGTERM hook); no-op without a recorder."""
+    fr = _active
+    if fr is not None:
+        return fr.dump(reason)
+    return None
+
+
+# -- small helpers ------------------------------------------------------------
+
+def _jsonl(rows: List[dict]) -> str:
+    return "".join(json.dumps(r) + "\n" for r in rows)
+
+
+def _proc_index() -> int:
+    try:
+        import jax
+
+        return int(jax.process_index())
+    except Exception:
+        return 0
+
+
+def _all_stacks() -> dict:
+    try:
+        from deep_vision_tpu.obs.health import dump_all_stacks
+
+        return dump_all_stacks()
+    except Exception:
+        return {}
+
+
+def _write_fsync(path: str, data: bytes) -> None:
+    with open(path, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+
+
+def _fsync_dir(path: str) -> None:
+    """Durability for the rename itself (the SIGKILL may be microseconds
+    away on the injected-crash path)."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+    except OSError:
+        pass

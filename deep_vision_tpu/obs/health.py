@@ -138,8 +138,22 @@ class HealthMonitor:
 
     def _emit(self, kind: str, **fields) -> None:
         if self.journal is not None:
+            # the flight recorder rides the journal's tap: one emit path
             self.journal.write("health", kind=kind, policy=self.policy,
                                monitor=self.name, **fields)
+            return
+        # journal-less runs still feed the black box: a hang or abort must
+        # trigger the postmortem dump even when nobody asked for a journal
+        try:
+            from deep_vision_tpu.obs import flight
+
+            fr = flight.get_flight()
+            if fr is not None:
+                fr.observe({"event": "health", "ts": round(time.time(), 3),
+                            "kind": kind, "policy": self.policy,
+                            "monitor": self.name, **fields})
+        except Exception:
+            pass
 
     # -- non-finite + divergence checks ------------------------------------
 
@@ -198,9 +212,15 @@ class HealthMonitor:
                 self._c_spikes.inc()
                 self._spike_streak += 1
                 escalate = self._spike_streak >= self.patience
+                # an escalation under the abort policy carries the action
+                # field: the flight recorder's tap keys its health_abort
+                # dump on it (the raise below never returns control here)
+                extra = ({"action": "abort"}
+                         if escalate and self.policy == "abort" else {})
                 self._emit("divergence" if escalate else "loss_spike",
                            step=int(step), loss=loss, window_mean=mean,
-                           window_std=std, z=z, streak=self._spike_streak)
+                           window_std=std, z=z, streak=self._spike_streak,
+                           **extra)
                 if escalate:
                     msg = (f"divergence: {self._spike_streak} consecutive "
                            f"loss spikes (z={z:.1f}, loss={loss:.4g} vs "

@@ -20,11 +20,20 @@ Event types (full schema in obs/README.md):
   crash         atexit marker: the process died without close()
   exit          clean close, with status
 
-The writer is process-0-only (`jax.process_index()`), appends with a
-flush per line (a crash loses at most the in-flight line), and registers
-an atexit hook that stamps a `crash` event — so a reader can always tell
-a finished run (`exit`) from a dead one (`crash`, or no terminal event at
-all for SIGKILL). Readers: `read_journal`, tools/obs_report.py.
+The writer appends with a flush per line (a crash loses at most the
+in-flight line) and registers an atexit hook that stamps a `crash`
+event — so a reader can always tell a finished run (`exit`) from a dead
+one (`crash`, or no terminal event at all for SIGKILL). Single-process
+runs write the plain path; multi-process runs write one file PER HOST at
+`<path>.p<process_index>` (obs.registry.process_suffix) so host 7's last
+seconds survive host 7 — `tools/obs_merge.py` stitches them back into
+one timeline. Readers: `read_journal`, tools/obs_report.py.
+
+Taps (`add_tap`) observe every event row after it is written — the
+flight recorder (obs/flight.py) rides one to keep its postmortem ring
+buffers current without a second instrumentation surface. A tap must be
+cheap and must never raise into the run it observes (exceptions are
+swallowed).
 """
 from __future__ import annotations
 
@@ -37,7 +46,7 @@ import threading
 import time
 from typing import Callable, List, Optional
 
-from deep_vision_tpu.obs.registry import is_primary_host
+from deep_vision_tpu.obs.registry import is_primary_host, process_suffix
 
 
 def _jsonable(v):
@@ -60,27 +69,40 @@ class RunJournal:
     """Append-only JSONL journal for one run (or one bench session)."""
 
     def __init__(self, path: str, run_id: Optional[str] = None,
-                 kind: str = "train"):
-        self.path = path
+                 kind: str = "train", per_process: bool = True):
+        # multi-process runs: every host owns a suffixed file (`.pN`) so a
+        # follower's telemetry outlives the follower; per_process=False
+        # keeps the legacy process-0-only single shared path
+        sfx = process_suffix() if per_process else ""
+        self.path = path + sfx
         self.kind = kind
         self.run_id = run_id or f"{kind}-{os.getpid()}-{int(time.time())}"
         self._closed = False
         self._closers: List[Callable[[], None]] = []
-        self._primary = is_primary_host()
+        self._taps: List[Callable[[dict], None]] = []
+        self._primary = is_primary_host() or bool(sfx)
         # writes come from the train loop AND side threads (the health
         # watchdog, data prefetch errors): one lock keeps lines whole
         self._lock = threading.Lock()
         self._f = None
         self.dropped_lines = 0  # lines lost to journal I/O errors
         if self._primary:
-            d = os.path.dirname(path)
+            d = os.path.dirname(self.path)
             if d:
                 os.makedirs(d, exist_ok=True)
-            self._f = open(path, "a")
+            self._f = open(self.path, "a")
         # the crash marker: fires only if close() never ran
         atexit.register(self._atexit)
 
     # -- lifecycle ---------------------------------------------------------
+
+    def add_tap(self, fn: Callable[[dict], None]) -> None:
+        """Register an observer called with every event row after it is
+        written (flight recorder, tests). Taps run outside the file lock
+        and may themselves call write() (e.g. a flight dump journaling its
+        own `flight_dump` event); a raising tap is swallowed — telemetry
+        observers must never kill the run they observe."""
+        self._taps.append(fn)
 
     def add_closer(self, fn: Callable[[], None]) -> None:
         """Register cleanup run by close() (and by the atexit crash path):
@@ -141,10 +163,9 @@ class RunJournal:
 
             faults.fire("journal.flush")
             with self._lock:
-                if self._f is None:
-                    return
-                self._f.write(json.dumps(row) + "\n")
-                self._f.flush()
+                if self._f is not None:
+                    self._f.write(json.dumps(row) + "\n")
+                    self._f.flush()
         except OSError as e:
             # telemetry must degrade, never kill the training it observes:
             # a failed journal write drops the line, counts it, and the
@@ -160,6 +181,14 @@ class RunJournal:
                 get_registry().counter(
                     "journal_dropped_lines_total",
                     "journal lines lost to I/O errors").inc()
+            except Exception:
+                pass
+        # taps observe the row even when the file write failed or this host
+        # is a non-writer: the flight recorder's postmortem buffers must
+        # stay current precisely when the journal volume is the thing dying
+        for tap in self._taps:
+            try:
+                tap(row)
             except Exception:
                 pass
 

@@ -1,0 +1,181 @@
+"""Merge per-host journals into one timeline; detect cross-host stragglers.
+
+A multi-host run writes one journal per process (`<path>.pN`, see
+obs/journal.py) because host 7's last seconds must survive host 7. This
+module is the read side: stitch the per-host files back into ONE
+chronological timeline (every event annotated with its `host`), and
+while doing so run the cheapest cross-host diagnosis there is — for
+every optimizer step reported by two or more hosts, compare their step
+times. SPMD lockstep means a step is as slow as its slowest host; a
+persistent max−median gap IS the straggler signal (a fragmenting host
+NIC, a throttled VM, a dying local SSD feeding one input pipeline), and
+it is invisible in any single host's journal because the collective
+stalls everyone equally.
+
+Detected stragglers become typed `straggler` events in the merged
+timeline (step, gap_ms, median_ms, max_ms, the offending host) and bump
+`obs_straggler_total`. The merged file is itself a schema-valid
+journal, rendered by `tools/obs_report.py --merged`. Under
+`tools/check_journal.py --strict` it behaves like any journal: a merge
+of clean runs passes, while a merge whose LAST terminal event is a
+host's `crash` marker (or that has none after a SIGKILL) is flagged —
+correctly, since strict mode exists to certify clean completions, and
+a postmortem merge is evidence of the opposite.
+
+CLI: `tools/obs_merge.py`. In-run: `parallel/multihost.aggregate_obs`
+runs this on the primary host after an end-of-run barrier (shared
+filesystem — the standard Cloud TPU pod setup where every host mounts
+the same GCS/NFS run directory).
+"""
+from __future__ import annotations
+
+import json
+import re
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from deep_vision_tpu.obs.journal import read_journal
+
+#: run_id stamped on events the merge itself synthesizes
+MERGE_RUN_ID = "obs-merge"
+
+
+def _median(xs: Sequence[float]) -> float:
+    s = sorted(xs)
+    n = len(s)
+    return s[n // 2] if n % 2 else (s[n // 2 - 1] + s[n // 2]) / 2.0
+
+
+def host_index(path: str, events: List[dict], fallback: int) -> int:
+    """A journal's host id: the manifest's `process_index` when present,
+    else the `.pN` path suffix, else the caller's positional fallback."""
+    for e in events:
+        if e.get("event") == "run_manifest" and "process_index" in e:
+            try:
+                return int(e["process_index"])
+            except (TypeError, ValueError):
+                break
+    m = re.search(r"\.p(\d+)$", path)
+    if m:
+        return int(m.group(1))
+    return fallback
+
+
+def detect_stragglers(
+    host_steps: Dict[int, Dict[int, dict]],
+    gap_ms: float = 25.0,
+    rel: float = 0.5,
+) -> List[dict]:
+    """Straggler events from per-host per-step records.
+
+    `host_steps`: host -> step index -> step event. A step flags when at
+    least two hosts reported it and the max−median step-time gap exceeds
+    BOTH the absolute floor (`gap_ms` — sub-floor jitter is noise at any
+    scale) and `rel` x median (so a 30ms gap on a 10ms step flags while
+    the same 30ms on a 5s step does not).
+    """
+    out: List[dict] = []
+    all_steps = sorted({s for steps in host_steps.values() for s in steps})
+    for step in all_steps:
+        reports = [
+            (h, float(ev["step_time_ms"]), ev)
+            for h, steps in sorted(host_steps.items())
+            if (ev := steps.get(step)) is not None
+            and ev.get("step_time_ms") is not None
+        ]
+        if len(reports) < 2:
+            continue
+        times = [t for _, t, _ in reports]
+        med = _median(times)
+        mx = max(times)
+        gap = mx - med
+        if gap <= gap_ms or gap <= rel * med:
+            continue
+        slow_host, _, slow_ev = max(reports, key=lambda r: r[1])
+        out.append({
+            "event": "straggler",
+            "ts": slow_ev.get("ts"),
+            "run_id": MERGE_RUN_ID,
+            "step": int(step),
+            "gap_ms": round(gap, 3),
+            "median_ms": round(med, 3),
+            "max_ms": round(mx, 3),
+            "host": int(slow_host),
+            "hosts": len(reports),
+        })
+    return out
+
+
+def merge_events(
+    per_host: Dict[int, List[dict]],
+    gap_ms: float = 25.0,
+    rel: float = 0.5,
+) -> Tuple[List[dict], List[dict]]:
+    """(merged timeline, straggler events). Every source event gains a
+    `host` field; stragglers are interleaved at their step's timestamp
+    and counted in `obs_straggler_total`."""
+    merged: List[dict] = []
+    host_steps: Dict[int, Dict[int, dict]] = {}
+    for host, events in per_host.items():
+        steps = host_steps.setdefault(host, {})
+        for e in events:
+            row = dict(e)
+            row.setdefault("host", int(host))
+            merged.append(row)
+            if e.get("event") == "step" and e.get("step") is not None:
+                steps[int(e["step"])] = e
+    stragglers = detect_stragglers(host_steps, gap_ms=gap_ms, rel=rel)
+    if stragglers:
+        try:
+            from deep_vision_tpu.obs.registry import get_registry
+
+            get_registry().counter(
+                "obs_straggler_total",
+                "cross-host step-skew detections (obs_merge)",
+            ).inc(len(stragglers))
+        except Exception:
+            pass
+    merged.extend(stragglers)
+    # stable sort: events sharing a ts keep source order (ts is the
+    # journal's own clock, already rounded to ms)
+    merged.sort(key=lambda e: (e.get("ts") is None, e.get("ts") or 0.0))
+    return merged, stragglers
+
+
+def merge_journal_files(
+    paths: Sequence[str],
+    out_path: Optional[str] = None,
+    gap_ms: float = 25.0,
+    rel: float = 0.5,
+) -> dict:
+    """Merge journal files into `out_path` (JSONL); returns a summary.
+
+    The merged file opens with a `note` event recording the sources, so
+    a reader (and `obs_report --merged`) can tell a merged timeline from
+    a single-host journal.
+    """
+    per_host: Dict[int, List[dict]] = {}
+    for i, path in enumerate(paths):
+        events = [e for e in read_journal(path)
+                  if e.get("event") != "_torn_line"]
+        host = host_index(path, events, fallback=i)
+        per_host.setdefault(host, []).extend(events)
+    merged, stragglers = merge_events(per_host, gap_ms=gap_ms, rel=rel)
+    ts0 = min((e["ts"] for e in merged if e.get("ts") is not None),
+              default=0.0)
+    header = {
+        "event": "note", "ts": ts0, "run_id": MERGE_RUN_ID,
+        "note": "obs_merge", "hosts": sorted(per_host),
+        "sources": list(paths), "stragglers": len(stragglers),
+    }
+    summary = {
+        "hosts": sorted(per_host),
+        "events": len(merged),
+        "stragglers": stragglers,
+        "out": out_path,
+    }
+    if out_path is not None:
+        with open(out_path, "w") as f:
+            f.write(json.dumps(header) + "\n")
+            for e in merged:
+                f.write(json.dumps(e) + "\n")
+    return summary

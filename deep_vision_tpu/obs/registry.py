@@ -29,6 +29,26 @@ def is_primary_host() -> bool:
         return True
 
 
+def process_suffix() -> str:
+    """'.pN' when this process is part of a multi-process run, else ''.
+
+    The multi-host observability contract (journal/trace/flight): with
+    more than one `jax.process_count()` every host writes its OWN file at
+    `<path>.p<index>` — a follower's telemetry must survive the follower,
+    and a shared file would interleave hosts mid-line. Single-process runs
+    keep the plain path, so nothing changes for the common case. Lazy jax
+    import, like is_primary_host: data workers must not drag in a backend.
+    """
+    try:
+        import jax
+
+        if jax.process_count() > 1:
+            return f".p{jax.process_index()}"
+    except Exception:
+        pass
+    return ""
+
+
 def _fmt_labels(labels: Optional[dict]) -> str:
     if not labels:
         return ""

@@ -61,18 +61,33 @@ def recompile_count() -> int:
     return _compile_events
 
 
-def hbm_bytes_in_use(device=None) -> Optional[int]:
-    """Live device memory, or None where the backend has no stats (CPU)."""
+def hbm_stats(device=None) -> "tuple[Optional[int], Optional[int]]":
+    """(bytes_in_use, peak_bytes_in_use) for one device; None where the
+    backend has no stats (CPU).
+
+    The peak matters more than the instant: OOMs and fragmentation are
+    high-water phenomena, an autoprof HBM trigger keyed on the
+    instantaneous value would miss a transient allocation spike that
+    freed before the sampled fence, and a postmortem wants the worst the
+    run ever did — not where it happened to be when it died.
+    """
     try:
         import jax
 
         dev = device or jax.local_devices()[0]
         stats = dev.memory_stats()
         if not stats:
-            return None
-        return int(stats.get("bytes_in_use", stats.get("bytes_in_use_", 0)))
+            return None, None
+        in_use = int(stats.get("bytes_in_use", stats.get("bytes_in_use_", 0)))
+        peak = stats.get("peak_bytes_in_use")
+        return in_use, (int(peak) if peak is not None else None)
     except Exception:
-        return None
+        return None, None
+
+
+def hbm_bytes_in_use(device=None) -> Optional[int]:
+    """Live device memory, or None where the backend has no stats (CPU)."""
+    return hbm_stats(device)[0]
 
 
 class StepClock:
@@ -117,6 +132,9 @@ class StepClock:
                                      "backend compiles observed this process")
         self._g_hbm = r.gauge("hbm_bytes_in_use",
                               "device bytes in use (0 where unavailable)")
+        self._g_hbm_peak = r.gauge(
+            "hbm_peak_bytes_in_use",
+            "device high-water bytes (0 where unavailable)")
         self._h_step = r.histogram(f"{name}_step_ms",
                                    "per-step wall ms distribution")
         self._h_wait = r.histogram(f"{name}_data_wait_ms_hist",
@@ -174,10 +192,13 @@ class StepClock:
             self._g_recompiles.set(n)
             rec.recompiles = n
             if self.track_memory:
-                hbm = hbm_bytes_in_use()
+                hbm, peak = hbm_stats()
                 if hbm is not None:
                     self._g_hbm.set(hbm)
                     rec.hbm_bytes = hbm
+                if peak is not None:
+                    self._g_hbm_peak.set(peak)
+                    rec.hbm_peak_bytes = peak
         if self.journal is not None:
             self.journal.step(rec.step if rec.step is not None
                               else self._steps_seen, **rec.fields())
@@ -208,6 +229,7 @@ class _StepRecord:
         self.examples_per_sec: Optional[float] = None
         self.recompiles: Optional[int] = None
         self.hbm_bytes: Optional[int] = None
+        self.hbm_peak_bytes: Optional[int] = None
         self._t0 = 0.0
         self._fenced = None
         self._auto_commit = auto_commit
@@ -266,6 +288,8 @@ class _StepRecord:
             out["recompiles"] = self.recompiles
         if self.hbm_bytes is not None:
             out["hbm_bytes"] = self.hbm_bytes
+        if self.hbm_peak_bytes is not None:
+            out["hbm_peak_bytes"] = self.hbm_peak_bytes
         if self.metrics:
             out["metrics"] = {k: float(v) for k, v in self.metrics.items()}
         return out

@@ -38,7 +38,7 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional
 
-from deep_vision_tpu.obs.registry import is_primary_host
+from deep_vision_tpu.obs.registry import is_primary_host, process_suffix
 
 # Trace-event timestamps are microseconds. Use an epoch-anchored clock so
 # trace ts and journal ts (unix seconds) cross-reference directly:
@@ -111,8 +111,13 @@ class Tracer:
     """
 
     def __init__(self, path: str, run_id: Optional[str] = None,
-                 flush_every: int = 256, max_events: int = 200_000):
-        self.path = path
+                 flush_every: int = 256, max_events: int = 200_000,
+                 per_process: bool = True):
+        # multi-process runs: one trace file per host at `<path>.pN` (same
+        # contract as the journal) — followers become writers of their own
+        # file instead of silent collectors
+        sfx = process_suffix() if per_process else ""
+        self.path = path + sfx
         self.run_id = run_id
         self.flush_every = max(1, int(flush_every))
         # ring-buffer cap: a post-mortem wants the most RECENT window, and
@@ -126,7 +131,7 @@ class Tracer:
         # one tmp name would publish a torn file
         self._flush_lock = threading.Lock()
         self._closed = False
-        self._primary = is_primary_host()
+        self._primary = is_primary_host() or bool(sfx)
         self._pid = os.getpid()
         self._thread_named: Dict[int, str] = {}  # ident -> last-seen name
         self._unflushed = 0
@@ -234,6 +239,12 @@ class Tracer:
     def num_events(self) -> int:
         with self._lock:
             return len(self._events)
+
+    def tail(self, n: int = 256) -> List[dict]:
+        """The most recent `n` buffered events (complete + metadata) — the
+        span tail a flight-recorder bundle snapshots at dump time."""
+        with self._lock:
+            return [dict(e) for e in self._events[-max(0, int(n)):]]
 
 
 def _arg(v):

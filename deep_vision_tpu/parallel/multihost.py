@@ -144,6 +144,34 @@ class PreemptionGuard:
 
     def _on_sigterm(self, signum, frame):
         self.requested = True
+        # the flight recorder's preemption bundle: SIGTERM gives ~30s of
+        # grace, so dumping NOW (not at the eventual consensus boundary)
+        # guarantees the postmortem exists even if the graceful path never
+        # completes before the VM is reclaimed. The dump runs on a daemon
+        # THREAD, never in signal context: the handler interrupts the main
+        # thread wherever it is — possibly inside the journal's or
+        # recorder's non-reentrant locks — and a dump here would re-acquire
+        # them and self-deadlock the very protocol it serves (the import
+        # below would similarly contend on the import lock). The thread
+        # simply waits until the handler returns and the lock holder
+        # resumes.
+        try:
+            import threading
+
+            threading.Thread(target=self._preempt_dump,
+                             name="flight-preempt-dump",
+                             daemon=True).start()
+        except Exception:
+            pass  # a failed dump must not break the preemption protocol
+
+    @staticmethod
+    def _preempt_dump() -> None:
+        try:
+            from deep_vision_tpu.obs import flight
+
+            flight.emergency_dump("preempt")
+        except Exception:
+            pass
 
     def __enter__(self):
         import signal
@@ -177,6 +205,34 @@ class PreemptionGuard:
         if force or due:
             self._agreed = agree_flag(self.requested)
         return self._agreed
+
+
+def aggregate_obs(journal_path: str, out_path: Optional[str] = None,
+                  gap_ms: float = 25.0) -> Optional[str]:
+    """Primary-host end-of-run merge of the per-host journals.
+
+    Assumes the standard Cloud TPU pod layout where every host writes its
+    `<journal_path>.pN` into the same shared run directory (GCS/NFS). All
+    hosts rendezvous at a barrier (so every follower's file is complete),
+    then process 0 merges them into `<journal_path>.merged` with
+    cross-host straggler detection (obs/merge.py). Returns the merged
+    path on the primary, None elsewhere and in single-process runs.
+    """
+    if jax.process_count() == 1:
+        return None
+    sync_hosts("obs_merge")
+    if not is_primary():
+        return None
+    import glob as _g
+
+    paths = sorted(_g.glob(journal_path + ".p*"))
+    if not paths:
+        return None
+    from deep_vision_tpu.obs.merge import merge_journal_files
+
+    out = out_path or journal_path + ".merged"
+    merge_journal_files(paths, out, gap_ms=gap_ms)
+    return out
 
 
 def per_host_batch_size(global_batch_size: int) -> int:

@@ -202,7 +202,18 @@ class FaultInjector:
                     f"injected io_error at {point}"
                     + (f" (stage={stage})" if stage else ""))
             # crash / crash_after_write: die the way real preemption does —
-            # no handlers, no atexit, no flushed buffers
+            # no handlers, no atexit, no flushed buffers. The flight
+            # recorder's bundle is the ONE artifact written first: a real
+            # SIGKILL gives no warning, but its postmortem value is exactly
+            # what the chaos loop exists to prove, so the injected variant
+            # dumps the black box in the instants before the kill (fsynced
+            # + atomically renamed — obs/flight.py survives what follows)
+            try:
+                from deep_vision_tpu.obs import flight
+
+                flight.emergency_dump(f"injected_{r.kind}")
+            except Exception:
+                pass
             sys.stderr.write(
                 f"faults: injected {r.kind} at {point} — SIGKILL\n")
             sys.stderr.flush()

@@ -91,9 +91,18 @@ class DcganTrainer:
                  latent_dim: int = 100, image_shape=(28, 28, 1),
                  mesh=None, rng: Optional[jax.Array] = None,
                  journal=None, registry=None,
-                 telemetry_sample_every: int = 32, health=None):
+                 telemetry_sample_every: int = 32, health=None,
+                 autoprof=None):
         self.mesh = mesh if mesh is not None else create_mesh()
         self.latent_dim = latent_dim
+        # anomaly-triggered profiling (obs/autoprof.py): the GAN loop has
+        # no optimizer-step fetch, so captures key on the clock's counter;
+        # the fence drains async dispatch into the trace before stop_trace
+        # (otherwise the tail of the anomalous steps is cut off mid-flight)
+        self.autoprof = autoprof
+        if autoprof is not None:
+            autoprof.fence = lambda: jax.block_until_ready(
+                (self.g_state, self.d_state))
         # health: the GAN loop keeps metrics on device until epoch end, so
         # the per-step hook is heartbeat-only; the epoch summary check
         # (check_summary) runs from the train_cli loop
@@ -150,6 +159,8 @@ class DcganTrainer:
         return g_state, d_state, {"g_loss": g_loss, "d_loss": d_loss}
 
     def train_step(self, real_images) -> dict:
+        if self.autoprof is not None:
+            self.autoprof.on_step_start()
         with span("gan/step"):
             with self.clock.step(batch_size=np.shape(real_images)[0]) as rec:
                 real = shard_batch(self.mesh, np.asarray(real_images))
@@ -157,6 +168,8 @@ class DcganTrainer:
                     self.g_state, self.d_state, real
                 )
                 rec.fence_on(metrics)
+        if self.autoprof is not None:
+            self.autoprof.observe_step(self.clock.steps_seen, rec.fields())
         if self.health is not None:
             self.health.beat()
         return metrics
@@ -213,9 +226,15 @@ class CycleGanTrainer:
                  d_tx_fn: Callable, image_shape=(256, 256, 3), mesh=None,
                  pool_size: int = 50, rng: Optional[jax.Array] = None,
                  journal=None, registry=None,
-                 telemetry_sample_every: int = 32, health=None):
+                 telemetry_sample_every: int = 32, health=None,
+                 autoprof=None):
         self.mesh = mesh if mesh is not None else create_mesh()
         self.health = health
+        self.autoprof = autoprof
+        if autoprof is not None:
+            # drain all four sub-network states into the trace on stop
+            autoprof.fence = lambda: jax.block_until_ready(
+                (self.gab, self.gba, self.da, self.db))
         self.clock = StepClock(registry=registry, journal=journal,
                                name="gan",
                                sample_every=telemetry_sample_every)
@@ -335,6 +354,8 @@ class CycleGanTrainer:
         return da, db, {"d_loss": d_loss}
 
     def train_step(self, real_a, real_b) -> dict:
+        if self.autoprof is not None:
+            self.autoprof.on_step_start()
         with span("gan/step"):
             with self.clock.step(batch_size=np.shape(real_a)[0]) as rec:
                 real_a = shard_batch(self.mesh, np.asarray(real_a))
@@ -359,6 +380,8 @@ class CycleGanTrainer:
                     )
                 metrics = {**g_metrics, **d_metrics}
                 rec.fence_on(metrics)
+        if self.autoprof is not None:
+            self.autoprof.observe_step(self.clock.steps_seen, rec.fields())
         if self.health is not None:
             self.health.beat()
         return metrics

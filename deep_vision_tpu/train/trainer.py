@@ -81,6 +81,7 @@ class Trainer:
         telemetry_sample_every: int = 16,
         lr_schedule=None,  # the optax schedule behind tx, for current_lr
         health=None,  # obs.HealthMonitor or None
+        autoprof=None,  # obs.AutoProfiler; built from profile_dir if None
     ):
         self.mesh = mesh if mesh is not None else create_mesh()
         self.model = model  # single source of truth for summaries/export
@@ -111,12 +112,24 @@ class Trainer:
         # summary as an 'epoch' event
         self.eval_logger = eval_logger or MetricLogger(
             name="val", print_every=0, registry=self.clock.registry)
-        # profiler hook: the instrumentation the reference never had
-        # (SURVEY.md §2.7 'tracing/profilers: NONE'); trace is captured for
-        # steps [start, stop) and viewed with tensorboard-plugin-profile/xprof
+        # profiler: the instrumentation the reference never had (SURVEY.md
+        # §2.7 'tracing/profilers: NONE'). One AutoProfiler owns BOTH the
+        # static [start, stop) window (profile_dir/profile_steps, viewed
+        # with tensorboard-plugin-profile/xprof) and the anomaly-triggered
+        # capture policy (obs/autoprof.py); it guards re-entry so a second
+        # trigger while a trace is in flight can never double-start.
         self.profile_dir = profile_dir
         self.profile_steps = profile_steps
-        self._profiling = False
+        if autoprof is None and profile_dir is not None:
+            from deep_vision_tpu.obs.autoprof import AutoProfiler
+
+            autoprof = AutoProfiler(profile_dir, window=profile_steps,
+                                    journal=journal, registry=registry)
+        self.prof = autoprof
+        if self.prof is not None:
+            # drain the device pipeline into the trace before stop_trace
+            self.prof.fence = lambda: jax.block_until_ready(
+                self.state.params)
         self._pguard = None  # PreemptionGuard, live only inside fit
         self._closed = False
 
@@ -260,31 +273,27 @@ class Trainer:
             batch["_mask"] = mask
         return batch
 
+    @property
+    def _profiling(self) -> bool:
+        """True while a profiler capture is in flight (static or auto)."""
+        return self.prof is not None and self.prof.capturing
+
     def _profiler_hook(self):
-        if self.profile_dir is None:
+        if self.prof is None:
             return
-        # int() syncs on the in-flight state; only pay it when profiling
-        step = int(self.state.step)
-        start, stop = self.profile_steps
-        if not self._profiling and step == start:
-            jax.profiler.start_trace(self.profile_dir)
-            self._profiling = True
-            if self.journal is not None:
-                self.journal.write("profile", action="start_trace",
-                                   step=step, dir=self.profile_dir)
-        elif self._profiling and step >= stop:
-            self._stop_trace(step)
+        # int() blocks on the in-flight state — pay it ONLY while a
+        # pending static window needs the true optimizer step to anchor
+        # (e.g. after a resume). An --autoprof-only run would otherwise
+        # drain the device pipeline every step; its internal counter is
+        # recalibrated by observe_step's committed opt_step instead.
+        self.prof.on_step_start(int(self.state.step)
+                                if self.prof.needs_step_index else None)
 
     def _stop_trace(self, step: Optional[int] = None) -> None:
-        """Close an in-flight profiler trace (idempotent)."""
-        if not self._profiling:
-            return
-        jax.block_until_ready(self.state.params)
-        jax.profiler.stop_trace()
-        self._profiling = False
-        if self.journal is not None:
-            self.journal.write("profile", action="stop_trace", step=step,
-                               dir=self.profile_dir)
+        """Close an in-flight profiler capture (idempotent); journaled as
+        a `profile_capture` event with outcome=closed_early."""
+        if self.prof is not None:
+            self.prof.interrupt()
 
     def train_step(self, batch) -> dict:
         self._profiler_hook()
@@ -336,7 +345,10 @@ class Trainer:
         self._closed = True
         if self.health is not None:
             self.health.stop()  # disarm the watchdog before teardown
-        self._stop_trace(step=None)
+        if self.prof is not None:
+            # terminal: stops an in-flight (auto-)capture without leaking
+            # the process-wide profiler latch
+            self.prof.close()
         for lg in (self.logger, self.eval_logger):
             tb = getattr(lg, "tb", None)
             if tb is not None:
@@ -507,6 +519,11 @@ class Trainer:
                 rec.commit(step=opt_step,
                            metrics={"loss": metrics["loss"], "lr": lr}
                            if "loss" in metrics else {"lr": lr})
+            # anomaly triggers see the committed record (step-time/data-wait
+            # z-scores, recompile bursts, HBM high-water jumps) and arm a
+            # capture that the NEXT step's _profiler_hook starts
+            if self.prof is not None:
+                self.prof.observe_step(opt_step, rec.fields())
             # one host fetch for loggers + health (log_step floats every
             # metric anyway, so this adds no extra device sync)
             metrics_f = {k: float(v) for k, v in metrics.items()}

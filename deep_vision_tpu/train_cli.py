@@ -323,7 +323,8 @@ def build_trainer(cfg: ExperimentConfig, train_fn, ckpt_dir: Optional[str],
                   ema_decay: Optional[float] = None,
                   journal=None,
                   telemetry_sample_every: int = 16,
-                  health=None):
+                  health=None,
+                  autoprof=None):
     import functools
 
     import jax.numpy as jnp
@@ -398,12 +399,13 @@ def build_trainer(cfg: ExperimentConfig, train_fn, ckpt_dir: Optional[str],
         checkify_errors=checkify_errors, ema_decay=ema_decay,
         journal=journal, lr_schedule=lr,
         telemetry_sample_every=telemetry_sample_every,
-        health=health,
+        health=health, autoprof=autoprof,
     )
 
 
 def build_gan_trainer(cfg: ExperimentConfig, journal=None,
-                      telemetry_sample_every: int = 32, health=None):
+                      telemetry_sample_every: int = 32, health=None,
+                      autoprof=None):
     from deep_vision_tpu.models import get_model
     from deep_vision_tpu.train import build_optimizer
     from deep_vision_tpu.train.gan import CycleGanTrainer, DcganTrainer
@@ -421,6 +423,7 @@ def build_gan_trainer(cfg: ExperimentConfig, journal=None,
             journal=journal,
             telemetry_sample_every=telemetry_sample_every,
             health=health,
+            autoprof=autoprof,
         )
     tx_fn = lambda: build_optimizer(name, lr, **dict(opt_kw))
     return CycleGanTrainer(
@@ -430,6 +433,7 @@ def build_gan_trainer(cfg: ExperimentConfig, journal=None,
         journal=journal,
         telemetry_sample_every=telemetry_sample_every,
         health=health,
+        autoprof=autoprof,
     )
 
 
@@ -576,11 +580,68 @@ def _make_health(args, journal):
     return health
 
 
+def _make_flight(args, journal):
+    """--flight-dir: install the flight recorder (obs/flight.py). It taps
+    the journal for its postmortem ring buffers and registers as the
+    process-wide recorder so the preemption guard, fault injector, and
+    data pipeline can reach it without a handle."""
+    if not args.flight_dir:
+        return None
+    from deep_vision_tpu.obs import FlightRecorder, set_flight
+
+    flight = FlightRecorder(
+        args.flight_dir,
+        run_id=journal.run_id if journal is not None else None)
+    set_flight(flight)
+    if journal is not None:
+        flight.attach(journal)
+    return flight
+
+
+def _parse_profile_window(parser, spec: str):
+    try:
+        start_s, stop_s = spec.split(":")
+        start, stop = int(start_s), int(stop_s)
+    except ValueError:
+        parser.error(f"--profile-window {spec!r} is not 'START:STOP'")
+    if not 0 <= start < stop:
+        parser.error(f"--profile-window needs 0 <= START < STOP, got {spec}")
+    return start, stop
+
+
+def _make_autoprof(args, journal, default_dir: str, window=None):
+    """--profile-dir (static window) / --autoprof (anomaly triggers):
+    one AutoProfiler owns both capture modes (obs/autoprof.py)."""
+    if not args.profile_dir and not args.autoprof:
+        return None
+    from deep_vision_tpu.obs import AutoProfiler
+
+    # --autoprof without --profile-dir still needs somewhere to put the
+    # captures; the checkpoint dir is the run's natural artifact home
+    pdir = args.profile_dir or os.path.join(default_dir, "autoprof")
+    return AutoProfiler(
+        pdir, journal=journal,
+        # the static window applies only when the user asked for a static
+        # capture dir; pure --autoprof runs capture on anomalies alone
+        window=window if args.profile_dir else None,
+        auto=args.autoprof,
+        window_steps=args.autoprof_window,
+        cooldown_steps=args.autoprof_cooldown,
+        max_captures=args.autoprof_budget,
+        z_threshold=args.autoprof_z,
+    )
+
+
 def _finish_obs(args, journal, status: str = "clean_exit",
-                tracer=None, health=None) -> None:
+                tracer=None, health=None, autoprof=None,
+                flight=None) -> None:
     """Clean-run epilogue: Prometheus export + trace flush + journal exit
-    marker. (Abnormal exits are covered by the journal's atexit crash
-    marker, the tracer's atexit flush, and the health closer.)"""
+    marker + multi-host journal aggregation + flight disarm. (Abnormal
+    exits are covered by the journal's atexit crash marker, the tracer's
+    atexit flush, the health closer, and the flight recorder's atexit
+    crash dump.)"""
+    if autoprof is not None:
+        autoprof.close()  # stop an in-flight capture instead of leaking it
     if health is not None:
         health.stop()
     if tracer is not None:
@@ -590,13 +651,29 @@ def _finish_obs(args, journal, status: str = "clean_exit",
         set_tracer(None)
         print(f"trace written to {tracer.path} "
               "(load in Perfetto / chrome://tracing)")
+    if journal is not None:
+        journal.close(status)
+        # multi-host: every host closed its .pN file at the barrier inside
+        # aggregate_obs; the primary stitches them into one timeline with
+        # cross-host straggler detection (no-op single-process)
+        try:
+            from deep_vision_tpu.parallel.multihost import aggregate_obs
+
+            merged = aggregate_obs(args.journal)
+            if merged:
+                print(f"merged multi-host journal -> {merged} "
+                      "(render with tools/obs_report.py --merged)")
+        except Exception as e:
+            print(f"warning: multi-host journal merge failed: {e}")
+    # metrics export AFTER the merge: counters the aggregation itself
+    # bumps (obs_straggler_total) must land in the exported snapshot
     if args.metrics_export:
         from deep_vision_tpu.obs.registry import get_registry
 
         if get_registry().write_prometheus(args.metrics_export):
             print(f"metrics exported to {args.metrics_export}")
-    if journal is not None:
-        journal.close(status)
+    if flight is not None:
+        flight.close()  # clean exit: disarm, no crash bundle
 
 
 # -- main --------------------------------------------------------------------
@@ -623,7 +700,41 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--fake-batches", type=int, default=4)
     parser.add_argument("--tensorboard-dir", default=None)
     parser.add_argument("--profile-dir", default=None,
-                        help="capture a jax.profiler trace of steps 10-20")
+                        help="capture a jax.profiler trace of the "
+                             "--profile-window steps into this dir")
+    parser.add_argument("--profile-window", default="10:20",
+                        metavar="START:STOP",
+                        help="static capture window [START, STOP) for "
+                             "--profile-dir (default 10:20)")
+    parser.add_argument("--autoprof", action="store_true",
+                        help="anomaly-triggered profiling: step-time/"
+                             "data-wait z-score regressions, recompile "
+                             "bursts, and HBM high-water jumps each arm a "
+                             "one-shot N-step jax.profiler capture with "
+                             "cooldown and budget, journaled as typed "
+                             "profile_capture events (obs/autoprof.py)")
+    parser.add_argument("--autoprof-window", type=int, default=8,
+                        metavar="STEPS",
+                        help="steps per triggered capture (default 8)")
+    parser.add_argument("--autoprof-cooldown", type=int, default=200,
+                        metavar="STEPS",
+                        help="steps after a capture before another trigger "
+                             "may arm (default 200)")
+    parser.add_argument("--autoprof-budget", type=int, default=2,
+                        metavar="N",
+                        help="max triggered captures per run (default 2; "
+                             "the static --profile-window is exempt)")
+    parser.add_argument("--autoprof-z", type=float, default=5.0,
+                        metavar="Z",
+                        help="rolling z-score threshold for the step-time/"
+                             "data-wait regression triggers (default 5.0)")
+    parser.add_argument("--flight-dir", default=None, metavar="DIR",
+                        help="always-on flight recorder: ring-buffer the "
+                             "recent steps/health/journal/span tail and "
+                             "dump an atomic crc-checked postmortem bundle "
+                             "under DIR on crash, hang, health abort, or "
+                             "preemption (obs/flight.py; validate with "
+                             "obs.flight.validate_bundle)")
     parser.add_argument("--journal", default=None, metavar="PATH",
                         help="append typed run events (manifest, per-step "
                              "timing, eval/checkpoint, exit marker) to this "
@@ -759,10 +870,15 @@ def main(argv: Optional[List[str]] = None) -> int:
         journal = _make_journal(args, cfg, budget=budget)
         tracer = _make_tracer(args, journal)
         health = _make_health(args, journal)
+        flight = _make_flight(args, journal)
+        autoprof = _make_autoprof(
+            args, journal, args.ckpt_dir or os.path.join("checkpoints",
+                                                         cfg.name),
+            window=_parse_profile_window(parser, args.profile_window))
         trainer = build_gan_trainer(
             cfg, journal=journal,
             telemetry_sample_every=args.telemetry_sample_every,
-            health=health)
+            health=health, autoprof=autoprof)
         if journal is not None:
             journal.write("note", mesh_shape=dict(trainer.mesh.shape))
         states = (
@@ -873,21 +989,25 @@ def main(argv: Optional[List[str]] = None) -> int:
                     trainer.save(gan_ckpt, epoch)
         gan_ckpt.wait()
         _maybe_upload(args, ckpt_dir)
-        _finish_obs(args, journal, tracer=tracer, health=health)
+        _finish_obs(args, journal, tracer=tracer, health=health,
+                    autoprof=autoprof, flight=flight)
         return 0
 
     ckpt_dir = args.ckpt_dir or os.path.join("checkpoints", cfg.name)
     journal = _make_journal(args, cfg, budget=budget)
     tracer = _make_tracer(args, journal)
     health = _make_health(args, journal)
+    flight = _make_flight(args, journal)
+    autoprof = _make_autoprof(
+        args, journal, ckpt_dir,
+        window=_parse_profile_window(parser, args.profile_window))
     trainer = build_trainer(cfg, train_fn, ckpt_dir,
                             tb_dir=args.tensorboard_dir,
-                            profile_dir=args.profile_dir,
                             checkify_errors=args.checkify,
                             ema_decay=args.ema_decay,
                             journal=journal,
                             telemetry_sample_every=args.telemetry_sample_every,
-                            health=health)
+                            health=health, autoprof=autoprof)
     if journal is not None:
         # an unwinding run (exception/SIGTERM) still stops an in-flight
         # profiler trace and flushes writers via the atexit crash path
@@ -917,7 +1037,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.eval_only:
         run_eval_only(cfg, trainer, eval_fn)
         trainer.close()
-        _finish_obs(args, journal, tracer=tracer, health=health)
+        _finish_obs(args, journal, tracer=tracer, health=health,
+                    autoprof=autoprof, flight=flight)
         return 0
     trainer.fit(
         train_fn, eval_fn, epochs=cfg.epochs, start_epoch=start_epoch,
@@ -925,7 +1046,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     )
     trainer.close()
     _maybe_upload(args, ckpt_dir)
-    _finish_obs(args, journal, tracer=tracer, health=health)
+    _finish_obs(args, journal, tracer=tracer, health=health,
+                autoprof=autoprof, flight=flight)
     return 0
 
 

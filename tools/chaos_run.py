@@ -17,17 +17,32 @@ fly), each a real `train_cli.main()` subprocess:
                   epoch 2's sidecar is bit-flipped after checksumming
                   (storage rot) and epoch 3's save is SIGKILLed inside
                   the torn-write window. The run must die by SIGKILL —
-                  that is the injected preemption.
+                  that is the injected preemption — and the flight
+                  recorder must leave an atomic, crc-valid postmortem
+                  bundle written in the instants before the kill.
   3. resume       same checkpoint dir, no faults: `resume()` must
                   QUARANTINE the corrupt/incomplete steps (typed
                   `ckpt_quarantine` events), fall back to the newest
                   valid one, and train to completion.
 
-Plus a no-fault overhead probe: with no spec installed, an injection
-point is one module-global load + None check — the probe times it and
-fails if it ever becomes measurable against a step budget.
+Then the observability contracts on top (obs/flight.py, obs/autoprof.py,
+obs/merge.py):
 
-Exit status 0 = every phase held; 1 = a resilience contract is broken.
+  4. autoprof     an induced step-time regression must yield exactly one
+                  `profile_capture` capture per episode (a REAL
+                  jax.profiler window on CPU), with triggers inside the
+                  cooldown journaled as skipped_cooldown and triggers
+                  past the budget as skipped_budget.
+  5. obs_merge    a simulated 2-process run (two per-host journals, one
+                  host slow on three steps) must merge into one timeline
+                  whose `straggler` events finger the slow host, passing
+                  `check_journal --strict` and `obs_report --merged`.
+
+Plus overhead probes: with no spec installed an injection point is one
+module-global load + None check, and flight recording (one tap call per
+journal event) must stay under 2% of the measured phase-1 step time.
+
+Exit status 0 = every phase held; 1 = a contract is broken.
 """
 from __future__ import annotations
 
@@ -183,6 +198,171 @@ def probe_disabled_overhead() -> float:
     return (time.perf_counter() - t0) / n * 1e9
 
 
+MAX_FLIGHT_OVERHEAD_FRAC = 0.02  # flight tap budget: 2% of step time
+
+
+def _phase1_mean_step_ms(work: str) -> float:
+    """Mean step_time_ms from the phase-1 journal — the denominator the
+    flight-overhead budget is measured against."""
+    steps = [e for e in read_jsonl(os.path.join(work,
+                                                "journal_bad_data.jsonl"))
+             if e.get("event") == "step" and "step_time_ms" in e]
+    if not steps:
+        return 1.0  # degenerate floor: the probe then demands < 20us
+    return sum(float(e["step_time_ms"]) for e in steps) / len(steps)
+
+
+def probe_flight_overhead(work: str) -> "tuple[float, float]":
+    """(ms per observe() tap call with a recorder attached, ns per
+    flight.note() with NO recorder installed — the disabled path)."""
+    from deep_vision_tpu.obs import flight as flight_mod
+    from deep_vision_tpu.obs.flight import FlightRecorder
+
+    fr = FlightRecorder(os.path.join(work, "flight_probe"))
+    row = {"event": "step", "ts": 0.0, "run_id": "probe", "step": 1,
+           "step_time_ms": 10.0, "data_wait_ms": 1.0}
+    n = 100_000
+    observe = fr.observe
+    t0 = time.perf_counter()
+    for _ in range(n):
+        observe(row)
+    tap_ms = (time.perf_counter() - t0) / n * 1e3
+    fr.close()
+
+    assert flight_mod.get_flight() is None
+    note = flight_mod.note
+    t0 = time.perf_counter()
+    for _ in range(n):
+        note("probe")
+    idle_ns = (time.perf_counter() - t0) / n * 1e9
+    return tap_ms, idle_ns
+
+
+def probe_autoprof(work: str, f: "Failures") -> None:
+    """Drive a real AutoProfiler (REAL jax.profiler captures on CPU)
+    through a synthetic step-time series with three induced regressions:
+    capture, skipped_cooldown, capture, skipped_budget — then validate
+    the journaled decisions and the trace artifacts."""
+    from deep_vision_tpu.obs import AutoProfiler, RunJournal
+    from deep_vision_tpu.obs.registry import Registry
+
+    j_path = os.path.join(work, "journal_autoprof.jsonl")
+    prof_dir = os.path.join(work, "autoprof")
+    journal = RunJournal(j_path)
+    journal.manifest()
+    ap = AutoProfiler(prof_dir, journal=journal, registry=Registry(),
+                      auto=True, window_steps=3, cooldown_steps=40,
+                      max_captures=2, z_threshold=4.0, min_history=10)
+    step = 0
+
+    def feed(ms: float) -> None:
+        nonlocal step
+        step += 1
+        ap.on_step_start(step)
+        ap.observe_step(step, {"step_time_ms": ms,
+                               "data_wait_ms": ms * 0.05})
+
+    for i in range(20):
+        feed(10.0 + 0.1 * (i % 5))   # steady baseline
+    feed(400.0)                       # regression 1 -> arms a capture
+    for _ in range(5):
+        feed(10.0)                    # capture window runs + closes
+    feed(400.0)                       # regression 2: inside cooldown
+    for _ in range(60):
+        feed(10.0)                    # cooldown expires
+    feed(400.0)                       # regression 3 -> second capture
+    for _ in range(60):
+        feed(10.0)
+    feed(400.0)                       # regression 4: budget spent
+    for _ in range(3):
+        feed(10.0)
+    ap.close()
+    journal.close()
+
+    caps = [e for e in read_jsonl(j_path)
+            if e.get("event") == "profile_capture"]
+    by_outcome: dict = {}
+    for e in caps:
+        by_outcome.setdefault(e["outcome"], []).append(e)
+    captured = by_outcome.get("captured", [])
+    f.check(len(captured) == 2,
+            f"exactly one capture per regression episode "
+            f"({len(captured)} captured, budget 2)")
+    f.check(len(by_outcome.get("skipped_cooldown", [])) == 1,
+            "in-cooldown regression journaled skipped_cooldown, "
+            "not a second capture")
+    f.check(len(by_outcome.get("skipped_budget", [])) == 1,
+            "post-budget regression journaled skipped_budget")
+    f.check(all(e.get("reason") == "step_time_z" for e in caps),
+            "every capture decision names the step_time_z trigger")
+    # ordering: capture 1 closed BEFORE the cooldown skip, which precedes
+    # capture 2's start — one capture in flight at a time, ever
+    order = [e["outcome"] for e in caps]
+    f.check(order == ["started", "captured", "skipped_cooldown",
+                      "started", "captured", "skipped_budget"],
+            f"capture lifecycle ordered correctly ({order})")
+    trace_files = []
+    for root, _dirs, files in os.walk(prof_dir):
+        trace_files += files
+    f.check(len(trace_files) >= 1,
+            f"jax.profiler wrote real trace artifacts "
+            f"({len(trace_files)} files)")
+    f.check(check_journal_strict(j_path),
+            "check_journal --strict accepts profile_capture events")
+
+
+def probe_obs_merge(work: str, f: "Failures") -> None:
+    """Synthesize a 2-host run (host 1 straggling on three steps), merge
+    via the tools/obs_merge.py CLI, and validate the straggler events,
+    schema, and --merged rendering."""
+    base = os.path.join(work, "journal_2host.jsonl")
+    t0 = time.time()
+    slow_steps = {10, 11, 12}
+    for host in (0, 1):
+        rows = [{"event": "run_manifest", "ts": t0, "kind": "train",
+                 "argv": ["chaos"], "run_id": f"chaos-2host-h{host}",
+                 "process_index": host, "process_count": 2}]
+        for s in range(1, 31):
+            ms = 300.0 if (host == 1 and s in slow_steps) else 50.0
+            rows.append({"event": "step", "ts": t0 + s * 0.05,
+                         "run_id": f"chaos-2host-h{host}", "step": s,
+                         "step_time_ms": ms, "data_wait_ms": 2.0,
+                         "dispatch_ms": 5.0})
+        rows.append({"event": "exit", "ts": t0 + 2.0, "status": "clean_exit",
+                     "run_id": f"chaos-2host-h{host}"})
+        with open(f"{base}.p{host}", "w") as fh:
+            for r in rows:
+                fh.write(json.dumps(r) + "\n")
+
+    merged = base + ".merged"
+    rc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "obs_merge.py"),
+         "--auto", base, "-o", merged],
+        cwd=ROOT, env=dict(os.environ, PYTHONPATH=ROOT),
+    ).returncode
+    f.check(rc == 0, f"obs_merge CLI merged the per-host journals (rc={rc})")
+    events = read_jsonl(merged)
+    stragglers = [e for e in events if e.get("event") == "straggler"]
+    f.check(len(stragglers) == len(slow_steps),
+            f"straggler detected on each induced slow step "
+            f"({len(stragglers)}/{len(slow_steps)})")
+    f.check(all(e.get("host") == 1 for e in stragglers),
+            "stragglers finger the slow host (1)")
+    f.check({e.get("step") for e in stragglers} == slow_steps,
+            "straggler steps match the induced ones")
+    f.check(all(abs(e.get("gap_ms", 0) - 125.0) < 1.0 for e in stragglers),
+            "max-median gap computed correctly (300 - median(175) = 125)")
+    f.check(check_journal_strict(merged),
+            "check_journal --strict accepts the merged timeline")
+    rc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "obs_report.py"),
+         "--merged", merged],
+        cwd=ROOT, env=dict(os.environ, PYTHONPATH=ROOT),
+        stdout=subprocess.PIPE,
+    ).returncode
+    f.check(rc == 0, f"obs_report --merged renders the timeline (rc={rc})")
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     if argv and argv[0] == "--child":
@@ -223,12 +403,14 @@ def main(argv: Optional[List[str]] = None) -> int:
     f.check(check_journal_strict(j1), "check_journal --strict accepts journal")
 
     # -- phase 2: rot one sidecar, SIGKILL inside the next torn window --
-    print("phase 2: sidecar rot + SIGKILL mid-checkpoint-save")
+    print("phase 2: sidecar rot + SIGKILL mid-checkpoint-save "
+          "(flight recorder armed)")
     ckpt2 = os.path.join(work, "ckpt_crash")
     j2 = os.path.join(work, "journal_crash.jsonl")
+    flight2 = os.path.join(work, "flight_crash")
     rc = run_child(
         ["-m", CONFIG, "--data-dir", data_dir, "--epochs", str(EPOCHS),
-         "--ckpt-dir", ckpt2, "--journal", j2,
+         "--ckpt-dir", ckpt2, "--journal", j2, "--flight-dir", flight2,
          "--fault-spec",
          "ckpt.sidecar:corrupt@2;ckpt.sidecar:crash_after_write@3"],
         os.path.join(work, "phase2.log"),
@@ -238,6 +420,30 @@ def main(argv: Optional[List[str]] = None) -> int:
     f.check(any(e.get("event") == "fault" and e.get("kind") == "corrupt"
                 for e in read_jsonl(j2)),
             "journal recorded the injected sidecar corruption")
+    # the black box: the injected kill must leave an atomic, crc-valid
+    # postmortem bundle (obs/flight.py), journaled as a flight_dump event
+    from deep_vision_tpu.obs.flight import find_bundles, validate_bundle
+
+    bundles = find_bundles(flight2)
+    f.check(len(bundles) == 1,
+            f"SIGKILL left exactly one flight bundle ({len(bundles)})")
+    if bundles:
+        errs = validate_bundle(bundles[0])
+        f.check(not errs, "flight bundle structure + crc valid"
+                + ("" if not errs else f" ({errs[0]})"))
+        f.check("injected_crash_after_write" in os.path.basename(bundles[0]),
+                "bundle names the injected-kill reason")
+        steps_dumped = read_jsonl(os.path.join(bundles[0], "steps.jsonl"))
+        f.check(len(steps_dumped) >= 1,
+                f"bundle carries recent step records ({len(steps_dumped)})")
+    f.check(any(e.get("event") == "flight_dump"
+                and e.get("outcome") == "written"
+                for e in read_jsonl(j2)),
+            "journal carries the typed flight_dump event")
+    leftovers = ([d for d in os.listdir(flight2) if ".tmp-" in d]
+                 if os.path.isdir(flight2) else ["flight dir missing"])
+    f.check(not leftovers,
+            "no torn .tmp- bundle dirs left behind (atomic rename)")
 
     # -- phase 3: resume must quarantine and fall back ------------------
     print("phase 3: resume quarantines the torn steps and recovers")
@@ -259,10 +465,29 @@ def main(argv: Optional[List[str]] = None) -> int:
             "resume restored a non-zero fallback step")
     f.check(check_journal_strict(j3), "check_journal --strict accepts journal")
 
+    # -- phase 4: induced regression -> exactly one capture per episode -
+    print("phase 4: step-time regression triggers one profile_capture "
+          "(cooldown + budget enforced)")
+    probe_autoprof(work, f)
+
+    # -- phase 5: simulated 2-process run merges with a straggler -------
+    print("phase 5: 2-host journal merge detects the straggler")
+    probe_obs_merge(work, f)
+
     # -- disabled-injection overhead ------------------------------------
     ns = probe_disabled_overhead()
     f.check(ns < MAX_DISABLED_FIRE_NS,
             f"disabled injection point costs {ns:.0f}ns/call "
+            f"(< {MAX_DISABLED_FIRE_NS:.0f}ns)")
+
+    # -- flight-recording overhead against the measured step time ------
+    step_ms = _phase1_mean_step_ms(work)
+    tap_ms, idle_ns = probe_flight_overhead(work)
+    f.check(tap_ms < MAX_FLIGHT_OVERHEAD_FRAC * step_ms,
+            f"flight tap costs {tap_ms * 1e3:.1f}us/step vs step time "
+            f"{step_ms:.1f}ms (< {MAX_FLIGHT_OVERHEAD_FRAC:.0%})")
+    f.check(idle_ns < MAX_DISABLED_FIRE_NS,
+            f"flight.note with no recorder costs {idle_ns:.0f}ns/call "
             f"(< {MAX_DISABLED_FIRE_NS:.0f}ns)")
 
     if f.errors:

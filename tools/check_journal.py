@@ -53,6 +53,9 @@ EVENT_FIELDS = {
     "fault": ("point", "kind"),
     "data_skip": ("path", "offset", "reason"),
     "ckpt_quarantine": ("step", "reason"),
+    "profile_capture": ("reason", "outcome"),
+    "flight_dump": ("reason", "dir", "outcome"),
+    "straggler": ("step", "gap_ms", "host"),
     "note": (),
     "exit": ("status",),
     "crash": ("reason",),
@@ -60,6 +63,14 @@ EVENT_FIELDS = {
 HEALTH_KINDS = {"non_finite", "loss_spike", "divergence", "hang",
                 "watchdog_started"}
 RETRY_OUTCOMES = {"retrying", "gave_up", "recovered"}
+PROFILE_CAPTURE_REASONS = {"static_window", "step_time_z", "data_wait_z",
+                           "recompile_burst", "hbm_jump", "manual"}
+PROFILE_CAPTURE_OUTCOMES = {"started", "captured", "closed_early",
+                            "skipped_cooldown", "skipped_budget",
+                            "skipped_inflight", "failed"}
+FLIGHT_REASONS = {"crash", "hang", "health_abort", "preempt",
+                  "injected_crash", "injected_crash_after_write", "manual"}
+FLIGHT_OUTCOMES = {"written", "failed"}
 
 
 def check_journal(path: str, require_exit: bool = False,
@@ -117,6 +128,28 @@ def check_journal(path: str, require_exit: bool = False,
         if ev == "retry" and row.get("outcome") not in RETRY_OUTCOMES:
             errors.append(f"{path}:{i}: unknown retry outcome "
                           f"{row.get('outcome')!r}")
+        if ev == "profile_capture":
+            if row.get("reason") not in PROFILE_CAPTURE_REASONS:
+                errors.append(f"{path}:{i}: unknown profile_capture reason "
+                              f"{row.get('reason')!r}")
+            if row.get("outcome") not in PROFILE_CAPTURE_OUTCOMES:
+                errors.append(f"{path}:{i}: unknown profile_capture outcome "
+                              f"{row.get('outcome')!r}")
+        if ev == "flight_dump":
+            if row.get("reason") not in FLIGHT_REASONS:
+                errors.append(f"{path}:{i}: unknown flight_dump reason "
+                              f"{row.get('reason')!r}")
+            if row.get("outcome") not in FLIGHT_OUTCOMES:
+                errors.append(f"{path}:{i}: unknown flight_dump outcome "
+                              f"{row.get('outcome')!r}")
+        if ev == "straggler":
+            if not isinstance(row.get("host"), int):
+                errors.append(f"{path}:{i}: straggler host must be a "
+                              "process index (int), got "
+                              f"{row.get('host')!r}")
+            if not isinstance(row.get("gap_ms"), (int, float)):
+                errors.append(f"{path}:{i}: straggler gap_ms must be "
+                              f"numeric, got {row.get('gap_ms')!r}")
         events.append(row)
     if not events:
         errors.append(f"{path}: no events")

@@ -1,0 +1,89 @@
+"""Merge per-host run journals into one timeline with straggler detection.
+
+    PYTHONPATH=. python tools/obs_merge.py run.jsonl.p0 run.jsonl.p1 ...
+    PYTHONPATH=. python tools/obs_merge.py --auto run.jsonl   # glob .p*
+        [-o merged.jsonl] [--gap-ms 25] [--rel 0.5]
+
+The CLI over obs/merge.py: a multi-host run writes one journal per
+process (`<path>.pN`); this stitches them into ONE chronological JSONL
+(every event annotated with `host`) and synthesizes typed `straggler`
+events wherever a step's max−median cross-host step-time gap exceeds
+the thresholds — the signal a fragmenting host hides inside the lockstep
+collective. Render the output with `tools/obs_report.py --merged`. The
+merge is schema-valid under `tools/check_journal.py`; note that
+`--strict` additionally demands a clean terminal `exit`, so a merged
+postmortem of a crashed run flags there by design.
+
+Exit status 0 = merged; 2 = no usable events; 64 = usage error.
+"""
+from __future__ import annotations
+
+import glob
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from deep_vision_tpu.cli import (  # noqa: E402
+    EXIT_INVALID,
+    EXIT_OK,
+    UsageErrorParser,
+)
+from deep_vision_tpu.obs.merge import merge_journal_files  # noqa: E402
+
+
+def main(argv=None) -> int:
+    p = UsageErrorParser(description=__doc__.splitlines()[0])
+    p.add_argument("journals", nargs="+",
+                   help="per-host journal files (or, with --auto, the "
+                        "base path whose .p* siblings are globbed)")
+    p.add_argument("--auto", action="store_true",
+                   help="treat each positional as a base path and expand "
+                        "<path>.p* (what a multi-host run wrote)")
+    p.add_argument("-o", "--out", default=None, metavar="PATH",
+                   help="merged JSONL path (default: <first base>.merged)")
+    p.add_argument("--gap-ms", type=float, default=25.0,
+                   help="absolute straggler floor: flag a step only when "
+                        "max-median exceeds this many ms (default 25)")
+    p.add_argument("--rel", type=float, default=0.5,
+                   help="relative straggler floor: ... and exceeds this "
+                        "fraction of the median (default 0.5)")
+    args = p.parse_args(argv)
+
+    if args.auto:
+        paths = []
+        for base in args.journals:
+            hits = sorted(glob.glob(base + ".p*"))
+            if not hits and os.path.exists(base):
+                hits = [base]  # single-process run: pass it through
+            paths.extend(hits)
+        out_default = args.journals[0] + ".merged"
+    else:
+        paths = list(args.journals)
+        out_default = paths[0] + ".merged"
+    if not paths:
+        print("no journal files found", file=sys.stderr)
+        return EXIT_INVALID
+
+    out = args.out or out_default
+    summary = merge_journal_files(paths, out, gap_ms=args.gap_ms,
+                                  rel=args.rel)
+    if not summary["events"]:
+        print("no events found in " + ", ".join(paths), file=sys.stderr)
+        return EXIT_INVALID
+    stragglers = summary["stragglers"]
+    print(f"merged {len(paths)} journal(s), hosts {summary['hosts']}, "
+          f"{summary['events']} events -> {out}")
+    if stragglers:
+        worst = max(stragglers, key=lambda s: s["gap_ms"])
+        print(f"stragglers: {len(stragglers)} step(s) flagged; worst gap "
+              f"{worst['gap_ms']:.1f} ms at step {worst['step']} "
+              f"(host {worst['host']})")
+    else:
+        print("stragglers: none detected")
+    print("render: python tools/obs_report.py --merged " + out)
+    return EXIT_OK
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

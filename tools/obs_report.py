@@ -9,10 +9,12 @@ per-step events), recompile and HBM peaks, eval/checkpoint/bench events,
 health findings (obs/health.py: non-finite steps, loss spikes, watchdog
 hang dumps), and the terminal marker (clean exit vs crash vs
 still-running). With `--trace`, a per-span time summary of the matching
-Chrome trace (obs/trace.py) follows: total/mean/max wall ms per span
-name — the "where did the time go" table without opening Perfetto. This
-is the diff surface for BENCH_* rounds: two journals from different PRs
-summarize into directly comparable tables.
+Chrome trace (obs/trace.py) follows: total/mean/p50/p95/max wall ms per
+span name — the "where did the time go" table without opening Perfetto.
+With `--merged`, the input is a `tools/obs_merge.py` multi-host timeline
+and the report shows per-host step statistics plus every detected
+straggler. This is the diff surface for BENCH_* rounds: two journals
+from different PRs summarize into directly comparable tables.
 """
 from __future__ import annotations
 
@@ -69,12 +71,19 @@ def summarize_run(events: List[dict]) -> dict:
     recompiles = [int(e["recompiles"]) for e in steps if "recompiles" in e]
     if recompiles:
         out["recompiles"] = max(recompiles)
-    hbm = [int(e["hbm_bytes"]) for e in steps if "hbm_bytes" in e]
+    # prefer the backend's true high-water (hbm_peak_bytes, stepclock
+    # peak_bytes_in_use) over the max of sampled instantaneous values
+    peak = [int(e["hbm_peak_bytes"]) for e in steps if "hbm_peak_bytes" in e]
+    hbm = peak or [int(e["hbm_bytes"]) for e in steps if "hbm_bytes" in e]
     if hbm:
         out["hbm_peak_gb"] = max(hbm) / 1e9
     out["epochs"] = [e for e in events if e.get("event") == "epoch"]
     out["evals"] = [e for e in events if e.get("event") == "eval"]
     out["health"] = [e for e in events if e.get("event") == "health"]
+    out["captures"] = [e for e in events
+                       if e.get("event") == "profile_capture"]
+    out["flight_dumps"] = [e for e in events
+                           if e.get("event") == "flight_dump"]
     out["checkpoints"] = sum(
         1 for e in events if e.get("event") == "checkpoint" and e.get("saved"))
     out["benches"] = [e for e in events if e.get("event") == "bench"]
@@ -136,6 +145,19 @@ def render(summary: dict) -> str:
         parts = " ".join(f"{k}={v}" for k, v in res.items()
                          if isinstance(v, (int, float)))
         rows.append((f"bench {e.get('name')}", parts))
+    # profiler captures: every decision the autoprof policy made, so the
+    # table answers "why does this run have three trace dirs" directly
+    for e in summary.get("captures", []):
+        detail = f"step {e.get('step', '?')}"
+        if e.get("z") is not None:
+            detail += f" z={e['z']}"
+        if e.get("outcome") in ("captured", "started") and e.get("dir"):
+            detail += f" -> {e['dir']}"
+        rows.append((f"capture {e.get('reason', '?')}",
+                     f"{e.get('outcome', '?')} ({detail})"))
+    for e in summary.get("flight_dumps", []):
+        rows.append((f"flight {e.get('reason', '?')}",
+                     f"{e.get('outcome', '?')} -> {e.get('dir', '?')}"))
     # health findings: one row per event, aggregated counts first so a
     # 10k-spike run stays readable (only the first few render verbatim)
     health = summary.get("health", [])
@@ -172,25 +194,31 @@ def render(summary: dict) -> str:
 
 def summarize_trace(path: str) -> List[dict]:
     """Per-span-name aggregate over a Chrome trace (obs/trace.py output):
-    count, total/mean/max duration ms, sorted by total descending."""
+    count, total/mean/p50/p95/max duration ms, sorted by total descending.
+    The tail quantiles are what make a capture window or a straggler gap
+    quantifiable from the CLI — a mean hides exactly the steps that
+    triggered the capture."""
     with open(path) as f:
         doc = json.load(f)
     events = doc["traceEvents"] if isinstance(doc, dict) else doc
-    agg: Dict[str, dict] = {}
+    durs: Dict[str, List[float]] = {}
     for e in events:
         if e.get("ph") != "X":
             continue  # metadata / instant events carry no duration
-        name = e.get("name", "?")
-        dur_ms = float(e.get("dur", 0.0)) / 1e3
-        a = agg.setdefault(name, {"name": name, "count": 0,
-                                  "total_ms": 0.0, "max_ms": 0.0})
-        a["count"] += 1
-        a["total_ms"] += dur_ms
-        a["max_ms"] = max(a["max_ms"], dur_ms)
-    out = sorted(agg.values(), key=lambda a: -a["total_ms"])
-    for a in out:
-        a["mean_ms"] = a["total_ms"] / a["count"]
-    return out
+        durs.setdefault(e.get("name", "?"), []).append(
+            float(e.get("dur", 0.0)) / 1e3)
+    out = []
+    for name, ds in durs.items():
+        out.append({
+            "name": name,
+            "count": len(ds),
+            "total_ms": sum(ds),
+            "mean_ms": sum(ds) / len(ds),
+            "p50_ms": _percentile(ds, 0.5),
+            "p95_ms": _percentile(ds, 0.95),
+            "max_ms": max(ds),
+        })
+    return sorted(out, key=lambda a: -a["total_ms"])
 
 
 def render_trace(spans: List[dict], path: str) -> str:
@@ -199,11 +227,59 @@ def render_trace(spans: List[dict], path: str) -> str:
     w = max(len(s["name"]) for s in spans)
     lines = [f"-- span time summary: {path} --",
              f"{'span':<{w}}  {'count':>6}  {'total ms':>10}  "
-             f"{'mean ms':>9}  {'max ms':>9}"]
+             f"{'mean ms':>9}  {'p50 ms':>9}  {'p95 ms':>9}  {'max ms':>9}"]
     for s in spans:
         lines.append(f"{s['name']:<{w}}  {s['count']:>6}  "
                      f"{s['total_ms']:>10.1f}  {s['mean_ms']:>9.2f}  "
+                     f"{s['p50_ms']:>9.2f}  {s['p95_ms']:>9.2f}  "
                      f"{s['max_ms']:>9.1f}")
+    return "\n".join(lines)
+
+
+def render_merged(events: List[dict]) -> str:
+    """Render an obs_merge timeline: per-host step statistics side by
+    side, then every detected straggler — the cross-host view a single
+    journal cannot show."""
+    hosts: Dict[int, List[dict]] = {}
+    stragglers = []
+    header = None
+    for e in events:
+        if e.get("event") == "note" and e.get("note") == "obs_merge":
+            header = e
+        elif e.get("event") == "straggler":
+            stragglers.append(e)
+        elif "host" in e:
+            hosts.setdefault(int(e["host"]), []).append(e)
+    lines = ["== merged multi-host timeline =="]
+    if header:
+        lines.append(f"hosts {header.get('hosts')}  "
+                     f"sources {len(header.get('sources', []))}  "
+                     f"stragglers {header.get('stragglers', 0)}")
+    for h in sorted(hosts):
+        evs = hosts[h]
+        steps = [e for e in evs if e.get("event") == "step"]
+        st = _stats([float(e["step_time_ms"]) for e in steps
+                     if "step_time_ms" in e])
+        terminal = next((e for e in reversed(evs)
+                         if e.get("event") in ("exit", "crash")), None)
+        status = ("no terminal event" if terminal is None
+                  else terminal["event"])
+        line = f"host {h}: {len(steps)} steps, {status}"
+        if st:
+            line += ("  step_time " + _fmt_stat(st, " ms"))
+        lines.append(line)
+    if stragglers:
+        lines.append(f"-- stragglers ({len(stragglers)}) --")
+        for e in stragglers[:16]:
+            lines.append(
+                f"step {e.get('step'):>6}  host {e.get('host')}  "
+                f"gap {e.get('gap_ms'):.1f} ms  "
+                f"(max {e.get('max_ms'):.1f} vs median "
+                f"{e.get('median_ms'):.1f} over {e.get('hosts')} hosts)")
+        if len(stragglers) > 16:
+            lines.append(f"... {len(stragglers) - 16} more")
+    else:
+        lines.append("no stragglers detected")
     return "\n".join(lines)
 
 
@@ -213,7 +289,23 @@ def main(argv=None) -> int:
     p.add_argument("--trace", default=None, metavar="PATH",
                    help="also render a per-span time summary of this "
                         "Chrome trace JSON (train.py --trace output)")
+    p.add_argument("--merged", action="store_true",
+                   help="the input is a tools/obs_merge.py merged "
+                        "multi-host timeline: render per-host step "
+                        "statistics and the detected stragglers")
     args = p.parse_args(argv)
+
+    if args.merged:
+        events: List[dict] = []
+        for path in args.journals:
+            events.extend(read_journal(path))
+        if not events:
+            print("no events found", file=sys.stderr)
+            return 1
+        print(render_merged(events))
+        if args.trace:
+            print(render_trace(summarize_trace(args.trace), args.trace))
+        return 0
 
     by_run: Dict[str, List[dict]] = {}
     for path in args.journals:
