@@ -50,7 +50,7 @@ lint-baseline:
 # obs-smoke and chaos-smoke — the telemetry artifacts must validate and
 # the resilience contracts must hold before the tests count
 verify: SHELL := /bin/bash
-verify: lint obs-smoke chaos-smoke
+verify: lint obs-smoke chaos-smoke serve-smoke
 	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
 
 # observability smoke: a tiny CPU train with tracing + health guard +
@@ -74,6 +74,16 @@ obs-smoke:
 	  --trace artifacts/obs_smoke/trace.json
 	@if [ -n "$$(ls -A artifacts/obs_smoke/flight 2>/dev/null)" ]; then \
 	  echo "obs-smoke: clean run left a flight bundle"; exit 1; fi
+
+# serving smoke: a real multi-model CPU server (YOLO + pose @64x64)
+# through the whole serve/ contract — AOT warmup compiles exactly the
+# bucket menu, a mixed-size request stream causes ZERO additional
+# compilations, injected data.read faults degrade single requests,
+# clean shutdown passes check_journal --strict with no flight bundle,
+# and a SIGTERM'd child flushes all accepted requests and leaves a
+# crc-valid preempt bundle (tools/serve_smoke.py)
+serve-smoke:
+	JAX_PLATFORMS=cpu python tools/serve_smoke.py --workdir artifacts/serve_smoke
 
 # resilience smoke: a record-backed CPU train under injected faults
 # (skipped bad records within budget, SIGKILL mid-checkpoint-save,
@@ -119,4 +129,4 @@ ps:
 native:
 	$(MAKE) -C native
 
-.PHONY: train resume train-fg test lint lint-baseline verify obs-smoke chaos-smoke bench bench-evidence demo demo-gan demo-real dryrun tb ps native
+.PHONY: train resume train-fg test lint lint-baseline verify obs-smoke chaos-smoke serve-smoke bench bench-evidence demo demo-gan demo-real dryrun tb ps native

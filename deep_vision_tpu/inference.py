@@ -15,10 +15,21 @@ from __future__ import annotations
 
 import functools
 import time
+import warnings
 from typing import Callable, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
+
+# the predictors donate their image argument (see make_yolo_detector);
+# backends without donation support (CPU) warn once per lowering, which
+# is pure noise on every test/eval run — the donation is declared for
+# the TPU path. Scoped to jax's lowering module so nothing else is
+# silenced (serve/engine.py filters the same warning around its AOT
+# compiles).
+warnings.filterwarnings(
+    "ignore", message="Some donated buffers were not usable",
+    module=r"jax\._src\.interpreters\.mlir")
 
 from deep_vision_tpu.ops.anchors import YOLO_ANCHOR_MASKS, YOLO_ANCHORS
 from deep_vision_tpu.ops.boxes import decode_yolo_boxes
@@ -103,6 +114,29 @@ def yolo_detect(
     return {"boxes": out_b, "scores": out_s, "classes": out_c, "num": valid}
 
 
+def yolo_predict_fn(
+    model,
+    *,
+    anchors=YOLO_ANCHORS,
+    anchor_masks=YOLO_ANCHOR_MASKS,
+    max_detections: int = 100,
+    iou_threshold: float = 0.5,
+    score_threshold: float = 0.5,
+) -> Callable:
+    """The raw (variables, images) -> detections fn, un-jitted: what
+    make_yolo_detector wraps per call and serve/engine.py AOT-compiles
+    per bucket shape."""
+    return functools.partial(
+        yolo_detect,
+        apply_fn=model.apply,
+        anchors=anchors,
+        anchor_masks=anchor_masks,
+        max_detections=max_detections,
+        iou_threshold=iou_threshold,
+        score_threshold=score_threshold,
+    )
+
+
 def make_yolo_detector(
     model,
     *,
@@ -112,17 +146,23 @@ def make_yolo_detector(
     iou_threshold: float = 0.5,
     score_threshold: float = 0.5,
 ):
-    """Returns a jitted (variables, images) -> detections dict."""
-    fn = functools.partial(
-        yolo_detect,
-        apply_fn=model.apply,
+    """Returns a jitted (variables, images) -> detections dict.
+
+    Donation goes to the IMAGES (argnum 1), never the variables: eval
+    paths reuse `variables` across every call (donating state here is a
+    use-after-free — the DV003 exemption rationale), while a request's
+    input buffer is dead once decode starts, so its HBM is reusable for
+    the decode/NMS intermediates.
+    """
+    fn = yolo_predict_fn(
+        model,
         anchors=anchors,
         anchor_masks=anchor_masks,
         max_detections=max_detections,
         iou_threshold=iou_threshold,
         score_threshold=score_threshold,
     )
-    return _observed(jax.jit(fn), "yolo")
+    return _observed(jax.jit(fn, donate_argnums=1), "yolo")
 
 
 def centernet_decode(
@@ -177,8 +217,10 @@ def centernet_decode(
     }
 
 
-def make_centernet_detector(model, *, max_detections: int = 100,
-                            score_threshold: float = 0.1):
+def centernet_predict_fn(model, *, max_detections: int = 100,
+                         score_threshold: float = 0.1) -> Callable:
+    """Raw (variables, images) -> detections fn (un-jitted; serve/ AOT
+    path + make_centernet_detector share it)."""
     def detect(variables, images):
         outputs = model.apply(variables, images, train=False)
         return centernet_decode(
@@ -187,7 +229,15 @@ def make_centernet_detector(model, *, max_detections: int = 100,
             score_threshold=score_threshold,
         )
 
-    return _observed(jax.jit(detect), "centernet")
+    return detect
+
+
+def make_centernet_detector(model, *, max_detections: int = 100,
+                            score_threshold: float = 0.1):
+    # donate images, not variables — see make_yolo_detector
+    fn = centernet_predict_fn(model, max_detections=max_detections,
+                              score_threshold=score_threshold)
+    return _observed(jax.jit(fn, donate_argnums=1), "centernet")
 
 
 def heatmaps_to_keypoints(heatmaps):
@@ -205,10 +255,18 @@ def heatmaps_to_keypoints(heatmaps):
     return jnp.stack([xs, ys, score], axis=-1)
 
 
-def make_pose_estimator(model):
+def pose_predict_fn(model) -> Callable:
+    """Raw (variables, images) -> (B, J, 3) keypoints fn (un-jitted;
+    serve/ AOT path + make_pose_estimator share it)."""
     def estimate(variables, images):
         outputs = model.apply(variables, images, train=False)
         heatmaps = outputs[-1] if isinstance(outputs, (list, tuple)) else outputs
         return heatmaps_to_keypoints(heatmaps)
 
-    return _observed(jax.jit(estimate), "pose")
+    return estimate
+
+
+def make_pose_estimator(model):
+    # donate images, not variables — see make_yolo_detector
+    return _observed(jax.jit(pose_predict_fn(model), donate_argnums=1),
+                     "pose")

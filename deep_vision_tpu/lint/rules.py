@@ -477,8 +477,31 @@ def check_dv003(ctx) -> List[Finding]:
 
 # -- DV004 jit-in-loop -------------------------------------------------------
 
+# the one sanctioned compile loop: serving warms its (model, bucket)
+# executables inside functions named like warmup (serve/engine.py); the
+# same AOT chain anywhere else in a loop — above all a request/dispatch
+# loop — is compilation at serve time. Anchored to the name's start so
+# merely containing 'warm' (swarm_dispatch) does not punch a hole in
+# the gate.
+_DV004_WARMUP = re.compile(r"^(_*)((re|pre)?warm|preload|aot_|startup)",
+                           re.I)
+
+
+def _is_aot_compile_chain(call: ast.Call) -> bool:
+    """`<expr>.lower(...).compile(...)` — the AOT warmup chain. Bare
+    `.compile()` on a non-lower receiver (re.compile, a compiled
+    executable cached outside the loop) is not it."""
+    f = call.func
+    if not (isinstance(f, ast.Attribute) and f.attr == "compile"):
+        return False
+    recv = f.value
+    return isinstance(recv, ast.Call) and \
+        isinstance(recv.func, ast.Attribute) and recv.func.attr == "lower"
+
+
 def check_dv004(ctx) -> List[Finding]:
-    """jax.jit constructed (or re-applied) inside a loop body."""
+    """jax.jit constructed (or re-applied) inside a loop body; serve-aware:
+    also AOT .lower().compile() in any loop outside a warmup function."""
     out: List[Finding] = []
 
     def _is_jax_jit(func: ast.AST) -> bool:
@@ -492,7 +515,7 @@ def check_dv004(ctx) -> List[Finding]:
                 root_name(func) in ("jax", "pjit")
         return False
 
-    def scan(node, in_loop: bool):
+    def scan(node, in_loop: bool, fname: str):
         for child in ast.iter_child_nodes(node):
             if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
                                   ast.ClassDef)):
@@ -507,22 +530,39 @@ def check_dv004(ctx) -> List[Finding]:
                                 "a jit-decorated function defined inside a "
                                 "loop builds a fresh jit (and cache) every "
                                 "iteration; hoist the definition"))
-                scan(child, False)  # body executes when called, not per-iter
+                # body executes when called, not per-iter; track the new
+                # enclosing-function name for the warmup exemption
+                scan(child, False,
+                     child.name if not isinstance(child, ast.ClassDef)
+                     else fname)
                 continue
             if isinstance(child, ast.Lambda):
                 continue
             if isinstance(child, ast.Call) and in_loop and \
                     _is_jax_jit(child.func) and \
-                    (child.args or child.keywords):
+                    (child.args or child.keywords) and \
+                    not _DV004_WARMUP.search(fname):
+                # warmup functions are exempt from both forms: compiling
+                # per loop iteration is the POINT of a warmup pass (one
+                # jit per model, one lower/compile per bucket)
                 out.append(_finding(
                     ctx, "DV004", child,
                     "jax.jit(...) inside a loop creates a new compiled "
                     "function (and recompile) every iteration; hoist it "
                     "out of the loop"))
+            elif isinstance(child, ast.Call) and in_loop and \
+                    _is_aot_compile_chain(child) and \
+                    not _DV004_WARMUP.search(fname):
+                out.append(_finding(
+                    ctx, "DV004", child,
+                    ".lower(...).compile(...) inside a loop compiles at "
+                    "serve/run time; bucket executables must be built "
+                    "once in a warmup path (a function named warm*), "
+                    "never in a request/dispatch loop"))
             scan(child, in_loop or isinstance(
-                child, (ast.For, ast.While, ast.AsyncFor)))
+                child, (ast.For, ast.While, ast.AsyncFor)), fname)
 
-    scan(ctx.tree, False)
+    scan(ctx.tree, False, "")
     return out
 
 
@@ -655,7 +695,7 @@ RULES = {
     "DV003": ("missing-donation", "error", check_dv003,
               "jitted train/update step without donate_argnums"),
     "DV004": ("jit-in-loop", "error", check_dv004,
-              "jax.jit constructed inside a loop body"),
+              "jax.jit or AOT lower().compile() inside a loop body"),
     "DV005": ("impure-jit", "error", check_dv005,
               "host side effects inside a traced function"),
     "DV006": ("untraced-python-branch", "warning", check_dv006,

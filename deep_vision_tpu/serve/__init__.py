@@ -1,0 +1,55 @@
+"""Production inference serving: dynamic batching over an AOT-warmed
+multi-model engine, with SLO accounting and drain semantics.
+
+The "millions of users" leg of the roadmap: `inference.py`'s per-call
+predictors become a server —
+
+- `buckets`: the anti-recompile contract — coalesced requests round up
+  to a small fixed menu of batch shapes and zero-pad the tail
+  (`bucket_for`, `pad_batch`, `split_rows`).
+- `queue`: `BatchingQueue`, max-wait/max-batch request coalescing with
+  first-class drain (close -> flush-immediately -> None).
+- `engine`: `Engine`, AOT `jax.jit(...).lower().compile()` of every
+  (model, bucket) pair at startup, images donated on the inference
+  path; `run()` refuses to compile at request time.
+- `router`: `Server`, one queue+dispatcher per model over one device,
+  request-scoped failure (`data.read` fault boundary), health-policy
+  wiring, SIGTERM drain that flushes in-flight requests and dumps a
+  `preempt` flight bundle.
+- `slo`: `SLOTracker`, p50/p95/p99 request latency from the obs
+  registry histograms plus queue-depth / batch-occupancy /
+  padding-waste gauges.
+
+Journal events: `serve_request`, `serve_batch`, `serve_drain` (schemas
+in obs/README.md, validated by tools/check_journal.py). Trace spans:
+`serve/warmup`, `serve/batch`, `serve/drain`. The CI teeth are
+`make serve-smoke` (tools/serve_smoke.py) and tests/test_serve.py.
+"""
+from deep_vision_tpu.serve.buckets import (
+    DEFAULT_BUCKETS,
+    bucket_for,
+    normalize_buckets,
+    pad_batch,
+    split_rows,
+)
+from deep_vision_tpu.serve.engine import Engine, ModelEntry, ServeError
+from deep_vision_tpu.serve.queue import BatchingQueue, QueueClosed, Request
+from deep_vision_tpu.serve.router import Server, ServerClosed
+from deep_vision_tpu.serve.slo import SLOTracker
+
+__all__ = [
+    "BatchingQueue",
+    "DEFAULT_BUCKETS",
+    "Engine",
+    "ModelEntry",
+    "QueueClosed",
+    "Request",
+    "SLOTracker",
+    "ServeError",
+    "Server",
+    "ServerClosed",
+    "bucket_for",
+    "normalize_buckets",
+    "pad_batch",
+    "split_rows",
+]

@@ -1,0 +1,72 @@
+"""Batch-shape bucketing: the anti-recompile contract of the server.
+
+XLA compiles one executable per input shape. A server that batches
+"however many requests happened to be waiting" presents a new batch
+dimension every few milliseconds and spends its life in the compiler —
+the ORCA/Clipper-era fix is a small fixed menu of batch sizes: coalesced
+requests round UP to the smallest warmed bucket, the tail rows are
+zero-padded, and the padded rows are sliced off before anyone sees them.
+Every predictor in this repo is batch-independent (per-example decode /
+NMS / argmax), so padding rows cannot perturb real rows; tests prove the
+padded result equals the unpadded reference bitwise.
+
+Host-side and jax-free at import (like obs/registry.py): padding is
+numpy on the request thread, device work stays in serve/engine.py.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+#: default batch-size menu; powers of two keep the warmup cost log(max)
+DEFAULT_BUCKETS = (1, 2, 4, 8)
+
+
+def normalize_buckets(buckets: Sequence[int]) -> Tuple[int, ...]:
+    """Sorted unique positive bucket sizes; rejects an empty/invalid menu
+    loudly — a typo'd bucket list must not become a server that can
+    never warm anything."""
+    out = sorted({int(b) for b in buckets})
+    if not out or out[0] < 1:
+        raise ValueError(f"buckets must be positive ints, got {buckets!r}")
+    return tuple(out)
+
+
+def bucket_for(n: int, buckets: Sequence[int]) -> Optional[int]:
+    """Smallest bucket >= n, or None when n exceeds the largest bucket
+    (the queue caps batches at max(buckets), so a live server never sees
+    None — it exists for callers probing the menu)."""
+    for b in buckets:
+        if b >= n:
+            return int(b)
+    return None
+
+
+def pad_batch(images: List[np.ndarray], bucket: int,
+              dtype=np.float32) -> np.ndarray:
+    """Stack per-request images into a (bucket, *image_shape) array,
+    zero-padding rows [len(images), bucket). All images must share one
+    shape — spatial bucketing is the model's fixed input_shape contract,
+    enforced at submit time (serve/router.py), not here."""
+    if not images:
+        raise ValueError("pad_batch on an empty request list")
+    if len(images) > bucket:
+        raise ValueError(f"{len(images)} requests do not fit bucket {bucket}")
+    shape = images[0].shape
+    for im in images[1:]:
+        if im.shape != shape:
+            raise ValueError(
+                f"mixed image shapes in one batch: {im.shape} vs {shape}")
+    out = np.zeros((bucket,) + tuple(shape), dtype=dtype)
+    for i, im in enumerate(images):
+        out[i] = im
+    return out
+
+
+def split_rows(tree, n: int) -> List[dict]:
+    """Batched output pytree (dict of (bucket, ...) host arrays) -> one
+    dict per real request, padded rows discarded. Row i keeps no batch
+    dim: a client asked about one image and gets one answer."""
+    keys = list(tree)
+    return [{k: tree[k][i] for k in keys} for i in range(n)]

@@ -1,0 +1,173 @@
+"""AOT inference engine: every (model, bucket) pair compiles at startup.
+
+The serving steady state must never trace: `warmup()` walks the
+registered models' bucket menus and runs
+`jax.jit(fn, donate_argnums=1).lower(...).compile()` for each batch
+shape, so the first user request hits an executable, not the compiler.
+`run()` only ever looks up a pre-compiled executable by exact batch
+size — an unwarmed shape raises instead of silently jitting, which is
+the same contract jaxlint DV004 enforces statically on dispatch loops.
+
+Donation: the IMAGES argument (argnum 1) is donated, not the variables —
+detectors reuse `variables` across every request (donating state on an
+eval path is a use-after-free, the DV003 exemption rationale), while a
+request's input buffer is dead the moment the batch is dispatched, so
+its HBM is reusable for the outputs. inference.py's per-call jits carry
+the same donation (this PR's eval-path fix).
+"""
+from __future__ import annotations
+
+import time
+import warnings
+from typing import Dict, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from deep_vision_tpu.obs.trace import span
+from deep_vision_tpu.serve.buckets import DEFAULT_BUCKETS, normalize_buckets
+
+
+class ServeError(RuntimeError):
+    """Serving contract violation (unwarmed bucket, unknown model, bad
+    request shape)."""
+
+
+class ModelEntry:
+    """One registered model: the raw predict fn + its static serving menu."""
+
+    __slots__ = ("name", "fn", "variables", "input_shape", "dtype", "buckets")
+
+    def __init__(self, name: str, fn, variables, input_shape: Tuple[int, ...],
+                 dtype, buckets: Tuple[int, ...]):
+        self.name = name
+        self.fn = fn  # (variables, images) -> dict of batched outputs
+        self.variables = variables
+        self.input_shape = tuple(int(d) for d in input_shape)
+        self.dtype = dtype
+        self.buckets = buckets
+
+
+class Engine:
+    """Multi-model AOT compile cache over one device.
+
+    Wire-up (what serve/router.py and tools/serve_smoke.py do):
+
+        eng = Engine(journal=journal)
+        eng.register("yolo", yolo_predict_fn(model), variables,
+                     input_shape=(416, 416, 3), buckets=(1, 2, 4, 8))
+        stats = eng.warmup()       # compiles every (model, bucket) pair
+        out = eng.run("yolo", images)   # images.shape[0] must be a bucket
+    """
+
+    def __init__(self, journal=None, registry=None):
+        self.journal = journal
+        self._entries: Dict[str, ModelEntry] = {}
+        self._compiled: Dict[Tuple[str, int], object] = {}
+        self._warmed = False
+        if registry is None:
+            from deep_vision_tpu.obs.registry import get_registry
+
+            registry = get_registry()
+        self._g_warmed = registry.gauge(
+            "serve_warmed_buckets", "(model, bucket) executables compiled")
+
+    # -- registration --------------------------------------------------------
+
+    def register(self, name: str, fn, variables,
+                 input_shape: Sequence[int],
+                 buckets: Sequence[int] = DEFAULT_BUCKETS,
+                 dtype=np.float32) -> ModelEntry:
+        if self._warmed:
+            raise ServeError(
+                f"register({name!r}) after warmup: the bucket menu is "
+                "closed once compiled (restart to change it)")
+        if name in self._entries:
+            raise ServeError(f"model {name!r} already registered")
+        entry = ModelEntry(name, fn, variables, tuple(input_shape), dtype,
+                           normalize_buckets(buckets))
+        self._entries[name] = entry
+        return entry
+
+    @property
+    def models(self) -> Tuple[str, ...]:
+        return tuple(self._entries)
+
+    def entry(self, name: str) -> ModelEntry:
+        e = self._entries.get(name)
+        if e is None:
+            raise ServeError(
+                f"unknown model {name!r}; registered: {sorted(self._entries)}")
+        return e
+
+    # -- warmup --------------------------------------------------------------
+
+    def warmup(self) -> dict:
+        """Compile every (model, bucket) pair; returns the warmup report
+        (pairs, per-pair compile ms, backend-compile counter delta).
+
+        The ONE sanctioned compile loop in the serving path — jaxlint's
+        serve-aware DV004 exempts warm* functions and flags the same
+        .lower().compile() chain anywhere near a dispatch loop.
+        """
+        from deep_vision_tpu.obs.stepclock import recompile_count
+
+        if not self._entries:
+            raise ServeError("warmup() with no registered models")
+        compiles_before = recompile_count()
+        pairs = []
+        for entry in self._entries.values():
+            # the jit wrapper hoists out of the bucket loop: one traced
+            # callable per model, one lowering+compile per bucket shape
+            jitted = jax.jit(entry.fn, donate_argnums=1)
+            for bucket in entry.buckets:
+                spec = jax.ShapeDtypeStruct(
+                    (bucket,) + entry.input_shape, entry.dtype)
+                t0 = time.perf_counter()
+                with span("serve/warmup", model=entry.name, bucket=bucket), \
+                        warnings.catch_warnings():
+                    # CPU has no donation support and warns per lowering;
+                    # the donation is real on TPU and free to declare here
+                    warnings.filterwarnings(
+                        "ignore", message="Some donated buffers")
+                    compiled = jitted.lower(entry.variables, spec).compile()
+                ms = (time.perf_counter() - t0) * 1e3
+                self._compiled[(entry.name, bucket)] = compiled
+                pairs.append({"model": entry.name, "bucket": bucket,
+                              "compile_ms": round(ms, 1)})
+        self._warmed = True
+        self._g_warmed.set(len(self._compiled))
+        stats = {
+            "models": len(self._entries),
+            "pairs": len(pairs),
+            "backend_compiles": recompile_count() - compiles_before,
+            "compile_ms_total": round(sum(p["compile_ms"] for p in pairs), 1),
+            "detail": pairs,
+        }
+        if self.journal is not None:
+            self.journal.write("note", note="serve_warmup", **{
+                k: v for k, v in stats.items() if k != "detail"})
+        return stats
+
+    @property
+    def warmed(self) -> bool:
+        return self._warmed
+
+    def warmed_buckets(self, name: str) -> Tuple[int, ...]:
+        return tuple(b for (n, b) in self._compiled if n == name)
+
+    # -- the request path ----------------------------------------------------
+
+    def run(self, name: str, images):
+        """Execute one padded batch; images.shape must be exactly
+        (bucket, *input_shape) for a warmed bucket. Returns the device
+        output pytree (the router fetches + splits it)."""
+        compiled = self._compiled.get((name, int(images.shape[0])))
+        if compiled is None:
+            entry = self.entry(name)  # raises the clearer error first
+            raise ServeError(
+                f"model {name!r} has no warmed bucket {images.shape[0]} "
+                f"(warmed: {sorted(self.warmed_buckets(name))}, menu: "
+                f"{entry.buckets}); serving must never compile — fix the "
+                "bucket menu and re-warm")
+        return compiled(self.entry(name).variables, images)

@@ -1,0 +1,128 @@
+"""BatchingQueue: coalesce in-flight requests under a max-wait/max-batch
+policy.
+
+The serving latency/throughput dial: a request never waits longer than
+`max_wait_ms` for company (the latency bound), and a batch never exceeds
+`max_batch` = the largest warmed bucket (the shape bound). Between the
+two, the dispatcher takes whatever has accumulated — bucket rounding and
+padding happen downstream (serve/buckets.py), so the queue stays a pure
+host-side coalescer with no jax anywhere near it.
+
+Drain semantics are first-class: `close()` stops producers (submit
+raises `QueueClosed`), wakes the dispatcher, and switches `next_batch`
+to flush-immediately mode — remaining requests come back in max_batch
+slices with no max-wait lingering, then `None` tells the dispatcher to
+exit. SIGTERM drain (serve/router.py) is exactly this switch.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+from typing import Callable, List, Optional
+
+
+class QueueClosed(RuntimeError):
+    """submit() after close(): the server is draining or stopped."""
+
+
+class Request:
+    """One in-flight request: the payload, its promise, and its clock.
+
+    `accounted` latches once the router has counted this request toward
+    completed/errors/cancelled — a request must land in exactly one
+    bucket no matter which path (resolve, batch failure, client cancel)
+    reaches it first.
+    """
+
+    __slots__ = ("model", "image", "future", "t_submit", "accounted")
+
+    def __init__(self, model: str, image):
+        self.model = model
+        self.image = image
+        self.future: Future = Future()
+        self.t_submit = time.perf_counter()
+        self.accounted = False
+
+
+class BatchingQueue:
+    """Thread-safe request coalescer for one model.
+
+    Producers call `submit` from any thread; one dispatcher thread loops
+    on `next_batch`. `on_depth` (the slo.py queue-depth gauge hook) is
+    called with the post-change depth under no lock contention concerns —
+    registry gauges take their own lock.
+    """
+
+    def __init__(self, max_batch: int, max_wait_ms: float = 5.0,
+                 on_depth: Optional[Callable[[int], None]] = None):
+        if max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+        self.max_batch = int(max_batch)
+        self.max_wait_s = max(0.0, float(max_wait_ms)) / 1e3
+        self._on_depth = on_depth
+        self._q: deque = deque()
+        self._cond = threading.Condition()
+        self._closed = False
+
+    # -- producer side -----------------------------------------------------
+
+    def submit(self, request: Request) -> None:
+        with self._cond:
+            if self._closed:
+                raise QueueClosed(
+                    f"queue for {request.model!r} is draining/closed")
+            self._q.append(request)
+            depth = len(self._q)
+            self._cond.notify_all()
+        if self._on_depth is not None:
+            self._on_depth(depth)
+
+    @property
+    def depth(self) -> int:
+        with self._cond:
+            return len(self._q)
+
+    @property
+    def closed(self) -> bool:
+        with self._cond:
+            return self._closed
+
+    # -- dispatcher side ---------------------------------------------------
+
+    def next_batch(self) -> Optional[List[Request]]:
+        """Block until a batch is ready; None = closed AND empty (exit).
+
+        A batch is ready when `max_batch` requests are waiting, when the
+        OLDEST request has waited `max_wait_ms`, or immediately once the
+        queue is closed (drain flushes, it never lingers).
+        """
+        with self._cond:
+            while not self._q and not self._closed:
+                self._cond.wait()
+            if not self._q:
+                return None  # closed and drained: dispatcher exits
+            if not self._closed:
+                # the max-wait window is anchored on the oldest request:
+                # later arrivals ride it, they do not extend it
+                deadline = self._q[0].t_submit + self.max_wait_s
+                while len(self._q) < self.max_batch and not self._closed:
+                    remaining = deadline - time.perf_counter()
+                    if remaining <= 0:
+                        break
+                    self._cond.wait(timeout=remaining)
+            take = min(len(self._q), self.max_batch)
+            batch = [self._q.popleft() for _ in range(take)]
+            depth = len(self._q)
+        if self._on_depth is not None:
+            self._on_depth(depth)
+        return batch
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def close(self) -> None:
+        """Stop accepting; flush what remains. Idempotent."""
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()

@@ -1,0 +1,415 @@
+"""Multi-model Router/Server: queues in front, AOT engine behind.
+
+One `Server` owns one device's serving plane: a `BatchingQueue` and a
+dispatcher thread per registered model, all execution funneled through
+one device lock (multi-model routing over ONE device — models share the
+chip, batches serialize). The request path is:
+
+    submit(model, image)                      # any thread
+      -> faults.fire("data.read")             # the request-decode boundary:
+                                              #   an injected/real I/O error
+                                              #   fails THIS request's future,
+                                              #   never the server
+      -> BatchingQueue coalesces (max-wait / max-batch)
+      -> bucket_for + pad_batch               # round up to a warmed shape
+      -> Engine.run (compiled executable, donated input buffer)
+      -> device_get, split rows, resolve futures
+
+Everything rides the substrate from day one: typed `serve_request` /
+`serve_batch` / `serve_drain` journal events, `serve/*` trace spans,
+SLO metrics (serve/slo.py), health-policy wiring (non-finite outputs
+journal a `health` event; policy `abort` fails the batch's requests
+instead of shipping NaNs), and a SIGTERM drain that flushes every
+accepted request and dumps a `preempt` flight bundle. A clean `close()`
+drains without the bundle — a healthy shutdown leaves no postmortem.
+"""
+from __future__ import annotations
+
+import signal
+import threading
+import time
+from concurrent.futures import Future
+from typing import Dict, List, Optional
+
+import jax
+import numpy as np
+
+from deep_vision_tpu.obs.trace import span
+from deep_vision_tpu.serve.buckets import bucket_for, pad_batch, split_rows
+from deep_vision_tpu.serve.engine import Engine, ServeError
+from deep_vision_tpu.serve.queue import BatchingQueue, QueueClosed, Request
+from deep_vision_tpu.serve.slo import SLOTracker
+
+DRAIN_REASONS = ("close", "sigterm")
+HEALTH_POLICIES = ("warn", "abort")
+
+
+class ServerClosed(QueueClosed):
+    """submit() on a draining/stopped server."""
+
+
+class Server:
+    """Production serving loop over a warmed Engine.
+
+    Wire-up (what tools/serve_smoke.py does):
+
+        server = Server(engine, journal=journal, max_wait_ms=5.0)
+        server.start()                       # engine must be warmed
+        fut = server.submit("yolo", image)   # -> Future of an output dict
+        ...
+        server.install_sigterm()             # main thread only
+        server.wait_for_stop()               # returns True on SIGTERM
+        server.drain("sigterm")              # flush + preempt flight bundle
+    """
+
+    def __init__(self, engine: Engine, journal=None, registry=None,
+                 max_wait_ms: float = 5.0, drain_timeout_s: float = 30.0,
+                 slo_ms: Optional[float] = None,
+                 health_policy: str = "warn", health=None):
+        if health_policy not in HEALTH_POLICIES:
+            raise ValueError(
+                f"health_policy {health_policy!r} not in {HEALTH_POLICIES}")
+        self.engine = engine
+        self.journal = journal
+        self.slo = SLOTracker(registry=registry, slo_ms=slo_ms)
+        self.max_wait_ms = float(max_wait_ms)
+        self.drain_timeout_s = float(drain_timeout_s)
+        self.health_policy = health_policy
+        self.health = health  # optional obs.HealthMonitor: beat() per batch
+        self._queues: Dict[str, BatchingQueue] = {}
+        self._threads: List[threading.Thread] = []
+        self._device_lock = threading.Lock()  # one device, serialized exec
+        self._count_lock = threading.Lock()
+        # serializes submit's accept-then-enqueue against drain's latch:
+        # drain must never observe an accepted request that is not yet in
+        # a queue (it would count as pending and taint the drain verdict)
+        self._submit_lock = threading.Lock()
+        self.accepted = 0
+        self.completed = 0
+        self.errors = 0
+        self.cancelled = 0  # client gave up while queued/dispatched
+        self._started = False
+        self._drained: Optional[dict] = None
+        self._drain_done = threading.Event()
+        self._stop = threading.Event()
+        self._prev_sigterm = None
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "Server":
+        if not self.engine.warmed:
+            raise ServeError("start() before engine.warmup(): the server "
+                             "must never compile at request time")
+        if self._started:
+            return self
+        for name in self.engine.models:
+            entry = self.engine.entry(name)
+            q = BatchingQueue(
+                max_batch=max(entry.buckets),
+                max_wait_ms=self.max_wait_ms,
+                on_depth=lambda d, _m=name: self.slo.queue_depth(_m, d))
+            self._queues[name] = q
+            t = threading.Thread(target=self._dispatch_loop,
+                                 args=(name, q), name=f"serve-{name}",
+                                 daemon=True)
+            self._threads.append(t)
+            t.start()
+        self._started = True
+        return self
+
+    # -- request ingestion ---------------------------------------------------
+
+    def submit(self, model: str, image) -> Future:
+        """Enqueue one image for `model`; returns a Future resolving to
+        the model's per-request output dict (padded rows already gone).
+
+        Failures are REQUEST-scoped by design: a bad shape, an unknown
+        model, or an I/O error at the decode boundary (the `data.read`
+        fault-injection point) resolves this future with the exception
+        and the server keeps serving everyone else.
+        """
+        if not self._started:
+            raise ServeError("submit() before start(): no dispatchers are "
+                             "running to answer it")
+        req = Request(model, image)
+        # decode OUTSIDE the submit lock: the dtype cast/copy, shape check,
+        # and fault boundary are per-request work that must not serialize
+        # ingestion across client threads — only the accept+enqueue below
+        # needs atomicity against drain's latch
+        decode_err: Optional[Exception] = None
+        try:
+            entry = self.engine.entry(model)
+            # the request-decode boundary: exactly where a production
+            # server reads/decodes the payload off the wire — injected
+            # data.read faults (resilience/faults.py) land here and
+            # degrade one request, not the process
+            from deep_vision_tpu.resilience import faults
+
+            faults.fire("data.read")
+            arr = np.asarray(req.image, dtype=entry.dtype)
+            if tuple(arr.shape) != entry.input_shape:
+                raise ServeError(
+                    f"request shape {tuple(arr.shape)} != {model!r} "
+                    f"input {entry.input_shape} (spatial shapes are "
+                    "static; resize on the client or register another "
+                    "model)")
+            req.image = arr
+        except (ServeError, OSError, ValueError, TypeError) as e:
+            decode_err = e
+        with self._submit_lock:
+            if self._drained is not None or self._stop.is_set():
+                raise ServerClosed("server is draining/stopped")
+            # accepted counts every request the server took responsibility
+            # for — including ones that fail at the decode boundary:
+            # drain's accepted == completed + errors + cancelled invariant
+            # needs both
+            with self._count_lock:
+                self.accepted += 1
+            if decode_err is None:
+                try:
+                    self._queues[model].submit(req)
+                except QueueClosed:
+                    with self._count_lock:
+                        self.accepted -= 1  # never enqueued, nobody owes it
+                    raise ServerClosed("server is draining/stopped")
+            else:
+                # account the failure while still holding the lock: drain
+                # latching between accepted+=1 and errors+=1 would see an
+                # unbalanced ledger and misreport timeout
+                self._fail_request(req, decode_err)
+        return req.future
+
+    def _account(self, req: Request, outcome: str, latency_ms: float,
+                 error: Optional[str] = None) -> None:
+        """Count one request toward exactly one of completed / errors /
+        cancelled (latched per request: the drain invariant
+        accepted == completed + errors + cancelled must survive races
+        between resolution, batch failure, and client cancellation)."""
+        if req.accounted:
+            return
+        req.accounted = True
+        with self._count_lock:
+            if outcome == "ok":
+                self.completed += 1
+            elif outcome == "cancelled":
+                self.cancelled += 1
+            else:
+                self.errors += 1
+        self.slo.request_done(req.model, latency_ms, outcome)
+        if self.journal is not None:
+            extra = {"error": error[:200]} if error else {}
+            self.journal.write("serve_request", model=req.model,
+                               latency_ms=round(latency_ms, 3),
+                               outcome=outcome, **extra)
+
+    def _fail_request(self, req: Request, exc: Exception) -> None:
+        latency_ms = (time.perf_counter() - req.t_submit) * 1e3
+        # a cancelled Future rejects set_exception; the client already
+        # walked away — account it as cancelled, not as a server error
+        if not req.future.set_running_or_notify_cancel():
+            self._account(req, "cancelled", latency_ms)
+            return
+        req.future.set_exception(exc)
+        self._account(req, "error", latency_ms,
+                      error=f"{type(exc).__name__}: {exc}")
+
+    # -- dispatch ------------------------------------------------------------
+
+    def _dispatch_loop(self, model: str, q: BatchingQueue) -> None:
+        # the serving hot loop: lint-clean by construction — no jit, no
+        # lower/compile anywhere below (DV004's serve-aware check flags
+        # exactly that), only warmed-executable lookups
+        while True:
+            batch = q.next_batch()
+            if batch is None:
+                return
+            try:
+                self._run_batch(model, batch)
+            except Exception as e:  # a poisoned batch fails its requests,
+                for req in batch:  # never the dispatcher
+                    if req.future.cancelled():
+                        self._account(
+                            req, "cancelled",
+                            (time.perf_counter() - req.t_submit) * 1e3)
+                    elif not req.future.done():
+                        self._fail_request(req, e)
+
+    def _run_batch(self, model: str, batch: List[Request]) -> None:
+        entry = self.engine.entry(model)
+        bucket = bucket_for(len(batch), entry.buckets)
+        t_dispatch = time.perf_counter()
+        queue_wait_ms = (t_dispatch
+                         - min(r.t_submit for r in batch)) * 1e3
+        with span("serve/batch", model=model, bucket=bucket,
+                  size=len(batch)):
+            images = pad_batch([r.image for r in batch], bucket,
+                               dtype=entry.dtype)
+            with self._device_lock:
+                out = self.engine.run(model, images)
+                host = jax.device_get(out)  # fences: exec_ms is end-to-end
+        exec_ms = (time.perf_counter() - t_dispatch) * 1e3
+        bad = self._nonfinite_fields(host, len(batch))
+        rows = self._split(host, len(batch))
+        t_done = time.perf_counter()
+        for req, row in zip(batch, rows):
+            latency_ms = (t_done - req.t_submit) * 1e3
+            if bad and self.health_policy == "abort":
+                # the health policy's serving semantics: never ship NaNs —
+                # the affected requests fail, the server keeps answering
+                self._fail_request(req, ServeError(
+                    f"non-finite output fields {bad} (health_policy=abort)"))
+                continue
+            if not req.future.set_running_or_notify_cancel():
+                # client gave up while the request was queued: the row
+                # has no recipient, but the books must still balance
+                self._account(req, "cancelled", latency_ms)
+                continue
+            req.future.set_result(row)
+            self._account(req, "ok", latency_ms)
+        self.slo.batch_done(model, bucket, len(batch), queue_wait_ms,
+                            exec_ms)
+        if self.journal is not None:
+            self.journal.write(
+                "serve_batch", model=model, bucket=int(bucket),
+                size=len(batch),
+                occupancy_pct=round(100.0 * len(batch) / bucket, 1),
+                padding_waste_pct=round(
+                    100.0 * (bucket - len(batch)) / bucket, 1),
+                queue_wait_ms=round(queue_wait_ms, 3),
+                exec_ms=round(exec_ms, 3))
+        if bad:
+            self._emit_nonfinite(model, bad, len(batch))
+        if self.health is not None:
+            self.health.beat()  # the serve loop is the watchdog heartbeat
+
+    def _split(self, host, n: int) -> List:
+        """Batched host output -> one row per real request. Dicts (the
+        detector contract) go through buckets.split_rows; any other
+        pytree (e.g. the pose estimator's bare keypoint array) is
+        row-indexed leaf-wise."""
+        if isinstance(host, dict):
+            return split_rows(host, n)
+        return [jax.tree_util.tree_map(lambda a: a[i], host)
+                for i in range(n)]
+
+    def _nonfinite_fields(self, host, n: int) -> List[str]:
+        items = (host.items() if isinstance(host, dict)
+                 else enumerate(jax.tree_util.tree_leaves(host)))
+        bad = []
+        for k, v in items:
+            a = np.asarray(v)
+            if np.issubdtype(a.dtype, np.floating) and \
+                    not np.isfinite(a[:n]).all():
+                bad.append(str(k))
+        return sorted(bad)
+
+    def _emit_nonfinite(self, model: str, fields: List[str],
+                        size: int) -> None:
+        self.slo.registry.counter(
+            "serve_nonfinite_batches_total",
+            "batches with non-finite output fields",
+            labels={"model": model}).inc()
+        if self.journal is not None:
+            # same typed health event the training monitor emits, so one
+            # check_journal schema and one obs_report health table cover
+            # both planes
+            self.journal.write("health", kind="non_finite",
+                               policy=self.health_policy, monitor="serve",
+                               fields=fields, action=self.health_policy,
+                               model=model, batch_size=size)
+
+    # -- drain / shutdown ----------------------------------------------------
+
+    def drain(self, reason: str = "close") -> dict:
+        """Flush every accepted request, then stop. Idempotent (the first
+        reason wins). `sigterm` additionally dumps a `preempt` flight
+        bundle — a clean `close` leaves no postmortem artifacts.
+        """
+        if reason not in DRAIN_REASONS:
+            raise ValueError(f"drain reason {reason!r} not in {DRAIN_REASONS}")
+        # the submit lock guarantees no request is accepted-but-unqueued
+        # when the latch lands: past this point every accepted request is
+        # either in a queue (the dispatchers will flush it) or resolved
+        with self._submit_lock, self._count_lock:
+            already = self._drained is not None
+            if not already:
+                # full-keyed placeholder: a concurrent caller that times
+                # out waiting below still sees a well-formed summary
+                self._drained = {
+                    "reason": reason, "outcome": "timeout",
+                    "accepted": self.accepted, "completed": self.completed,
+                    "errors": self.errors, "cancelled": self.cancelled,
+                    "pending": max(0, self.accepted - self.completed
+                                   - self.errors - self.cancelled),
+                }
+        if already:
+            # a second drain (close racing a SIGTERM drain) waits for the
+            # first one's verdict instead of returning a half-done record
+            self._drain_done.wait(timeout=self.drain_timeout_s)
+            return self._drained
+        try:
+            deadline = time.perf_counter() + self.drain_timeout_s
+            with span("serve/drain", reason=reason):
+                for q in self._queues.values():
+                    q.close()  # stop accepting; flush-immediately mode
+                for t in self._threads:
+                    t.join(timeout=max(0.0,
+                                       deadline - time.perf_counter()))
+                with self._count_lock:
+                    # one consistent snapshot: the journaled summary must
+                    # balance even if a straggler is mid-account elsewhere
+                    counts = {"accepted": self.accepted,
+                              "completed": self.completed,
+                              "errors": self.errors,
+                              "cancelled": self.cancelled}
+                pending = (counts["accepted"] - counts["completed"]
+                           - counts["errors"] - counts["cancelled"])
+                outcome = ("flushed" if pending == 0
+                           and not any(t.is_alive() for t in self._threads)
+                           else "timeout")
+                summary = {"reason": reason, "outcome": outcome,
+                           **counts, "pending": max(0, pending)}
+                if self.journal is not None:
+                    self.journal.write("serve_drain", **summary)
+                if reason == "sigterm":
+                    # the preemption postmortem: same bundle + reason the
+                    # trainer's PreemptionGuard dumps, so one flight-dir
+                    # convention covers both planes
+                    from deep_vision_tpu.obs import flight
+
+                    summary["flight_bundle"] = \
+                        flight.emergency_dump("preempt")
+            self._drained = summary
+            return summary
+        finally:
+            self._stop.set()
+            self._drain_done.set()
+
+    def close(self) -> dict:
+        return self.drain("close")
+
+    # -- SIGTERM wiring ------------------------------------------------------
+
+    def install_sigterm(self) -> None:
+        """Arm SIGTERM -> stop flag (main thread only, like
+        parallel/multihost.PreemptionGuard). The handler only sets the
+        flag; the serving owner loop observes it (`wait_for_stop`) and
+        runs the drain OUTSIDE signal context, where joining threads and
+        journaling are safe."""
+        self._prev_sigterm = signal.signal(signal.SIGTERM, self._on_sigterm)
+
+    def uninstall_sigterm(self) -> None:
+        if self._prev_sigterm is not None:
+            signal.signal(signal.SIGTERM, self._prev_sigterm)
+            self._prev_sigterm = None
+
+    def _on_sigterm(self, signum, frame) -> None:
+        self._stop.set()
+
+    @property
+    def stop_requested(self) -> bool:
+        return self._stop.is_set()
+
+    def wait_for_stop(self, timeout: Optional[float] = None) -> bool:
+        """Block until SIGTERM (or drain/close) flips the stop flag."""
+        return self._stop.wait(timeout)

@@ -40,15 +40,16 @@ def main():
     args = p.parse_args()
 
     model = get_model("yolov3", num_classes=4)
-    x = jnp.asarray(
-        np.random.RandomState(0).rand(1, args.image_size, args.image_size, 3),
-        jnp.float32,
-    )
+    img = np.random.RandomState(0).rand(
+        1, args.image_size, args.image_size, 3).astype(np.float32)
+    x = jnp.asarray(img)
     variables = model.init(jax.random.PRNGKey(0), x, train=False)
 
-    # image batch -> decoded, class-aware-NMS'd boxes, all jitted
+    # image batch -> decoded, class-aware-NMS'd boxes, all jitted. The
+    # detector donates its image argument (inference.py), and the export
+    # round-trip below still needs x — hand the detector its own copy
     detect = make_yolo_detector(model, score_threshold=0.1)
-    det = detect(variables, x)
+    det = detect(variables, jnp.asarray(img))
     n = int(det["num"][0])
     print(f"detections: {n} boxes "
           f"(scores {np.asarray(det['scores'][0, :max(n, 1)]).round(3)})")

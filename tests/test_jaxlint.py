@@ -622,6 +622,68 @@ def test_dv004_def_in_loop_with_deferred_jit_ok():
     """) == []
 
 
+def test_dv004_aot_compile_in_dispatch_loop_flagged():
+    # the serve-aware check: .lower().compile() in a request/dispatch
+    # loop is compilation at serve time — users wait on XLA
+    found = run("""
+        import jax
+
+        def dispatch_loop(fn, variables, queue):
+            while True:
+                batch = queue.get()
+                exe = jax.jit(fn).lower(variables, batch).compile()
+                exe(variables, batch)
+    """)
+    assert "DV004" in [f.code for f in found]
+    assert any("warmup" in f.message for f in found)
+
+
+def test_dv004_aot_compile_in_warmup_loop_ok():
+    # warmup is THE sanctioned compile loop: one jit per model, one
+    # lower/compile per bucket (serve/engine.py's shape)
+    assert codes("""
+        import jax
+
+        def warmup(fn, variables, buckets, shape):
+            compiled = {}
+            jitted = jax.jit(fn, donate_argnums=1)
+            for b in buckets:
+                spec = jax.ShapeDtypeStruct((b,) + shape, "float32")
+                compiled[b] = jitted.lower(variables, spec).compile()
+            return compiled
+    """) == []
+
+
+def test_dv004_warmup_exemption_is_name_anchored():
+    # 'warm' buried mid-name is not a warmup path: the exemption must
+    # not weaken the gate for a function that merely contains the word
+    assert codes("""
+        import jax
+
+        def swarm_dispatch(fn, xs):
+            out = []
+            for x in xs:
+                out.append(jax.jit(fn)(x))
+            return out
+    """) == ["DV004"]
+
+
+def test_dv004_non_lower_compile_in_loop_ok():
+    # re.compile (and any non-AOT .compile) in a loop is not jax's
+    # problem; calling an already-compiled executable is the point
+    assert codes("""
+        import re
+
+        def scan_all(patterns, lines, exe, batches):
+            out = []
+            for p in patterns:
+                out.append(re.compile(p))
+            for b in batches:
+                out.append(exe(b))
+            return out
+    """) == []
+
+
 # -- DV005 impure-jit ---------------------------------------------------------
 
 def test_dv005_self_write_time_and_np_random():

@@ -1,0 +1,510 @@
+"""serve/ tier-1 suite: bucket/padding correctness, the zero-recompile
+contract, max-wait flush timing, drain semantics (including SIGTERM +
+flight bundle), and request-scoped fault degradation.
+
+Runs on a pure-jnp toy model so the whole stack (queue -> bucket ->
+AOT engine -> router -> slo/journal) exercises in CPU-tier time; the
+real YOLO/pose router is `make serve-smoke` (tools/serve_smoke.py).
+"""
+import json
+import os
+import signal
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deep_vision_tpu.obs import RunJournal, read_journal
+from deep_vision_tpu.obs.registry import Registry
+from deep_vision_tpu.obs.stepclock import recompile_count
+from deep_vision_tpu.resilience import FaultInjected, faults
+from deep_vision_tpu.serve import (
+    BatchingQueue,
+    Engine,
+    Request,
+    ServeError,
+    Server,
+    ServerClosed,
+    bucket_for,
+    normalize_buckets,
+    pad_batch,
+    split_rows,
+)
+
+IMG = (4, 4, 1)
+
+
+def toy_fn(variables, images):
+    flat = images.reshape((images.shape[0], -1))
+    return {"scores": flat @ variables["w"],
+            "mean": images.mean(axis=(1, 2, 3))}
+
+
+def toy_variables(seed=0):
+    w = np.random.RandomState(seed).randn(16, 3).astype(np.float32)
+    return {"w": jnp.asarray(w)}
+
+
+def make_engine(buckets=(1, 2, 4), registry=None, journal=None, seed=0):
+    eng = Engine(registry=registry or Registry(), journal=journal)
+    eng.register("toy", toy_fn, toy_variables(seed), input_shape=IMG,
+                 buckets=buckets)
+    return eng
+
+
+def images(n, seed=1):
+    rng = np.random.RandomState(seed)
+    return [rng.rand(*IMG).astype(np.float32) for _ in range(n)]
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    yield
+    faults.install(None)
+    os.environ.pop(faults.ENV_SPEC, None)
+    os.environ.pop(faults.ENV_SEED, None)
+
+
+@pytest.fixture
+def journal(tmp_path):
+    j = RunJournal(str(tmp_path / "serve.jsonl"), kind="serve")
+    yield j
+    if not j._closed:
+        j.close()
+
+
+def strict_errors(path):
+    from tools.check_journal import check_journal
+
+    return check_journal(path, strict=True)
+
+
+# -- buckets -----------------------------------------------------------------
+
+class TestBuckets:
+    def test_bucket_for_rounds_up(self):
+        buckets = (1, 2, 4, 8)
+        assert bucket_for(1, buckets) == 1
+        assert bucket_for(2, buckets) == 2
+        assert bucket_for(3, buckets) == 4
+        assert bucket_for(5, buckets) == 8
+        assert bucket_for(8, buckets) == 8
+        assert bucket_for(9, buckets) is None
+
+    def test_normalize_rejects_garbage(self):
+        assert normalize_buckets([4, 1, 4, 2]) == (1, 2, 4)
+        with pytest.raises(ValueError):
+            normalize_buckets([])
+        with pytest.raises(ValueError):
+            normalize_buckets([0, 2])
+
+    def test_pad_batch_contents_and_padding(self):
+        ims = images(3)
+        arr = pad_batch(ims, 4)
+        assert arr.shape == (4,) + IMG
+        for i, im in enumerate(ims):
+            np.testing.assert_array_equal(arr[i], im)
+        np.testing.assert_array_equal(arr[3], np.zeros(IMG, np.float32))
+
+    def test_pad_batch_rejects_overflow_and_mixed_shapes(self):
+        with pytest.raises(ValueError):
+            pad_batch(images(5), 4)
+        with pytest.raises(ValueError):
+            pad_batch([np.zeros(IMG, np.float32),
+                       np.zeros((2, 2, 1), np.float32)], 4)
+        with pytest.raises(ValueError):
+            pad_batch([], 4)
+
+    def test_split_rows_drops_padding(self):
+        tree = {"a": np.arange(8).reshape(4, 2), "b": np.arange(4)}
+        rows = split_rows(tree, 3)
+        assert len(rows) == 3
+        np.testing.assert_array_equal(rows[1]["a"], [2, 3])
+        assert rows[2]["b"] == 2
+
+
+# -- engine ------------------------------------------------------------------
+
+class TestEngine:
+    def test_warmup_compiles_every_pair_exactly_once(self):
+        eng = make_engine(buckets=(1, 2, 4))
+        stats = eng.warmup()
+        assert stats["pairs"] == 3
+        # the AOT contract: one backend compile per (model, bucket) pair,
+        # nothing eager slipping in at trace time
+        assert stats["backend_compiles"] == 3
+        assert sorted(eng.warmed_buckets("toy")) == [1, 2, 4]
+
+    def test_padded_equals_unpadded_reference(self):
+        eng = make_engine(buckets=(4,))
+        eng.warmup()
+        ims = images(3)
+        out = jax.device_get(eng.run("toy", pad_batch(ims, 4)))
+        ref = jax.device_get(
+            toy_fn(toy_variables(), jnp.asarray(np.stack(ims))))
+        np.testing.assert_allclose(out["scores"][:3], ref["scores"],
+                                   rtol=1e-6)
+        np.testing.assert_allclose(out["mean"][:3], ref["mean"], rtol=1e-6)
+
+    def test_zero_recompiles_after_warmup(self):
+        eng = make_engine(buckets=(1, 2, 4))
+        eng.warmup()
+        c0 = recompile_count()
+        for n in (1, 2, 4, 2, 1, 4):
+            eng.run("toy", pad_batch(images(n), n))
+        assert recompile_count() == c0, \
+            "serving mixed warmed shapes must never touch the compiler"
+
+    def test_unwarmed_bucket_refuses_to_compile(self):
+        eng = make_engine(buckets=(1, 2))
+        eng.warmup()
+        with pytest.raises(ServeError, match="no warmed bucket"):
+            eng.run("toy", np.zeros((3,) + IMG, np.float32))
+
+    def test_unknown_model_and_late_register(self):
+        eng = make_engine()
+        with pytest.raises(ServeError, match="unknown model"):
+            eng.entry("nope")
+        eng.warmup()
+        with pytest.raises(ServeError, match="after warmup"):
+            eng.register("late", toy_fn, toy_variables(), IMG)
+
+    def test_start_before_warmup_refused(self):
+        with pytest.raises(ServeError, match="warmup"):
+            Server(make_engine()).start()
+
+
+# -- batching queue ----------------------------------------------------------
+
+class TestBatchingQueue:
+    def test_coalesces_to_max_batch(self):
+        q = BatchingQueue(max_batch=4, max_wait_ms=5000)
+        for _ in range(6):
+            q.submit(Request("m", None))
+        t0 = time.perf_counter()
+        batch = q.next_batch()
+        # max_batch reached: no max-wait lingering
+        assert time.perf_counter() - t0 < 1.0
+        assert len(batch) == 4
+        assert q.depth == 2
+
+    def test_max_wait_flushes_partial_batch(self):
+        q = BatchingQueue(max_batch=8, max_wait_ms=40)
+        q.submit(Request("m", None))
+        t0 = time.perf_counter()
+        batch = q.next_batch()
+        elapsed = time.perf_counter() - t0
+        assert len(batch) == 1
+        # lower bound is the contract (a request waits for company up to
+        # max_wait); the upper bound is loose for CI schedulers
+        assert 0.02 <= elapsed < 5.0
+
+    def test_close_flushes_immediately_then_none(self):
+        q = BatchingQueue(max_batch=4, max_wait_ms=60_000)
+        for _ in range(2):
+            q.submit(Request("m", None))
+        q.close()
+        t0 = time.perf_counter()
+        assert len(q.next_batch()) == 2
+        assert q.next_batch() is None
+        assert time.perf_counter() - t0 < 1.0, "drain must not linger"
+        with pytest.raises(Exception):
+            q.submit(Request("m", None))
+
+
+# -- server ------------------------------------------------------------------
+
+class TestServer:
+    def _server(self, journal=None, registry=None, **kw):
+        eng = make_engine(buckets=(1, 2, 4), registry=registry,
+                          journal=journal)
+        eng.warmup()
+        kw.setdefault("max_wait_ms", 3.0)
+        srv = Server(eng, journal=journal, registry=registry, **kw)
+        srv.start()
+        return srv
+
+    def test_round_trip_matches_reference(self, journal):
+        srv = self._server(journal=journal)
+        try:
+            ims = images(5)
+            futs = [srv.submit("toy", im) for im in ims]
+            rows = [f.result(timeout=30) for f in futs]
+            ref = jax.device_get(
+                toy_fn(toy_variables(), jnp.asarray(np.stack(ims))))
+            for i, row in enumerate(rows):
+                np.testing.assert_allclose(row["scores"], ref["scores"][i],
+                                           rtol=1e-6)
+        finally:
+            srv.close()
+        journal.close()
+        events = read_journal(journal.path)
+        kinds = [e["event"] for e in events]
+        assert kinds.count("serve_request") == 5
+        assert "serve_batch" in kinds
+        drain = next(e for e in events if e["event"] == "serve_drain")
+        assert drain["reason"] == "close"
+        assert drain["outcome"] == "flushed"
+        assert drain["completed"] == 5
+        assert strict_errors(journal.path) == []
+
+    def test_zero_recompiles_through_server_path(self):
+        srv = self._server()
+        try:
+            c0 = recompile_count()
+            for burst in (1, 3, 2, 4, 1):
+                futs = [srv.submit("toy", im) for im in images(burst)]
+                for f in futs:
+                    f.result(timeout=30)
+            assert recompile_count() == c0
+        finally:
+            srv.close()
+
+    def test_fault_degrades_one_request_not_the_server(self, journal):
+        srv = self._server(journal=journal)
+        try:
+            # deterministic Nth-hit form: exactly the 2nd data.read fails
+            faults.install_spec("data.read:io_error@2", seed=3,
+                                journal=journal, export_env=False)
+            futs = [srv.submit("toy", im) for im in images(3)]
+            with pytest.raises(FaultInjected):
+                futs[1].result(timeout=30)
+            for f in (futs[0], futs[2]):
+                assert f.result(timeout=30)["scores"].shape == (3,)
+            faults.install(None)
+            # the server keeps answering after the fault
+            assert srv.submit(
+                "toy", images(1)[0]).result(timeout=30) is not None
+        finally:
+            srv.close()
+        journal.close()
+        events = read_journal(journal.path)
+        assert any(e["event"] == "fault" and e["point"] == "data.read"
+                   for e in events)
+        outcomes = [e["outcome"] for e in events
+                    if e["event"] == "serve_request"]
+        assert outcomes.count("error") == 1
+        assert outcomes.count("ok") == 3
+        assert strict_errors(journal.path) == []
+
+    def test_bad_shape_fails_request_only(self):
+        srv = self._server()
+        try:
+            bad = srv.submit("toy", np.zeros((2, 2, 1), np.float32))
+            with pytest.raises(ServeError, match="request shape"):
+                bad.result(timeout=30)
+            ok = srv.submit("toy", images(1)[0])
+            assert ok.result(timeout=30) is not None
+        finally:
+            srv.close()
+
+    def test_cancelled_future_balances_the_books(self, journal):
+        # a client that cancels its queued Future must not poison the
+        # rest of the batch, and drain's accounting must still balance
+        srv = self._server(journal=journal, max_wait_ms=200.0)
+        try:
+            futs = [srv.submit("toy", im) for im in images(3)]
+            assert futs[1].cancel()  # still queued: cancel succeeds
+            assert futs[0].result(timeout=30) is not None
+            assert futs[2].result(timeout=30) is not None
+        finally:
+            summary = srv.close()
+        assert summary["outcome"] == "flushed"
+        assert summary["cancelled"] == 1
+        assert summary["accepted"] == summary["completed"] \
+            + summary["errors"] + summary["cancelled"]
+        journal.close()
+        outcomes = [e["outcome"] for e in read_journal(journal.path)
+                    if e["event"] == "serve_request"]
+        assert outcomes.count("cancelled") == 1
+        assert outcomes.count("ok") == 2
+        assert strict_errors(journal.path) == []
+
+    def test_submit_before_start_refused(self):
+        eng = make_engine()
+        eng.warmup()
+        srv = Server(eng)
+        with pytest.raises(ServeError, match="before start"):
+            srv.submit("toy", images(1)[0])
+        assert srv.accepted == 0
+
+    def test_unknown_model_fails_request_only(self):
+        srv = self._server()
+        try:
+            with pytest.raises(ServeError, match="unknown model"):
+                srv.submit("nope", images(1)[0]).result(timeout=30)
+        finally:
+            srv.close()
+
+    def test_drain_flushes_in_flight_futures(self, journal):
+        # a long max-wait keeps requests queued; drain must flush them
+        # immediately instead of waiting out the window
+        srv = self._server(journal=journal, max_wait_ms=60_000)
+        futs = [srv.submit("toy", im) for im in images(3)]
+        t0 = time.perf_counter()
+        summary = srv.drain("close")
+        assert time.perf_counter() - t0 < 10.0
+        assert summary["outcome"] == "flushed"
+        assert summary["completed"] == 3 and summary["pending"] == 0
+        assert all(f.done() for f in futs)
+        with pytest.raises(ServerClosed):
+            srv.submit("toy", images(1)[0])
+        # idempotent: the first drain's verdict sticks
+        assert srv.drain("close")["outcome"] == "flushed"
+
+    def test_sigterm_drain_dumps_preempt_flight_bundle(self, journal,
+                                                       tmp_path):
+        from deep_vision_tpu.obs import flight as flight_mod
+        from deep_vision_tpu.obs.flight import (
+            FlightRecorder,
+            find_bundles,
+            validate_bundle,
+        )
+
+        fr = FlightRecorder(str(tmp_path / "flight"),
+                            run_id=journal.run_id)
+        fr.attach(journal)
+        flight_mod.set_flight(fr)
+        srv = self._server(journal=journal, max_wait_ms=60_000)
+        prev = signal.getsignal(signal.SIGTERM)
+        try:
+            srv.install_sigterm()
+            futs = [srv.submit("toy", im) for im in images(2)]
+            os.kill(os.getpid(), signal.SIGTERM)
+            assert srv.wait_for_stop(timeout=10)
+            with pytest.raises(ServerClosed):
+                srv.submit("toy", images(1)[0])
+            summary = srv.drain("sigterm")
+            assert summary["outcome"] == "flushed"
+            assert all(f.result(timeout=30) is not None for f in futs)
+            bundles = find_bundles(str(tmp_path / "flight"))
+            assert len(bundles) == 1 and "preempt" in bundles[0]
+            assert validate_bundle(bundles[0]) == []
+        finally:
+            srv.uninstall_sigterm()
+            signal.signal(signal.SIGTERM, prev)
+            fr.close()
+            flight_mod.set_flight(None)
+        journal.close()
+        events = read_journal(journal.path)
+        drain = next(e for e in events if e["event"] == "serve_drain")
+        assert drain["reason"] == "sigterm"
+        assert any(e["event"] == "flight_dump" and e["reason"] == "preempt"
+                   and e["outcome"] == "written" for e in events)
+        assert strict_errors(journal.path) == []
+
+    def test_nonfinite_outputs_journal_health_event(self, journal):
+        registry = Registry()
+        eng = Engine(registry=registry, journal=journal)
+        nan_vars = {"w": jnp.full((16, 3), jnp.nan)}
+        eng.register("toy", toy_fn, nan_vars, input_shape=IMG, buckets=(1,))
+        eng.warmup()
+        srv = Server(eng, journal=journal, registry=registry,
+                     max_wait_ms=1.0, health_policy="abort")
+        srv.start()
+        try:
+            fut = srv.submit("toy", images(1)[0])
+            with pytest.raises(ServeError, match="non-finite"):
+                fut.result(timeout=30)
+        finally:
+            srv.close()
+        journal.close()
+        events = read_journal(journal.path)
+        health = [e for e in events if e["event"] == "health"]
+        assert health and health[0]["kind"] == "non_finite"
+        assert health[0]["monitor"] == "serve"
+        assert strict_errors(journal.path) == []
+
+
+# -- slo accounting ----------------------------------------------------------
+
+class TestSLO:
+    def test_report_and_render(self):
+        from deep_vision_tpu.serve import SLOTracker
+
+        slo = SLOTracker(registry=Registry(), slo_ms=50.0)
+        for ms in (5, 8, 12, 200):
+            slo.request_done("toy", ms, "ok")
+        slo.request_done("toy", 1.0, "error")
+        slo.batch_done("toy", bucket=4, size=3, queue_wait_ms=2.0,
+                       exec_ms=6.0)
+        rep = slo.report()["toy"]
+        assert rep["requests"] == 4 and rep["errors"] == 1
+        assert rep["p50_ms"] > 0
+        assert rep["occupancy_pct"] == pytest.approx(75.0)
+        assert rep["padding_waste_pct"] == pytest.approx(25.0)
+        assert rep["slo_violations"] == 1
+        text = slo.render()
+        assert "toy" in text and "occupancy 75.0%" in text
+
+
+# -- journal schema + report -------------------------------------------------
+
+class TestServeJournalSchema:
+    def test_strict_accepts_serve_events(self, tmp_path):
+        j = RunJournal(str(tmp_path / "j.jsonl"), kind="serve")
+        j.manifest()
+        j.write("serve_request", model="toy", latency_ms=3.2, outcome="ok")
+        j.write("serve_batch", model="toy", bucket=4, size=3,
+                occupancy_pct=75.0, padding_waste_pct=25.0)
+        j.write("serve_drain", reason="sigterm", outcome="flushed",
+                accepted=3, completed=3, errors=0, pending=0)
+        j.close()
+        assert strict_errors(j.path) == []
+
+    def test_strict_rejects_bad_enums_and_arithmetic(self, tmp_path):
+        path = str(tmp_path / "bad.jsonl")
+        rows = [
+            {"event": "serve_request", "ts": 1.0, "run_id": "r",
+             "model": "toy", "latency_ms": 1.0, "outcome": "maybe"},
+            {"event": "serve_batch", "ts": 1.0, "run_id": "r",
+             "model": "toy", "bucket": 2, "size": 3},
+            {"event": "serve_drain", "ts": 1.0, "run_id": "r",
+             "reason": "whim", "outcome": "flushed", "accepted": 1,
+             "completed": 1},
+            {"event": "serve_drain", "ts": 1.0, "run_id": "r",
+             "reason": "close", "outcome": "flushed"},
+            {"event": "exit", "ts": 2.0, "run_id": "r", "status": "clean"},
+        ]
+        with open(path, "w") as f:
+            for r in rows:
+                f.write(json.dumps(r) + "\n")
+        errs = strict_errors(path)
+        assert any("serve_request outcome" in e for e in errs)
+        assert any("outside [1, bucket=" in e for e in errs)
+        assert any("serve_drain reason" in e for e in errs)
+        assert any("missing field 'accepted'" in e for e in errs)
+
+    def test_obs_report_renders_serving_summary(self, tmp_path, capsys):
+        from tools.obs_report import main as report_main
+
+        j = RunJournal(str(tmp_path / "j.jsonl"), kind="serve")
+        j.manifest()
+        for ms in (2.0, 3.0, 40.0):
+            j.write("serve_request", model="toy", latency_ms=ms,
+                    outcome="ok")
+        j.write("serve_request", model="toy", latency_ms=1.0,
+                outcome="error", error="FaultInjected: boom")
+        j.write("serve_batch", model="toy", bucket=4, size=3)
+        j.write("serve_drain", reason="close", outcome="flushed",
+                accepted=4, completed=3, errors=1, pending=0)
+        j.close()
+        assert report_main([j.path]) == 0
+        out = capsys.readouterr().out
+        assert "serving toy" in out
+        assert "3 ok, 1 err" in out
+        assert "p99" in out
+        assert "occupancy 75.0%" in out
+        assert "close -> flushed" in out
+
+    def test_obs_report_without_serving_unchanged(self, tmp_path, capsys):
+        from tools.obs_report import main as report_main
+
+        j = RunJournal(str(tmp_path / "j.jsonl"))
+        j.manifest()
+        j.step(1, step_time_ms=10.0, data_wait_ms=1.0)
+        j.close()
+        assert report_main([j.path]) == 0
+        assert "serving" not in capsys.readouterr().out

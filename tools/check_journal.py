@@ -56,6 +56,9 @@ EVENT_FIELDS = {
     "profile_capture": ("reason", "outcome"),
     "flight_dump": ("reason", "dir", "outcome"),
     "straggler": ("step", "gap_ms", "host"),
+    "serve_request": ("model", "latency_ms", "outcome"),
+    "serve_batch": ("model", "bucket", "size"),
+    "serve_drain": ("reason", "outcome", "accepted", "completed"),
     "note": (),
     "exit": ("status",),
     "crash": ("reason",),
@@ -71,6 +74,9 @@ PROFILE_CAPTURE_OUTCOMES = {"started", "captured", "closed_early",
 FLIGHT_REASONS = {"crash", "hang", "health_abort", "preempt",
                   "injected_crash", "injected_crash_after_write", "manual"}
 FLIGHT_OUTCOMES = {"written", "failed"}
+SERVE_REQUEST_OUTCOMES = {"ok", "error", "rejected", "cancelled"}
+SERVE_DRAIN_REASONS = {"close", "sigterm"}
+SERVE_DRAIN_OUTCOMES = {"flushed", "timeout"}
 
 
 def check_journal(path: str, require_exit: bool = False,
@@ -141,6 +147,26 @@ def check_journal(path: str, require_exit: bool = False,
                               f"{row.get('reason')!r}")
             if row.get("outcome") not in FLIGHT_OUTCOMES:
                 errors.append(f"{path}:{i}: unknown flight_dump outcome "
+                              f"{row.get('outcome')!r}")
+        if ev == "serve_request" and \
+                row.get("outcome") not in SERVE_REQUEST_OUTCOMES:
+            errors.append(f"{path}:{i}: unknown serve_request outcome "
+                          f"{row.get('outcome')!r}")
+        if ev == "serve_batch":
+            bucket, size = row.get("bucket"), row.get("size")
+            if not isinstance(bucket, int) or not isinstance(size, int):
+                errors.append(f"{path}:{i}: serve_batch bucket/size must "
+                              f"be ints, got {bucket!r}/{size!r}")
+            elif not 1 <= size <= bucket:
+                errors.append(f"{path}:{i}: serve_batch size {size} "
+                              f"outside [1, bucket={bucket}] — padding "
+                              "arithmetic is broken")
+        if ev == "serve_drain":
+            if row.get("reason") not in SERVE_DRAIN_REASONS:
+                errors.append(f"{path}:{i}: unknown serve_drain reason "
+                              f"{row.get('reason')!r}")
+            if row.get("outcome") not in SERVE_DRAIN_OUTCOMES:
+                errors.append(f"{path}:{i}: unknown serve_drain outcome "
                               f"{row.get('outcome')!r}")
         if ev == "straggler":
             if not isinstance(row.get("host"), int):

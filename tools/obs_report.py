@@ -87,6 +87,9 @@ def summarize_run(events: List[dict]) -> dict:
     out["checkpoints"] = sum(
         1 for e in events if e.get("event") == "checkpoint" and e.get("saved"))
     out["benches"] = [e for e in events if e.get("event") == "bench"]
+    serving = summarize_serving(events)
+    if serving:
+        out["serving"] = serving
     terminal = next(
         (e for e in reversed(events) if e.get("event") in ("exit", "crash")),
         None)
@@ -99,6 +102,68 @@ def summarize_run(events: List[dict]) -> dict:
     first, last = events[0].get("ts"), events[-1].get("ts")
     if first is not None and last is not None:
         out["wall_s"] = float(last) - float(first)
+    return out
+
+
+def summarize_serving(events: List[dict]) -> Optional[dict]:
+    """Collapse serve_* events (serve/router.py) into per-model serving
+    rows: request counts, latency tail quantiles recomputed from the
+    per-request events (exact, unlike the registry's bucket-resolution
+    quantiles), batch occupancy and padding waste from the serve_batch
+    aggregates, and the drain verdict. None when the journal carries no
+    serving traffic — training-only reports stay unchanged."""
+    requests = [e for e in events if e.get("event") == "serve_request"]
+    batches = [e for e in events if e.get("event") == "serve_batch"]
+    drains = [e for e in events if e.get("event") == "serve_drain"]
+    if not (requests or batches or drains):
+        return None
+    models: Dict[str, dict] = {}
+
+    def row_for(e):
+        return models.setdefault(
+            e.get("model", "?"),
+            {"ok": 0, "error": 0, "rejected": 0, "cancelled": 0,
+             "latencies": [], "slots": 0, "padded": 0, "batches": 0})
+
+    for e in requests:
+        m = row_for(e)
+        outcome = e.get("outcome")
+        # unknown outcomes (future producer / corrupt row) count as
+        # errors rather than crashing the postmortem report — the strict
+        # enum lives in check_journal, not here
+        m[outcome if outcome in ("ok", "error", "rejected", "cancelled")
+          else "error"] += 1
+        if outcome == "ok" and isinstance(e.get("latency_ms"), (int, float)):
+            m["latencies"].append(float(e["latency_ms"]))
+    for e in batches:
+        m = row_for(e)
+        bucket, size = e.get("bucket"), e.get("size")
+        if not isinstance(bucket, int) or not isinstance(size, int):
+            continue  # corrupt/foreign row: never crash the postmortem
+        m["batches"] += 1
+        m["slots"] += bucket
+        m["padded"] += max(0, bucket - size)
+    out: dict = {"models": {}}
+    for name, m in sorted(models.items()):
+        row = {"ok": m["ok"], "error": m["error"], "rejected": m["rejected"],
+               "cancelled": m["cancelled"], "batches": m["batches"]}
+        if m["latencies"]:
+            row.update(
+                p50_ms=_percentile(m["latencies"], 0.5),
+                p95_ms=_percentile(m["latencies"], 0.95),
+                p99_ms=_percentile(m["latencies"], 0.99),
+                mean_ms=sum(m["latencies"]) / len(m["latencies"]),
+            )
+        if m["slots"]:
+            row["occupancy_pct"] = 100.0 * (m["slots"] - m["padded"]) \
+                / m["slots"]
+            row["padding_waste_pct"] = 100.0 * m["padded"] / m["slots"]
+        out["models"][name] = row
+    if drains:
+        last = drains[-1]
+        out["drain"] = {k: last.get(k) for k in
+                        ("reason", "outcome", "accepted", "completed",
+                         "errors", "cancelled", "pending")}
     return out
 
 
@@ -145,6 +210,37 @@ def render(summary: dict) -> str:
         parts = " ".join(f"{k}={v}" for k, v in res.items()
                          if isinstance(v, (int, float)))
         rows.append((f"bench {e.get('name')}", parts))
+    # serving summary (serve/router.py journal events): one row per
+    # model, then the drain verdict — the SLO table without a live
+    # registry endpoint
+    serving = summary.get("serving")
+    if serving:
+        for name, r in serving["models"].items():
+            parts = f"{r['ok']} ok, {r['error']} err"
+            if r.get("rejected"):
+                parts += f", {r['rejected']} rejected"
+            if r.get("cancelled"):
+                parts += f", {r['cancelled']} cancelled"
+            if "p50_ms" in r:
+                parts += (f"  latency p50 {r['p50_ms']:.2f}ms "
+                          f"p95 {r['p95_ms']:.2f}ms "
+                          f"p99 {r['p99_ms']:.2f}ms")
+            if r.get("batches"):
+                parts += f"  batches {r['batches']}"
+            if "occupancy_pct" in r:
+                parts += (f"  occupancy {r['occupancy_pct']:.1f}%"
+                          f"  padding waste {r['padding_waste_pct']:.1f}%")
+            rows.append((f"serving {name}", parts))
+        drain = serving.get("drain")
+        if drain:
+            parts = (f"accepted={drain.get('accepted')} "
+                     f"completed={drain.get('completed')} "
+                     f"errors={drain.get('errors')}")
+            if drain.get("cancelled"):
+                parts += f" cancelled={drain['cancelled']}"
+            rows.append(("serve drain",
+                         f"{drain.get('reason')} -> {drain.get('outcome')} "
+                         f"({parts} pending={drain.get('pending')})"))
     # profiler captures: every decision the autoprof policy made, so the
     # table answers "why does this run have three trace dirs" directly
     for e in summary.get("captures", []):

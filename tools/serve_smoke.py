@@ -1,0 +1,365 @@
+"""Serve smoke: a real multi-model CPU server proving the serving contracts.
+
+    PYTHONPATH=. JAX_PLATFORMS=cpu python tools/serve_smoke.py \
+        [--workdir artifacts/serve_smoke]
+
+The CI teeth behind serve/ (`make serve-smoke`, a `make verify`
+prerequisite), the way obs-smoke gates obs/ and chaos-smoke gates
+resilience/. One in-process server routes the REAL YOLO + Hourglass-pose
+predictors (64x64, tiny heads) over one CPU device, then a subprocess
+proves the preemption path:
+
+  1. warmup       every (model, bucket) pair AOT-compiles at startup;
+                  the backend-compile counter delta must equal the
+                  warmed pair count exactly (nothing eager slipped in).
+  2. mixed load   bursts of 1..4 concurrent requests per model — every
+                  batch rounds to a warmed bucket, every response checks
+                  out, and the recompile counter must not move AT ALL.
+  3. chaos        `data.read:io_error@N` injected at the request-decode
+                  boundary: exactly one request fails with the injected
+                  error, everyone else (including requests submitted
+                  after) is served — request-scoped degradation.
+  4. clean close  drain journals `serve_drain(close, flushed)`, the
+                  journal passes `check_journal --strict` (serve_*
+                  schemas + trace), obs_report renders the serving
+                  summary, and the flight dir is EMPTY — a healthy
+                  shutdown leaves no postmortem.
+  5. sigterm      a child server under live traffic gets SIGTERM: it
+                  must flush every accepted request, journal
+                  `serve_drain(sigterm, flushed)`, leave a crc-valid
+                  `preempt` flight bundle, and exit 0 with a clean
+                  journal terminal event.
+
+Exit status 0 = every contract held; 1 = something broke.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import threading
+import time
+from typing import List, Optional
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+INPUT_SHAPE = (64, 64, 3)
+YOLO_BUCKETS = (1, 2, 4)
+POSE_BUCKETS = (1, 2, 4)
+MAX_WAIT_MS = 15.0
+
+
+class Failures:
+    def __init__(self):
+        self.errors: List[str] = []
+
+    def check(self, ok: bool, what: str) -> bool:
+        print(("  ok  " if ok else "  FAIL") + f"  {what}")
+        if not ok:
+            self.errors.append(what)
+        return ok
+
+
+def read_jsonl(path: str) -> List[dict]:
+    if not os.path.exists(path):
+        return []
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                try:
+                    out.append(json.loads(line))
+                except json.JSONDecodeError:
+                    pass
+    return out
+
+
+def check_journal_strict(path: str, trace: Optional[str] = None) -> bool:
+    cmd = [sys.executable, os.path.join(ROOT, "tools", "check_journal.py"),
+           path, "--strict"]
+    if trace:
+        cmd += ["--trace", trace]
+    return subprocess.run(
+        cmd, cwd=ROOT, env=dict(os.environ, PYTHONPATH=ROOT),
+    ).returncode == 0
+
+
+def build_models(models=("yolo", "pose")):
+    """Tiny real predictors: the zoo's YOLO decode->NMS and Hourglass
+    keypoint paths at 64x64 — real enough that a recompile would show."""
+    import jax
+    import jax.numpy as jnp
+
+    from deep_vision_tpu.inference import pose_predict_fn, yolo_predict_fn
+    from deep_vision_tpu.models import get_model
+
+    x = jnp.zeros((1,) + INPUT_SHAPE, jnp.float32)
+    out = {}
+    if "yolo" in models:
+        m = get_model("yolov3", num_classes=4)
+        out["yolo"] = (
+            yolo_predict_fn(m, max_detections=8, score_threshold=0.3),
+            m.init(jax.random.PRNGKey(0), x, train=False), YOLO_BUCKETS)
+    if "pose" in models:
+        m = get_model("hourglass", num_stack=1, num_heatmap=4)
+        out["pose"] = (
+            pose_predict_fn(m),
+            m.init(jax.random.PRNGKey(1), x, train=False), POSE_BUCKETS)
+    return out
+
+
+def rand_image(rng):
+    return rng.rand(*INPUT_SHAPE).astype("float32")
+
+
+# -- child: the SIGTERM-drain server ------------------------------------------
+
+def child_main(argv: List[str]) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--workdir", required=True)
+    args = p.parse_args(argv)
+    import numpy as np
+
+    from deep_vision_tpu.obs import FlightRecorder, RunJournal, set_flight
+    from deep_vision_tpu.serve import Engine, Server, ServerClosed
+
+    work = args.workdir
+    journal = RunJournal(os.path.join(work, "journal_sigterm.jsonl"),
+                         kind="serve")
+    journal.manifest(config={"name": "serve_smoke_sigterm",
+                             "task": "serving"})
+    flight = FlightRecorder(os.path.join(work, "flight_sigterm"),
+                            run_id=journal.run_id)
+    flight.attach(journal)
+    set_flight(flight)
+
+    engine = Engine(journal=journal)
+    for name, (fn, variables, buckets) in build_models(("pose",)).items():
+        engine.register(name, fn, variables, INPUT_SHAPE, buckets=(1, 2))
+    engine.warmup()
+    server = Server(engine, journal=journal, max_wait_ms=MAX_WAIT_MS)
+    server.start()
+    server.install_sigterm()
+
+    def traffic():
+        rng = np.random.RandomState(7)
+        while True:
+            try:
+                server.submit("pose", rand_image(rng))
+            except ServerClosed:
+                return
+            time.sleep(0.05)
+
+    t = threading.Thread(target=traffic, name="traffic", daemon=True)
+    t.start()
+    print("READY", flush=True)  # the parent sends SIGTERM after this
+    server.wait_for_stop()
+    summary = server.drain("sigterm")
+    t.join(timeout=5)
+    flight.close()  # disarm the crash dump; the preempt bundle stays
+    journal.close()
+    print(f"drained: {summary}", flush=True)
+    return 0 if summary["outcome"] == "flushed" else 1
+
+
+# -- parent: phases 1-4 in process, phase 5 via the child ---------------------
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if argv and argv[0] == "--child":
+        return child_main(argv[1:])
+
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--workdir", default="artifacts/serve_smoke")
+    args = p.parse_args(argv)
+
+    import numpy as np
+
+    from deep_vision_tpu.obs import (
+        FlightRecorder,
+        RunJournal,
+        Tracer,
+        set_flight,
+        set_tracer,
+    )
+    from deep_vision_tpu.obs.stepclock import recompile_count
+    from deep_vision_tpu.resilience import FaultInjected, faults
+    from deep_vision_tpu.serve import Engine, Server
+
+    work = os.path.abspath(args.workdir)
+    shutil.rmtree(work, ignore_errors=True)
+    os.makedirs(work)
+    f = Failures()
+    j_path = os.path.join(work, "journal.jsonl")
+    t_path = os.path.join(work, "trace.json")
+    flight_dir = os.path.join(work, "flight")
+
+    journal = RunJournal(j_path, kind="serve")
+    journal.manifest(config={"name": "serve_smoke", "task": "serving"})
+    tracer = Tracer(t_path, run_id=journal.run_id)
+    set_tracer(tracer)
+    flight = FlightRecorder(flight_dir, run_id=journal.run_id)
+    flight.attach(journal)
+    set_flight(flight)
+
+    # -- phase 1: AOT warmup, compile accounting ------------------------
+    print("phase 1: AOT warmup compiles every (model, bucket) pair")
+    models = build_models()
+    engine = Engine(journal=journal)
+    for name, (fn, variables, buckets) in models.items():
+        engine.register(name, fn, variables, INPUT_SHAPE, buckets=buckets)
+    stats = engine.warmup()
+    pairs = len(YOLO_BUCKETS) + len(POSE_BUCKETS)
+    f.check(stats["pairs"] == pairs,
+            f"warmed {stats['pairs']}/{pairs} (model, bucket) pairs")
+    f.check(stats["backend_compiles"] == pairs,
+            f"recompile counter delta equals the warmed bucket count "
+            f"({stats['backend_compiles']} == {pairs})")
+
+    server = Server(engine, journal=journal, max_wait_ms=MAX_WAIT_MS)
+    server.start()
+    rng = np.random.RandomState(0)
+
+    # -- phase 2: mixed-size stream, zero additional compiles -----------
+    print("phase 2: mixed-size request stream after warmup")
+    c0 = recompile_count()
+    ok = 0
+    for burst in (1, 3, 2, 4, 1, 2, 4, 3):
+        futs = [(model, server.submit(model, rand_image(rng)))
+                for model in ("yolo", "pose") for _ in range(burst)]
+        for model, fut in futs:
+            row = fut.result(timeout=120)
+            if model == "yolo":
+                assert row["boxes"].shape == (8, 4), row["boxes"].shape
+            else:
+                assert row.shape == (4, 3), row.shape
+            ok += 1
+    f.check(ok == 2 * (1 + 3 + 2 + 4 + 1 + 2 + 4 + 3),
+            f"all {ok} mixed-size requests answered with correct shapes")
+    f.check(recompile_count() == c0,
+            "zero additional compilations across the mixed-size stream")
+
+    # -- phase 3: injected data.read fault degrades one request ---------
+    print("phase 3: injected data.read fault is request-scoped")
+    faults.install_spec("data.read:io_error@2", seed=11, journal=journal,
+                        export_env=False)
+    futs = [server.submit("yolo", rand_image(rng)) for _ in range(3)]
+    outcomes = []
+    for fut in futs:
+        try:
+            fut.result(timeout=120)
+            outcomes.append("ok")
+        except FaultInjected:
+            outcomes.append("fault")
+    faults.install(None)
+    f.check(outcomes.count("fault") == 1 and outcomes.count("ok") == 2,
+            f"exactly the injected request failed ({outcomes})")
+    after = server.submit("pose", rand_image(rng)).result(timeout=120)
+    f.check(after.shape == (4, 3),
+            "server keeps answering after the injected fault")
+
+    # -- phase 4: clean close leaves no postmortem ----------------------
+    print("phase 4: clean shutdown — strict journal, no flight bundle")
+    summary = server.close()
+    f.check(summary["outcome"] == "flushed" and summary["pending"] == 0,
+            f"close drained everything ({summary})")
+    print("  " + server.slo.render().replace("\n", "\n  "))
+    tracer.close()
+    set_tracer(None)
+    flight.close()
+    set_flight(None)
+    journal.close()
+    f.check(not os.listdir(flight_dir) if os.path.isdir(flight_dir)
+            else True, "clean shutdown left no flight bundle")
+    f.check(check_journal_strict(j_path, trace=t_path),
+            "check_journal --strict accepts journal + trace "
+            "(serve_* schemas)")
+    rep = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "obs_report.py"),
+         j_path],
+        cwd=ROOT, env=dict(os.environ, PYTHONPATH=ROOT),
+        stdout=subprocess.PIPE, text=True)
+    f.check(rep.returncode == 0 and "serving yolo" in rep.stdout
+            and "serve drain" in rep.stdout,
+            "obs_report renders the serving summary")
+    ev = read_jsonl(j_path)
+    spans = {e.get("name") for e in
+             (json.load(open(t_path)).get("traceEvents") or [])}
+    f.check({"serve/warmup", "serve/batch", "serve/drain"} <= spans,
+            f"serve/* trace spans recorded ({sorted(s for s in spans if str(s).startswith('serve'))})")
+    f.check(any(e.get("event") == "serve_batch"
+                and e.get("size", 0) < e.get("bucket", 0) for e in ev),
+            "padding observed and journaled (occupancy < 100% somewhere)")
+
+    # -- phase 5: SIGTERM drain in a child server -----------------------
+    print("phase 5: SIGTERM drain flushes in-flight requests + dumps "
+          "a preempt flight bundle")
+    log_path = os.path.join(work, "sigterm_child.log")
+    env = dict(os.environ, PYTHONPATH=ROOT, JAX_PLATFORMS="cpu")
+    env.pop("DVT_FAULT_SPEC", None)
+    env.pop("DVT_FAULT_SEED", None)
+    with open(log_path, "w") as log:
+        proc = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--child",
+             "--workdir", work],
+            cwd=ROOT, env=env, stdout=subprocess.PIPE,
+            stderr=log, text=True)
+        ready = proc.stdout.readline().strip()
+        f.check(ready == "READY", f"child server came up ({ready!r})")
+        time.sleep(1.5)  # let live traffic flow
+        proc.send_signal(signal.SIGTERM)
+        try:
+            out, _ = proc.communicate(timeout=120)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            out, _ = proc.communicate()
+        log.write(out or "")
+    f.check(proc.returncode == 0,
+            f"child drained and exited cleanly (rc={proc.returncode})")
+
+    jc = os.path.join(work, "journal_sigterm.jsonl")
+    ev = read_jsonl(jc)
+    drains = [e for e in ev if e.get("event") == "serve_drain"]
+    f.check(len(drains) == 1 and drains[0].get("reason") == "sigterm"
+            and drains[0].get("outcome") == "flushed",
+            f"serve_drain journaled sigterm/flushed ({drains})")
+    if drains:
+        d = drains[0]
+        f.check(d.get("accepted", -1) >= 1
+                and d.get("accepted") == d.get("completed", 0)
+                + d.get("errors", 0) + d.get("cancelled", 0),
+                f"every accepted request accounted for "
+                f"(accepted={d.get('accepted')} "
+                f"completed={d.get('completed')})")
+    f.check(any(e.get("event") == "flight_dump"
+                and e.get("reason") == "preempt"
+                and e.get("outcome") == "written" for e in ev),
+            "journal carries the preempt flight_dump event")
+    from deep_vision_tpu.obs.flight import find_bundles, validate_bundle
+
+    bundles = find_bundles(os.path.join(work, "flight_sigterm"))
+    f.check(len(bundles) == 1 and "preempt" in os.path.basename(bundles[0]),
+            f"SIGTERM left exactly one preempt bundle ({bundles})")
+    if bundles:
+        errs = validate_bundle(bundles[0])
+        f.check(not errs, "preempt bundle structure + crc valid"
+                + ("" if not errs else f" ({errs[0]})"))
+    f.check(check_journal_strict(jc),
+            "check_journal --strict accepts the sigterm journal")
+
+    if f.errors:
+        print(f"\nserve-smoke: {len(f.errors)} contract(s) BROKEN "
+              f"(artifacts in {work})")
+        return 1
+    print(f"\nserve-smoke: all serving contracts held (artifacts in {work})")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
