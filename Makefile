@@ -35,7 +35,8 @@ test:
 	python -m pytest tests/ -x -q
 
 # static analysis (lint/): the review-time teeth behind the obs/ runtime
-# signals — fails on any non-baselined DV001-DV005 finding. Runs first in
+# signals — fails on any non-baselined DV001-DV007 (JAX/TPU contracts) or
+# DV101-DV104 (concurrency pack, lint/concur.py) finding. Runs first in
 # verify: it is the cheapest gate (~3s, no jax import of the hot paths)
 lint:
 	python -m deep_vision_tpu.lint
@@ -81,14 +82,19 @@ obs-smoke:
 # compilations, injected data.read faults degrade single requests,
 # clean shutdown passes check_journal --strict with no flight bundle,
 # and a SIGTERM'd child flushes all accepted requests and leaves a
-# crc-valid preempt bundle (tools/serve_smoke.py)
+# crc-valid preempt bundle (tools/serve_smoke.py). The locksmith lock
+# sanitizer (obs/locksmith.py) is armed throughout and must report
+# zero lock_order_violation events
 serve-smoke:
 	JAX_PLATFORMS=cpu python tools/serve_smoke.py --workdir artifacts/serve_smoke
 
 # resilience smoke: a record-backed CPU train under injected faults
 # (skipped bad records within budget, SIGKILL mid-checkpoint-save,
 # quarantine-and-fall-back resume), journals validated --strict, plus a
-# no-fault overhead probe on the injection points (tools/chaos_run.py)
+# no-fault overhead probe on the injection points (tools/chaos_run.py).
+# Children run with DVT_LOCKSMITH=1 (zero violations asserted), a forced
+# A->B/B->A inversion must be detected at runtime, and the disabled
+# locksmith wrapper is overhead-probed
 chaos-smoke:
 	JAX_PLATFORMS=cpu python tools/chaos_run.py --workdir artifacts/chaos_smoke
 

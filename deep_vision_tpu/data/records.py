@@ -29,12 +29,12 @@ import os
 import random
 import struct
 import sys
-import threading
 import time
 from typing import Iterable, Iterator, List, Optional, Sequence, Tuple, Union
 
 import google_crc32c
 
+from deep_vision_tpu.obs import locksmith
 from deep_vision_tpu.resilience import RetryPolicy, faults
 
 _MASK_DELTA = 0xA282EAD8
@@ -147,7 +147,7 @@ class BadRecordBudget:
         self.journal = journal
         self.bad = 0
         self.ok = 0
-        self._lock = threading.Lock()
+        self._lock = locksmith.lock("data.records.budget")
 
     @classmethod
     def parse(cls, spec: str, **kw) -> "BadRecordBudget":
@@ -167,7 +167,7 @@ class BadRecordBudget:
 
     def __setstate__(self, d):
         self.__dict__.update(d)
-        self._lock = threading.Lock()
+        self._lock = locksmith.lock("data.records.budget")
 
     def describe(self) -> str:
         parts = []

@@ -4,8 +4,12 @@ The review-time teeth behind the obs/ runtime telemetry: an AST-based
 rule engine (stdlib `ast`, no dependencies) that enforces the
 performance and correctness contracts the hot paths rely on — no host
 syncs or impurity inside jit, no reused PRNG keys, donated train-step
-state, no jit-in-loop recompiles. Run as `python -m deep_vision_tpu.lint`
-or `make lint`; see lint/README.md for the rule catalog.
+state, no jit-in-loop recompiles (DV001-DV007), plus the DV1xx
+concurrency pack (lint/concur.py): thread-shared state without a lock,
+lock-order inversions, signal-unsafe handlers, Future-protocol misuse.
+Run as `python -m deep_vision_tpu.lint` or `make lint`; see
+lint/README.md for the rule catalog and obs/locksmith.py for the
+runtime half of the concurrency contracts.
 """
 from deep_vision_tpu.lint.engine import (
     lint_paths,

@@ -1,0 +1,675 @@
+"""concurlint: the DV1xx concurrency rule pack (thread-safety analysis).
+
+jaxlint's DV001-DV007 gate the JAX/TPU contracts; this pack gates the
+repo's SECOND failure domain — the threading that grew around the
+serving/observability stack (serve router + queue dispatchers, flight
+recorder taps, health watchdog, data workers, preemption handlers).
+The codes encode, mechanically, the exact bug classes the PR 5/6 review
+logs caught by hand:
+
+  DV101 shared-mutable-state   an attribute written both from a thread
+        target (threading.Thread / executor.submit) and from another
+        method without a common `with self._lock:` guard — per-class
+        lock-domain inference over `ast`.
+  DV102 lock-order inversion   the static lock-order graph built from
+        nested `with lockA: with lockB:` scopes (including across call
+        edges between functions/methods of the module) contains a
+        cycle — the lockdep check, at review time.
+  DV103 signal-unsafe handler  a blocking call (lock acquire, journal
+        write, Future.result, queue put/get, thread join, flight dump)
+        reachable from a `signal.signal` handler — the PR 5 bug: a
+        SIGTERM handler dumping a flight bundle can self-deadlock on
+        the journal/recorder locks the interrupted thread holds.
+  DV104 future-protocol misuse set_result/set_exception on a Future the
+        scope did not create, without set_running_or_notify_cancel —
+        the PR 6 bug: a client-cancelled Future raises
+        InvalidStateError and fails the rest of its batch.
+
+Analysis is per-module and name-based, like the rest of jaxlint: lock
+identity is `Class.attr` (or a module-global name), call edges are
+followed for `self.method()` and bare module-function calls only.
+Cross-module lock orders (journal lock vs flight lock vs device lock at
+runtime) are the *dynamic* residue this pack deliberately leaves to
+obs/locksmith.py, the runtime sanitizer armed in serve-smoke and
+chaos-smoke. See lint/README.md for the catalog with fix recipes.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from deep_vision_tpu.lint.findings import Finding
+from deep_vision_tpu.lint.jitctx import last_name, root_name
+
+# factories whose result is a mutual-exclusion object: stdlib threading
+# plus the obs/locksmith instrumented wrappers the repo adopts
+_LOCK_FACTORIES = {"Lock", "RLock", "Condition", "Semaphore",
+                   "BoundedSemaphore", "lock", "rlock", "condition"}
+_REENTRANT_FACTORIES = {"RLock", "rlock"}
+_QUEUE_FACTORIES = {"Queue", "SimpleQueue", "LifoQueue", "PriorityQueue"}
+
+
+def _finding(ctx, code: str, node: ast.AST, message: str,
+             severity: str = "error") -> Finding:
+    return Finding(
+        code=code,
+        message=message,
+        path=ctx.relpath,
+        line=getattr(node, "lineno", 0),
+        col=getattr(node, "col_offset", 0) + 1,
+        severity=severity,
+        symbol=ctx.symbol_at(node),
+    )
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    """'x' for `self.x` / `cls.x`, else None."""
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and \
+            node.value.id in ("self", "cls"):
+        return node.attr
+    return None
+
+
+def _is_lock_factory(value: ast.AST) -> Optional[str]:
+    """Factory name when `value` constructs a lock-like object
+    (threading.Lock(), locksmith.lock("...")), else None."""
+    if isinstance(value, ast.Call):
+        name = last_name(value.func)
+        if name in _LOCK_FACTORIES:
+            return name
+    return None
+
+
+class _ClassInfo:
+    """Per-class lock domain: methods, lock attrs, thread entry points."""
+
+    def __init__(self, node: ast.ClassDef):
+        self.node = node
+        self.methods: Dict[str, ast.AST] = {}
+        for child in node.body:
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.methods[child.name] = child
+        # attrs assigned a lock factory anywhere in the class, + their
+        # reentrancy (RLock nests legally, Lock does not)
+        self.lock_attrs: Dict[str, bool] = {}
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Assign):
+                fac = _is_lock_factory(sub.value)
+                if fac is None:
+                    continue
+                for t in sub.targets:
+                    attr = _self_attr(t)
+                    if attr is not None:
+                        self.lock_attrs[attr] = fac in _REENTRANT_FACTORIES
+        # attrs assigned queue.Queue()-likes (for DV103's queue-op check)
+        self.queue_attrs: Set[str] = set()
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Assign) and \
+                    isinstance(sub.value, ast.Call) and \
+                    last_name(sub.value.func) in _QUEUE_FACTORIES:
+                for t in sub.targets:
+                    attr = _self_attr(t)
+                    if attr is not None:
+                        self.queue_attrs.add(attr)
+        self.thread_entries = self._thread_entries()
+
+    def _thread_entries(self) -> Set[str]:
+        """Method names handed to `threading.Thread(target=self.m)` or
+        `executor.submit(self.m, ...)` anywhere in the class — the roots
+        of the concurrent lock domain. Only defined methods count: an
+        attribute like `self.transform` (a user callback) is not ours to
+        analyze."""
+        out: Set[str] = set()
+        for sub in ast.walk(self.node):
+            if not isinstance(sub, ast.Call):
+                continue
+            fname = last_name(sub.func)
+            if fname == "Thread":
+                for kw in sub.keywords:
+                    if kw.arg == "target":
+                        attr = _self_attr(kw.value)
+                        if attr in self.methods:
+                            out.add(attr)
+            elif fname == "submit" and isinstance(sub.func, ast.Attribute) \
+                    and sub.args:
+                attr = _self_attr(sub.args[0])
+                if attr in self.methods:
+                    out.add(attr)
+        return out
+
+    def reachable(self, roots: Set[str]) -> Set[str]:
+        """Closure of `roots` over same-class `self.m()` calls."""
+        seen = set(roots)
+        frontier = list(roots)
+        while frontier:
+            m = frontier.pop()
+            fn = self.methods.get(m)
+            if fn is None:
+                continue
+            for sub in ast.walk(fn):
+                if isinstance(sub, ast.Call):
+                    callee = _self_attr(sub.func)
+                    if callee in self.methods and callee not in seen:
+                        seen.add(callee)
+                        frontier.append(callee)
+        return seen
+
+
+def _module_locks(tree: ast.Module) -> Dict[str, bool]:
+    """Module-global `NAME = threading.Lock()` style locks -> reentrant?"""
+    out: Dict[str, bool] = {}
+    for node in tree.body:
+        if isinstance(node, ast.Assign):
+            fac = _is_lock_factory(node.value)
+            if fac is None:
+                continue
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    out[t.id] = fac in _REENTRANT_FACTORIES
+    return out
+
+
+def _classes(tree: ast.Module) -> List[_ClassInfo]:
+    return [_ClassInfo(n) for n in ast.walk(tree)
+            if isinstance(n, ast.ClassDef)]
+
+
+def _guards_of(node: ast.AST, parents: Dict[int, ast.AST],
+               fn: ast.AST) -> Set[str]:
+    """Lock guards held at `node` within `fn`: the attr/name of every
+    enclosing `with self.X:` / `with NAME:` item. Generous on purpose —
+    any with-context over a bare self-attr or name counts as a guard, so
+    an unrecognized lock factory never produces a false positive."""
+    guards: Set[str] = set()
+    cur = node
+    while id(cur) in parents and cur is not fn:
+        parent = parents[id(cur)]
+        if isinstance(parent, (ast.With, ast.AsyncWith)) and \
+                cur in parent.body:
+            for item in parent.items:
+                attr = _self_attr(item.context_expr)
+                if attr is not None:
+                    guards.add("self." + attr)
+                elif isinstance(item.context_expr, ast.Name):
+                    guards.add(item.context_expr.id)
+        cur = parent
+    return guards
+
+
+def _parent_map(root: ast.AST) -> Dict[int, ast.AST]:
+    parents: Dict[int, ast.AST] = {}
+    for parent in ast.walk(root):
+        for child in ast.iter_child_nodes(parent):
+            parents[id(child)] = parent
+    return parents
+
+
+# -- DV101 shared-mutable-state ----------------------------------------------
+
+def check_dv101(ctx) -> List[Finding]:
+    """A self-attribute written both from a thread target and from another
+    method without a common lock guard."""
+    out: List[Finding] = []
+    for cls in _classes(ctx.tree):
+        if not cls.thread_entries:
+            continue
+        thread_methods = cls.reachable(cls.thread_entries)
+        # attr -> list of (method, guards, node, in_thread_domain)
+        writes: Dict[str, List[Tuple[str, Set[str], ast.AST, bool]]] = {}
+        for mname, fn in cls.methods.items():
+            if mname in ("__init__", "__new__"):
+                continue  # construction happens-before every thread start
+            parents = _parent_map(fn)
+            for sub in ast.walk(fn):
+                if isinstance(sub, (ast.Assign, ast.AugAssign,
+                                    ast.AnnAssign)):
+                    targets = (sub.targets if isinstance(sub, ast.Assign)
+                               else [sub.target])
+                    for t in targets:
+                        attr = _self_attr(t)
+                        if attr is None or attr in cls.lock_attrs:
+                            continue
+                        writes.setdefault(attr, []).append(
+                            (mname, _guards_of(sub, parents, fn), sub,
+                             mname in thread_methods))
+        for attr, events in sorted(writes.items()):
+            threaded = [e for e in events if e[3]]
+            external = [e for e in events if not e[3]]
+            if not threaded or not external:
+                continue
+            for t_m, t_g, t_node, _ in threaded:
+                for x_m, x_g, x_node, _ in external:
+                    if t_g & x_g:
+                        continue
+                    # flag the unguarded side (the usual fix site); when
+                    # both hold disjoint locks, flag the thread-side write
+                    node = (x_node if not x_g and t_g else t_node)
+                    out.append(_finding(
+                        ctx, "DV101", node,
+                        f"attribute 'self.{attr}' is written from thread "
+                        f"target '{t_m}' and from '{x_m}' without a common "
+                        "lock guard: a torn/raced write under free-running "
+                        "threads; guard both writes with one `with "
+                        "self._lock:`"))
+                    break  # one finding per (threaded write, attr)
+                else:
+                    continue
+                break  # one finding per attr keeps the report readable
+    return out
+
+
+# -- DV102 lock-order inversion ----------------------------------------------
+
+class _FnLocks:
+    """Per-function lock behavior: direct nesting edges, the set of locks
+    it may acquire, and the calls it makes while holding locks."""
+
+    def __init__(self):
+        self.edges: List[Tuple[str, str, ast.AST]] = []
+        self.acquires: Set[str] = set()
+        self.acquire_nodes: Dict[str, ast.AST] = {}
+        # (held-locks frozenset, callee key, call node)
+        self.calls: List[Tuple[frozenset, str, ast.AST]] = []
+
+
+def _lock_key(expr: ast.AST, cls: Optional[_ClassInfo],
+              module_locks: Dict[str, bool]) -> Optional[Tuple[str, bool]]:
+    """(graph key, reentrant) for a with-item that is a known lock."""
+    attr = _self_attr(expr)
+    if attr is not None and cls is not None and attr in cls.lock_attrs:
+        return f"{cls.node.name}.{attr}", cls.lock_attrs[attr]
+    if isinstance(expr, ast.Name) and expr.id in module_locks:
+        return expr.id, module_locks[expr.id]
+    return None
+
+
+def _scan_fn_locks(fn: ast.AST, cls: Optional[_ClassInfo],
+                   module_locks: Dict[str, bool],
+                   module_fns: Set[str]) -> _FnLocks:
+    info = _FnLocks()
+
+    def rec(node: ast.AST, held: List[Tuple[str, bool]]):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda, ast.ClassDef)):
+                continue  # nested defs run on their own call stack
+            if isinstance(child, (ast.With, ast.AsyncWith)):
+                acquired: List[Tuple[str, bool]] = []
+                for item in child.items:
+                    key = _lock_key(item.context_expr, cls, module_locks)
+                    if key is None:
+                        continue
+                    name, reentrant = key
+                    info.acquires.add(name)
+                    info.acquire_nodes.setdefault(name, item.context_expr)
+                    held_names = [h for h, _ in held + acquired]
+                    if name in held_names and not reentrant:
+                        # self-cycle: nested acquisition of one
+                        # non-reentrant lock deadlocks unconditionally
+                        info.edges.append((name, name, item.context_expr))
+                    else:
+                        for h in held_names:
+                            if h != name:
+                                info.edges.append((h, name,
+                                                   item.context_expr))
+                    acquired.append((name, reentrant))
+                rec(child, held + acquired)
+                continue
+            if isinstance(child, ast.Call) and held:
+                callee = None
+                attr = _self_attr(child.func)
+                if attr is not None and cls is not None and \
+                        attr in cls.methods:
+                    callee = f"{cls.node.name}.{attr}"
+                elif isinstance(child.func, ast.Name) and \
+                        child.func.id in module_fns:
+                    callee = child.func.id
+                if callee is not None:
+                    info.calls.append((
+                        frozenset(h for h, _ in held), callee, child))
+            rec(child, held)
+
+    rec(fn, [])
+    return info
+
+
+def check_dv102(ctx) -> List[Finding]:
+    """Cycle in the module's static lock-order graph (nested with-scopes,
+    propagated across intra-module call edges)."""
+    module_locks = _module_locks(ctx.tree)
+    classes = _classes(ctx.tree)
+    has_class_locks = any(c.lock_attrs for c in classes)
+    if not module_locks and not has_class_locks:
+        return []
+    module_fns = {n.name for n in ctx.tree.body
+                  if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+
+    fn_infos: Dict[str, _FnLocks] = {}
+    for cls in classes:
+        for mname, fn in cls.methods.items():
+            fn_infos[f"{cls.node.name}.{mname}"] = _scan_fn_locks(
+                fn, cls, module_locks, module_fns)
+    for node in ctx.tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            fn_infos[node.name] = _scan_fn_locks(
+                node, None, module_locks, module_fns)
+
+    # transitive acquire sets (fixed point over the intra-module call graph)
+    changed = True
+    while changed:
+        changed = False
+        for info in fn_infos.values():
+            for _, callee, _ in info.calls:
+                target = fn_infos.get(callee)
+                if target and not target.acquires <= info.acquires:
+                    info.acquires |= target.acquires
+                    changed = True
+
+    # edge set: direct nesting + (held -> everything a callee may acquire)
+    edges: Dict[Tuple[str, str], ast.AST] = {}
+    for info in fn_infos.values():
+        for a, b, node in info.edges:
+            edges.setdefault((a, b), node)
+        for held, callee, node in info.calls:
+            target = fn_infos.get(callee)
+            if target is None:
+                continue
+            for h in held:
+                for l in target.acquires:
+                    if h != l:
+                        edges.setdefault((h, l), node)
+                    elif not _reentrant(h, classes, module_locks):
+                        edges.setdefault((h, h), node)
+
+    # cycles: self-loops + any edge inside a multi-node SCC
+    graph: Dict[str, Set[str]] = {}
+    for (a, b) in edges:
+        graph.setdefault(a, set()).add(b)
+        graph.setdefault(b, set())
+    sccs = _tarjan(graph)
+    scc_of = {n: i for i, scc in enumerate(sccs) for n in scc}
+    out: List[Finding] = []
+    reported: Set[Tuple[str, str]] = set()
+    for (a, b), node in sorted(
+            edges.items(),
+            key=lambda kv: (getattr(kv[1], "lineno", 0),
+                            getattr(kv[1], "col_offset", 0))):
+        if a == b:
+            out.append(_finding(
+                ctx, "DV102", node,
+                f"nested acquisition of non-reentrant lock '{a}': the "
+                "inner acquire deadlocks on the outer hold; use an RLock "
+                "or restructure the critical section"))
+            continue
+        if scc_of.get(a) == scc_of.get(b) and \
+                len(sccs[scc_of[a]]) > 1 and (b, a) not in reported:
+            cycle = " <-> ".join(sorted(sccs[scc_of[a]]))
+            out.append(_finding(
+                ctx, "DV102", node,
+                f"lock-order inversion: '{a}' is held while acquiring "
+                f"'{b}', but elsewhere the order reverses (cycle: {cycle}) "
+                "— two threads taking opposite paths deadlock; pick one "
+                "global order"))
+            reported.add((a, b))
+    return out
+
+
+def _reentrant(name: str, classes: List[_ClassInfo],
+               module_locks: Dict[str, bool]) -> bool:
+    if name in module_locks:
+        return module_locks[name]
+    if "." in name:
+        cname, attr = name.split(".", 1)
+        for c in classes:
+            if c.node.name == cname:
+                return c.lock_attrs.get(attr, False)
+    return False
+
+
+def _tarjan(graph: Dict[str, Set[str]]) -> List[List[str]]:
+    """Iterative Tarjan SCC (the graphs here are tiny, but recursion
+    depth must not depend on lint input)."""
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on_stack: Set[str] = set()
+    stack: List[str] = []
+    sccs: List[List[str]] = []
+    counter = [0]
+
+    for root in graph:
+        if root in index:
+            continue
+        work = [(root, iter(sorted(graph[root])))]
+        index[root] = low[root] = counter[0]
+        counter[0] += 1
+        stack.append(root)
+        on_stack.add(root)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for nxt in it:
+                if nxt not in index:
+                    index[nxt] = low[nxt] = counter[0]
+                    counter[0] += 1
+                    stack.append(nxt)
+                    on_stack.add(nxt)
+                    work.append((nxt, iter(sorted(graph[nxt]))))
+                    advanced = True
+                    break
+                elif nxt in on_stack:
+                    low[node] = min(low[node], index[nxt])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == index[node]:
+                scc = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    scc.append(w)
+                    if w == node:
+                        break
+                sccs.append(scc)
+    return sccs
+
+
+# -- DV103 signal-unsafe handler ---------------------------------------------
+
+#: attribute calls that block (or may block indefinitely) and are never
+#: safe from signal context, where the interrupted thread may hold the
+#: very lock the call needs
+_BLOCKING_ATTRS = {
+    "acquire": "acquires a lock",
+    "result": "blocks on a Future",
+    "join": "joins a thread",
+}
+
+
+def check_dv103(ctx) -> List[Finding]:
+    """Blocking calls reachable from a signal handler."""
+    module_locks = _module_locks(ctx.tree)
+    classes = _classes(ctx.tree)
+    by_name = {c.node.name: c for c in classes}
+    module_fns = {n.name: n for n in ctx.tree.body
+                  if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+    cls_of_fn: Dict[int, _ClassInfo] = {}
+    for c in classes:
+        for fn in c.methods.values():
+            cls_of_fn[id(fn)] = c
+
+    # handler roots: second arg of signal.signal(...)
+    handlers: List[Tuple[ast.AST, Optional[_ClassInfo]]] = []
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Call) and
+                last_name(node.func) == "signal" and
+                root_name(node.func) in ("signal", None) and
+                len(node.args) >= 2):
+            continue
+        target = node.args[1]
+        attr = _self_attr(target)
+        if attr is not None:
+            # self._on_sigterm: resolve within the enclosing class (the
+            # registration site's class, found via the symbol table)
+            sym = ctx.symbol_at(node)
+            cname = sym.split(".", 1)[0] if sym else ""
+            cls = by_name.get(cname)
+            if cls is not None and attr in cls.methods:
+                handlers.append((cls.methods[attr], cls))
+        elif isinstance(target, ast.Name) and target.id in module_fns:
+            handlers.append((module_fns[target.id], None))
+
+    out: List[Finding] = []
+    flagged: Set[int] = set()
+    for handler, cls in handlers:
+        # reachability: direct self.m() / module fn() calls, transitively.
+        # References that are merely *passed* (Thread(target=...)) run on
+        # another thread, outside signal context, and are NOT followed —
+        # that is exactly the sanctioned PR 5 fix shape.
+        seen: Set[int] = set()
+        frontier: List[Tuple[ast.AST, Optional[_ClassInfo]]] = [
+            (handler, cls)]
+        while frontier:
+            fn, fcls = frontier.pop()
+            if id(fn) in seen:
+                continue
+            seen.add(id(fn))
+            hname = getattr(handler, "name", "<handler>")
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                reason = _dv103_blocking(node, fcls, module_locks)
+                if reason and id(node) not in flagged:
+                    flagged.add(id(node))
+                    out.append(_finding(
+                        ctx, "DV103", node,
+                        f"{reason} reachable from signal handler "
+                        f"'{hname}': the handler interrupts a thread that "
+                        "may hold the same lock — self-deadlock; set a "
+                        "flag (threading.Event) and do the work outside "
+                        "signal context, or hand it to a daemon thread"))
+                # follow call edges
+                attr = _self_attr(node.func)
+                if attr is not None and fcls is not None and \
+                        attr in fcls.methods:
+                    frontier.append((fcls.methods[attr], fcls))
+                elif isinstance(node.func, ast.Name) and \
+                        node.func.id in module_fns:
+                    frontier.append((module_fns[node.func.id], None))
+            # `with self._lock:` in the handler body is an acquire too
+            for node in ast.walk(fn):
+                if isinstance(node, (ast.With, ast.AsyncWith)):
+                    for item in node.items:
+                        key = _lock_key(item.context_expr, fcls,
+                                        module_locks)
+                        if key is not None and \
+                                id(item.context_expr) not in flagged:
+                            flagged.add(id(item.context_expr))
+                            out.append(_finding(
+                                ctx, "DV103", item.context_expr,
+                                f"lock '{key[0]}' acquired inside code "
+                                f"reachable from signal handler "
+                                f"'{hname}': the interrupted thread may "
+                                "hold it — self-deadlock; set a flag and "
+                                "acquire outside signal context"))
+    out.sort(key=lambda f: (f.line, f.col))
+    return out
+
+
+def _dv103_blocking(call: ast.Call, cls: Optional[_ClassInfo],
+                    module_locks: Dict[str, bool]) -> Optional[str]:
+    f = call.func
+    if isinstance(f, ast.Name):
+        if f.id == "emergency_dump":
+            return "flight bundle dump (journal + recorder locks)"
+        return None
+    if not isinstance(f, ast.Attribute):
+        return None
+    if f.attr == "emergency_dump":
+        return "flight bundle dump (journal + recorder locks)"
+    if f.attr in _BLOCKING_ATTRS:
+        # ", ".join(...) is a str method, not a thread join
+        if f.attr == "join" and isinstance(f.value, ast.Constant):
+            return None
+        recv = _self_attr(f.value)
+        if f.attr == "acquire" and recv is not None and cls is not None \
+                and recv not in cls.lock_attrs and \
+                recv not in cls.queue_attrs:
+            return None  # .acquire on a non-lock attr of ours: unknown
+        return f"blocking call .{f.attr}() ({_BLOCKING_ATTRS[f.attr]})"
+    if f.attr in ("put", "get"):
+        recv = _self_attr(f.value)
+        if recv is not None and cls is not None and \
+                recv in cls.queue_attrs:
+            return f"queue .{f.attr}() (may block on the queue lock)"
+        if isinstance(f.value, ast.Name) and f.value.id in ("q", "queue"):
+            return f"queue .{f.attr}() (may block on the queue lock)"
+        return None
+    if f.attr == "write":
+        chain = f.value
+        tail = _self_attr(chain) or (chain.id if isinstance(chain, ast.Name)
+                                     else None)
+        if tail in ("journal", "_journal"):
+            return "journal write (takes the journal lock)"
+    return None
+
+
+# -- DV104 future-protocol misuse --------------------------------------------
+
+def check_dv104(ctx) -> List[Finding]:
+    """set_result/set_exception on a non-local Future without
+    set_running_or_notify_cancel."""
+    out: List[Finding] = []
+    for fn in ctx.top_level_functions():
+        notified = any(
+            isinstance(n, ast.Call) and
+            last_name(n.func) == "set_running_or_notify_cancel"
+            for n in ast.walk(fn))
+        if notified:
+            continue
+        # futures created locally are promises the scope owns: nobody can
+        # have cancelled them before the first set_*, so the protocol
+        # call is not required
+        local_futures: Set[str] = set()
+        for n in ast.walk(fn):
+            if isinstance(n, ast.Assign) and isinstance(n.value, ast.Call) \
+                    and last_name(n.value.func) == "Future":
+                for t in n.targets:
+                    if isinstance(t, ast.Name):
+                        local_futures.add(t.id)
+        for n in ast.walk(fn):
+            if not (isinstance(n, ast.Call) and
+                    isinstance(n.func, ast.Attribute) and
+                    n.func.attr in ("set_result", "set_exception")):
+                continue
+            recv = n.func.value
+            if isinstance(recv, ast.Name) and recv.id in local_futures:
+                continue
+            out.append(_finding(
+                ctx, "DV104", n,
+                f".{n.func.attr}() on a Future this scope did not create, "
+                "without set_running_or_notify_cancel(): a client-"
+                "cancelled Future raises InvalidStateError here and can "
+                "fail the whole batch; gate the resolution on "
+                "set_running_or_notify_cancel() and account the "
+                "cancellation"))
+    return out
+
+
+# -- registry ----------------------------------------------------------------
+
+CONCUR_RULES = {
+    "DV101": ("shared-mutable-state", "error", check_dv101,
+              "attribute written from a thread target and another method "
+              "without a common lock"),
+    "DV102": ("lock-order-inversion", "error", check_dv102,
+              "cycle in the static lock-order graph (nested with scopes)"),
+    "DV103": ("signal-unsafe-handler", "error", check_dv103,
+              "blocking call reachable from a signal.signal handler"),
+    "DV104": ("future-protocol-misuse", "error", check_dv104,
+              "set_result/set_exception without "
+              "set_running_or_notify_cancel"),
+}

@@ -624,6 +624,99 @@ def check_dv005(ctx) -> List[Finding]:
     return out
 
 
+# -- DV007 trace-time-constant ----------------------------------------------
+
+# the alias forms DV005's attribute matching cannot see: DV005 catches
+# `time.time()` / `np.random.rand()` / `random.random()` spelled as
+# attribute calls; DV007 closes the holes — bare names imported with
+# `from time import time` / `from random import ...` /
+# `from numpy.random import ...`, and method calls on a host RNG object
+# (`rng = np.random.default_rng(...)`; `rng.normal()` inside jit).
+
+_RNG_FACTORIES = {"default_rng", "RandomState", "Generator"}
+
+
+def _dv007_aliases(tree: ast.Module, jax_aliases: frozenset) -> tuple:
+    """-> (bare-call aliases, datetime-class aliases). The first maps a
+    bare name to its impure dotted form (calling the NAME is the trap:
+    `time()`, `randint()`); the second holds local names for the
+    datetime/date classes, where only `.now()`/`.today()` is impure —
+    the constructor itself (`datetime(1970, 1, 1)`) is a pure literal
+    and must not flag."""
+    out = {}
+    dt_classes = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            if node.module == "time":
+                for a in node.names:
+                    if a.name in _IMPURE_TIME:
+                        out[a.asname or a.name] = f"time.{a.name}"
+            elif node.module == "random":
+                for a in node.names:
+                    out[a.asname or a.name] = f"random.{a.name}"
+            elif node.module in ("numpy.random", "onp.random"):
+                for a in node.names:
+                    out[a.asname or a.name] = f"np.random.{a.name}"
+            elif node.module == "datetime":
+                for a in node.names:
+                    if a.name in ("datetime", "date"):
+                        dt_classes.add(a.asname or a.name)
+    # names bound to jax.random are samplers with explicit keys, not traps
+    for name in jax_aliases:
+        out.pop(name, None)
+    return out, dt_classes
+
+
+def _dv007_rng_objects(tree: ast.Module) -> set:
+    """Names assigned a host RNG object (module- or function-level):
+    `rng = np.random.default_rng(0)` / `RandomState(7)`."""
+    out = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            if last_name(node.value.func) in _RNG_FACTORIES:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        out.add(t.id)
+    return out
+
+
+def check_dv007(ctx) -> List[Finding]:
+    """Host time/RNG reached through aliases or generator objects inside a
+    traced function: evaluated once, frozen into the trace as a constant."""
+    aliases, dt_classes = _dv007_aliases(
+        ctx.tree, frozenset(getattr(ctx, "jax_random_aliases", ())))
+    rng_objects = _dv007_rng_objects(ctx.tree)
+    out: List[Finding] = []
+    for fn in ctx.jit.traced_functions():
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if isinstance(f, ast.Name) and f.id in aliases:
+                out.append(_finding(
+                    ctx, "DV007", node,
+                    f"{f.id}() (= {aliases[f.id]}) inside a jitted "
+                    "function is evaluated once at trace time and frozen "
+                    "into the graph as a constant; time on the host around "
+                    "the step / use jax.random with an explicit key"))
+            elif isinstance(f, ast.Attribute) and \
+                    isinstance(f.value, ast.Name) and \
+                    f.value.id in rng_objects:
+                out.append(_finding(
+                    ctx, "DV007", node,
+                    f"host RNG call {f.value.id}.{f.attr}() inside a "
+                    "jitted function freezes one sample into the trace; "
+                    "use jax.random with an explicit key"))
+            elif isinstance(f, ast.Attribute) and f.attr in ("now", "today") \
+                    and root_name(f) in ({"datetime", "date"} | dt_classes):
+                out.append(_finding(
+                    ctx, "DV007", node,
+                    f"{ast.unparse(f) if hasattr(ast, 'unparse') else f.attr}"
+                    "() inside a jitted function is a trace-time constant; "
+                    "take timestamps on the host"))
+    return out
+
+
 # -- DV006 untraced-python-branch -------------------------------------------
 
 def _naked_param_refs(test: ast.AST, params) -> List[str]:
@@ -700,4 +793,14 @@ RULES = {
               "host side effects inside a traced function"),
     "DV006": ("untraced-python-branch", "warning", check_dv006,
               "Python control flow on a traced argument"),
+    "DV007": ("trace-time-constant", "error", check_dv007,
+              "host time/RNG via import aliases or RNG objects in a "
+              "traced function"),
 }
+
+# the DV1xx concurrency pack (lint/concur.py) rides the same engine:
+# one RULES registry, one baseline, one suppression syntax, one CLI.
+# concur.py imports only findings/jitctx, so this merge is cycle-free.
+from deep_vision_tpu.lint.concur import CONCUR_RULES  # noqa: E402
+
+RULES.update(CONCUR_RULES)

@@ -24,6 +24,12 @@ records the reference's instrumentation as one examples/sec print):
   and budget, plus the configurable static window (`AutoProfiler`).
 - `merge`: per-host journal merge + cross-host straggler detection for
   multi-host runs (`merge_journal_files`; CLI in tools/obs_merge.py).
+- `locksmith`: opt-in runtime lock-order sanitizer — named lock/condition
+  wrappers adopted by serve/ and obs/, order-inversion + hold-time-outlier
+  detection journaled as `lock_order_violation`/`lock_contention` events;
+  armed in serve-smoke/chaos-smoke, a module-global None-check when
+  disabled (`locksmith.lock`, `locksmith.arm`, `locksmith.report`). The
+  static half is lint/concur.py (jaxlint DV101-DV104).
 
 Metric/journal/trace writers are process-0-only in single-process runs;
 multi-process runs write per-host `.pN` files (registry.process_suffix)

@@ -57,6 +57,7 @@ import zlib
 from collections import deque
 from typing import Dict, List, Optional
 
+from deep_vision_tpu.obs import locksmith
 from deep_vision_tpu.obs.journal import _jsonable
 from deep_vision_tpu.obs.registry import process_suffix
 
@@ -114,7 +115,7 @@ class FlightRecorder:
         self._health: deque = deque(maxlen=int(max_health))
         self._tail: deque = deque(maxlen=int(max_tail))
         self._notes: deque = deque(maxlen=int(max_notes))
-        self._lock = threading.Lock()
+        self._lock = locksmith.lock("obs.flight")
         self._dumped: Dict[str, str] = {}  # reason -> bundle dir (latch)
         self._dumping = False
         self._armed = True

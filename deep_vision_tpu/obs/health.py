@@ -38,6 +38,7 @@ import traceback
 from collections import deque
 from typing import Optional
 
+from deep_vision_tpu.obs import locksmith
 from deep_vision_tpu.obs.registry import Registry, get_registry
 
 POLICIES = ("warn", "skip_step", "abort")
@@ -128,7 +129,11 @@ class HealthMonitor:
         self._checks = 0
 
         # watchdog state: monotonic heartbeat + a fire latch so one stall
-        # produces one stack dump, re-armed by the next heartbeat
+        # produces one stack dump, re-armed by the next heartbeat. The
+        # latch is written by BOTH the train thread (beat) and the
+        # watchdog thread (fire) — one lock covers the pair (concurlint
+        # DV101: the un-guarded version loses the re-arm/fire race)
+        self._wd_lock = locksmith.lock("obs.health.watchdog")
         self._last_beat = time.monotonic()
         self._wd_fired = False
         self._wd_thread: Optional[threading.Thread] = None
@@ -268,8 +273,9 @@ class HealthMonitor:
 
     def beat(self) -> None:
         """Heartbeat: any sign of forward progress re-arms the watchdog."""
-        self._last_beat = time.monotonic()
-        self._wd_fired = False
+        with self._wd_lock:
+            self._last_beat = time.monotonic()
+            self._wd_fired = False
 
     def start_watchdog(self) -> None:
         """Arm the hang detector (no-op without a timeout). Daemon thread:
@@ -288,11 +294,16 @@ class HealthMonitor:
     def _watchdog_loop(self) -> None:
         poll = min(max(self.watchdog_timeout / 4.0, 0.05), 10.0)
         while not self._wd_stop.wait(poll):
-            stalled = time.monotonic() - self._last_beat
-            if stalled < self.watchdog_timeout or self._wd_fired:
-                continue
-            # latch first: a beat racing in after the dump re-arms cleanly
-            self._wd_fired = True
+            # latch under the beat lock: a beat racing the fire either
+            # re-arms before (no dump) or after (clean re-arm) — never a
+            # lost latch. The stack dump and journal write run OUTSIDE
+            # the lock: beat() is on the per-step hot path and must never
+            # wait on a dump in progress.
+            with self._wd_lock:
+                stalled = time.monotonic() - self._last_beat
+                if stalled < self.watchdog_timeout or self._wd_fired:
+                    continue
+                self._wd_fired = True
             self._c_hangs.inc()
             stacks = dump_all_stacks()
             self._emit("hang", stalled_s=round(stalled, 3),

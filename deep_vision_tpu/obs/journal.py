@@ -16,6 +16,8 @@ Event types (full schema in obs/README.md):
   fault         an injected fault fired (resilience/faults.py)
   data_skip     a bad record skipped under the bad-record budget
   ckpt_quarantine  a corrupt/incomplete checkpoint step quarantined
+  lock_order_violation  runtime lock-order inversion (obs/locksmith.py)
+  lock_contention  a lock hold/wait over the locksmith threshold
   note          free-form annotation
   crash         atexit marker: the process died without close()
   exit          clean close, with status
@@ -46,6 +48,7 @@ import threading
 import time
 from typing import Callable, List, Optional
 
+from deep_vision_tpu.obs import locksmith
 from deep_vision_tpu.obs.registry import is_primary_host, process_suffix
 
 
@@ -82,8 +85,10 @@ class RunJournal:
         self._taps: List[Callable[[dict], None]] = []
         self._primary = is_primary_host() or bool(sfx)
         # writes come from the train loop AND side threads (the health
-        # watchdog, data prefetch errors): one lock keeps lines whole
-        self._lock = threading.Lock()
+        # watchdog, data prefetch errors): one lock keeps lines whole.
+        # locksmith-named: the runtime sanitizer checks nothing ever holds
+        # this while taking a lock that can be held around a write()
+        self._lock = locksmith.lock("obs.journal")
         self._f = None
         self.dropped_lines = 0  # lines lost to journal I/O errors
         if self._primary:

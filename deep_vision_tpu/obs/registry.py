@@ -236,7 +236,13 @@ class Registry:
 
     def __init__(self):
         self._metrics: Dict[Tuple[str, Tuple], object] = {}
-        self._lock = threading.Lock()
+        # the registry-level get-or-create lock is locksmith-named; the
+        # per-metric leaf locks (Counter/Gauge/Histogram) stay raw
+        # threading.Locks on purpose — they guard single arithmetic ops on
+        # the hottest paths, never nest, and carry no ordering information
+        from deep_vision_tpu.obs import locksmith
+
+        self._lock = locksmith.lock("obs.registry")
 
     def _get_or_create(self, cls, name: str, help: str,
                        labels: Optional[dict], **kw):

@@ -38,6 +38,7 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional
 
+from deep_vision_tpu.obs import locksmith
 from deep_vision_tpu.obs.registry import is_primary_host, process_suffix
 
 # Trace-event timestamps are microseconds. Use an epoch-anchored clock so
@@ -125,11 +126,11 @@ class Tracer:
         self.max_events = max(1000, int(max_events))
         self._events: List[dict] = []
         self._dropped = 0
-        self._lock = threading.Lock()
+        self._lock = locksmith.lock("obs.trace.buffer")
         # flush serialization is separate from the buffer lock: the file
         # write must not block recorders, but two concurrent flushes with
         # one tmp name would publish a torn file
-        self._flush_lock = threading.Lock()
+        self._flush_lock = locksmith.lock("obs.trace.flush")
         self._closed = False
         self._primary = is_primary_host() or bool(sfx)
         self._pid = os.getpid()

@@ -16,11 +16,12 @@ exit. SIGTERM drain (serve/router.py) is exactly this switch.
 """
 from __future__ import annotations
 
-import threading
 import time
 from collections import deque
 from concurrent.futures import Future
 from typing import Callable, List, Optional
+
+from deep_vision_tpu.obs import locksmith
 
 
 class QueueClosed(RuntimeError):
@@ -63,7 +64,9 @@ class BatchingQueue:
         self.max_wait_s = max(0.0, float(max_wait_ms)) / 1e3
         self._on_depth = on_depth
         self._q: deque = deque()
-        self._cond = threading.Condition()
+        # one lock ROLE for every per-model queue (locksmith checks lock
+        # ordering between roles, not instances — lockdep lock classes)
+        self._cond = locksmith.condition("serve.queue")
         self._closed = False
 
     # -- producer side -----------------------------------------------------

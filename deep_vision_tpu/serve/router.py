@@ -34,6 +34,7 @@ from typing import Dict, List, Optional
 import jax
 import numpy as np
 
+from deep_vision_tpu.obs import locksmith
 from deep_vision_tpu.obs.trace import span
 from deep_vision_tpu.serve.buckets import bucket_for, pad_batch, split_rows
 from deep_vision_tpu.serve.engine import Engine, ServeError
@@ -78,12 +79,14 @@ class Server:
         self.health = health  # optional obs.HealthMonitor: beat() per batch
         self._queues: Dict[str, BatchingQueue] = {}
         self._threads: List[threading.Thread] = []
-        self._device_lock = threading.Lock()  # one device, serialized exec
-        self._count_lock = threading.Lock()
+        # locksmith-named locks: armed smokes check their runtime order
+        # (submit -> counts, never the reverse) and hold-time outliers
+        self._device_lock = locksmith.lock("serve.device")  # one device,
+        self._count_lock = locksmith.lock("serve.counts")  # serialized exec
         # serializes submit's accept-then-enqueue against drain's latch:
         # drain must never observe an accepted request that is not yet in
         # a queue (it would count as pending and taint the drain verdict)
-        self._submit_lock = threading.Lock()
+        self._submit_lock = locksmith.lock("serve.submit")
         self.accepted = 0
         self.completed = 0
         self.errors = 0

@@ -520,13 +520,21 @@ def _maybe_upload(args, ckpt_dir: str) -> None:
 
 
 def _make_journal(args, cfg: ExperimentConfig, budget=None):
+    from deep_vision_tpu.obs import locksmith
+
     if not args.journal:
+        # DVT_LOCKSMITH arms the runtime lock sanitizer even journal-less
+        # (violations still count in the registry and report())
+        locksmith.arm_from_env()
         return None
     import dataclasses
 
     from deep_vision_tpu.obs import RunJournal
 
     journal = RunJournal(args.journal, kind="train")
+    # chaos-smoke children run with DVT_LOCKSMITH=1: lock-order
+    # violations and hold-time outliers land as typed journal events
+    locksmith.arm_from_env(journal=journal)
     journal.manifest(config=dataclasses.asdict(cfg))
     # late-attach the resilience emitters (both are built before the
     # journal exists): injected faults and skipped records then show up

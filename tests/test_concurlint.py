@@ -1,0 +1,834 @@
+"""concurlint (lint/concur.py DV101-DV104) + locksmith (obs/locksmith.py):
+per-rule positive/negative fixtures, suppression/baseline interplay, the
+repo self-lint gate, and the runtime sanitizer's unit contracts (forced
+inversion detected, disabled-mode overhead, clean serve drain journals
+zero violations).
+"""
+from __future__ import annotations
+
+import json
+import pickle
+import textwrap
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from deep_vision_tpu.lint import lint_source
+from deep_vision_tpu.lint.__main__ import main as lint_main
+from deep_vision_tpu.lint.rules import RULES
+from deep_vision_tpu.obs import RunJournal, locksmith, read_journal
+from deep_vision_tpu.obs.registry import Registry
+
+REPO_ROOT = Path(__file__).resolve().parents[1]
+
+
+def run(src: str, **kw):
+    kept, _ = lint_source(textwrap.dedent(src), "fixture.py", **kw)
+    return kept
+
+
+def codes(src: str, **kw):
+    return [f.code for f in run(src, **kw)]
+
+
+@pytest.fixture(autouse=True)
+def _disarm_locksmith():
+    yield
+    locksmith.disarm()
+
+
+# -- DV101 shared-mutable-state ----------------------------------------------
+
+class TestDV101:
+    def test_unguarded_thread_shared_write_flags(self):
+        found = run("""
+            import threading
+
+            class Worker:
+                def __init__(self):
+                    self.count = 0
+                    self._t = threading.Thread(target=self._loop)
+
+                def _loop(self):
+                    self.count += 1
+
+                def reset(self):
+                    self.count = 0
+        """, select=["DV101"])
+        assert [f.code for f in found] == ["DV101"]
+        assert "self.count" in found[0].message
+        assert "_loop" in found[0].message and "reset" in found[0].message
+
+    def test_executor_submit_target_flags(self):
+        assert codes("""
+            class Pool:
+                def __init__(self, ex):
+                    self.done = 0
+                    ex.submit(self._work)
+
+                def _work(self):
+                    self.done = 1
+
+                def clear(self):
+                    self.done = 0
+        """, select=["DV101"]) == ["DV101"]
+
+    def test_common_guard_is_clean(self):
+        assert run("""
+            import threading
+
+            class Worker:
+                def __init__(self):
+                    self.count = 0
+                    self._lock = threading.Lock()
+                    self._t = threading.Thread(target=self._loop)
+
+                def _loop(self):
+                    with self._lock:
+                        self.count += 1
+
+                def reset(self):
+                    with self._lock:
+                        self.count = 0
+        """, select=["DV101"]) == []
+
+    def test_disjoint_guards_flag(self):
+        # both sides hold A lock — just not the SAME lock: still a race
+        assert codes("""
+            import threading
+
+            class Worker:
+                def __init__(self):
+                    self.count = 0
+                    self._a = threading.Lock()
+                    self._b = threading.Lock()
+                    self._t = threading.Thread(target=self._loop)
+
+                def _loop(self):
+                    with self._a:
+                        self.count += 1
+
+                def reset(self):
+                    with self._b:
+                        self.count = 0
+        """, select=["DV101"]) == ["DV101"]
+
+    def test_init_writes_do_not_count(self):
+        # construction happens-before thread start: __init__ is exempt
+        assert run("""
+            import threading
+
+            class Worker:
+                def __init__(self):
+                    self.count = 0
+                    self._t = threading.Thread(target=self._loop)
+
+                def _loop(self):
+                    self.count += 1
+        """, select=["DV101"]) == []
+
+    def test_transitive_thread_reach(self):
+        # the thread target delegates to a helper; the helper's write is
+        # still in the thread domain
+        assert codes("""
+            import threading
+
+            class Worker:
+                def __init__(self):
+                    self.state = None
+                    self._t = threading.Thread(target=self._loop)
+
+                def _loop(self):
+                    self._step()
+
+                def _step(self):
+                    self.state = "running"
+
+                def reset(self):
+                    self.state = None
+        """, select=["DV101"]) == ["DV101"]
+
+    def test_locksmith_factory_recognized_as_lock(self):
+        assert run("""
+            from deep_vision_tpu.obs import locksmith
+            import threading
+
+            class Worker:
+                def __init__(self):
+                    self.count = 0
+                    self._lock = locksmith.lock("w")
+                    self._t = threading.Thread(target=self._loop)
+
+                def _loop(self):
+                    with self._lock:
+                        self.count += 1
+
+                def reset(self):
+                    with self._lock:
+                        self.count = 0
+        """, select=["DV101"]) == []
+
+    def test_callback_attribute_target_out_of_scope(self):
+        # pool.submit(self.transform): `transform` is a user-supplied
+        # callable attribute, not a method of the class — not our domain
+        assert run("""
+            class Loader:
+                def __init__(self, transform, pool):
+                    self.transform = transform
+                    self.n = 0
+                    pool.submit(self.transform)
+
+                def bump(self):
+                    self.n += 1
+        """, select=["DV101"]) == []
+
+
+# -- DV102 lock-order inversion ----------------------------------------------
+
+class TestDV102:
+    def test_module_lock_inversion_flags(self):
+        found = run("""
+            import threading
+
+            A = threading.Lock()
+            B = threading.Lock()
+
+            def f():
+                with A:
+                    with B:
+                        pass
+
+            def g():
+                with B:
+                    with A:
+                        pass
+        """, select=["DV102"])
+        assert [f.code for f in found] == ["DV102"]
+        assert "inversion" in found[0].message
+        assert "A" in found[0].message and "B" in found[0].message
+
+    def test_consistent_order_clean(self):
+        assert run("""
+            import threading
+
+            A = threading.Lock()
+            B = threading.Lock()
+
+            def f():
+                with A:
+                    with B:
+                        pass
+
+            def g():
+                with A:
+                    with B:
+                        pass
+        """, select=["DV102"]) == []
+
+    def test_multi_item_with_counts_as_nesting(self):
+        assert codes("""
+            import threading
+
+            A = threading.Lock()
+            B = threading.Lock()
+
+            def f():
+                with A, B:
+                    pass
+
+            def g():
+                with B, A:
+                    pass
+        """, select=["DV102"]) == ["DV102"]
+
+    def test_inversion_across_call_edge(self):
+        # f holds _a and calls helper() which takes _b; g takes them in
+        # the reverse order — the cycle only exists across the call edge
+        assert codes("""
+            import threading
+
+            class S:
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self._b = threading.Lock()
+
+                def f(self):
+                    with self._a:
+                        self.helper()
+
+                def helper(self):
+                    with self._b:
+                        pass
+
+                def g(self):
+                    with self._b:
+                        with self._a:
+                            pass
+        """, select=["DV102"]) == ["DV102"]
+
+    def test_nested_same_nonreentrant_lock_flags(self):
+        found = run("""
+            import threading
+
+            class S:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def outer(self):
+                    with self._lock:
+                        with self._lock:
+                            pass
+        """, select=["DV102"])
+        assert [f.code for f in found] == ["DV102"]
+        assert "non-reentrant" in found[0].message
+
+    def test_nested_rlock_clean(self):
+        assert run("""
+            import threading
+
+            class S:
+                def __init__(self):
+                    self._lock = threading.RLock()
+
+                def outer(self):
+                    with self._lock:
+                        with self._lock:
+                            pass
+        """, select=["DV102"]) == []
+
+    def test_nested_same_lock_via_call_edge_flags(self):
+        # the PR 5 bug shape: a method that holds the lock calls another
+        # method that re-acquires it
+        assert codes("""
+            import threading
+
+            class J:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def write(self):
+                    with self._lock:
+                        pass
+
+                def dump(self):
+                    with self._lock:
+                        self.write()
+        """, select=["DV102"]) == ["DV102"]
+
+    def test_unrelated_with_blocks_ignored(self):
+        assert run("""
+            import threading
+
+            A = threading.Lock()
+
+            def f(path):
+                with open(path) as fh:
+                    with A:
+                        return fh.read()
+        """, select=["DV102"]) == []
+
+
+# -- DV103 signal-unsafe handler ---------------------------------------------
+
+class TestDV103:
+    def test_lock_in_handler_flags(self):
+        found = run("""
+            import signal
+            import threading
+
+            _LOCK = threading.Lock()
+
+            def handler(signum, frame):
+                with _LOCK:
+                    pass
+
+            signal.signal(signal.SIGTERM, handler)
+        """, select=["DV103"])
+        assert [f.code for f in found] == ["DV103"]
+        assert "self-deadlock" in found[0].message
+
+    def test_blocking_calls_reachable_from_method_handler(self):
+        # the exact PR 5 incident: the SIGTERM handler dumps a flight
+        # bundle (journal + recorder locks) in signal context
+        found = run("""
+            import signal
+
+            class Guard:
+                def install(self):
+                    signal.signal(signal.SIGTERM, self._on_sigterm)
+
+                def _on_sigterm(self, signum, frame):
+                    self._drain()
+
+                def _drain(self):
+                    from deep_vision_tpu.obs import flight
+                    flight.emergency_dump("preempt")
+        """, select=["DV103"])
+        assert [f.code for f in found] == ["DV103"]
+        assert "flight" in found[0].message
+
+    def test_future_result_and_journal_write_flag(self):
+        found = run("""
+            import signal
+
+            class S:
+                def install(self):
+                    signal.signal(signal.SIGTERM, self._on_term)
+
+                def _on_term(self, signum, frame):
+                    self.pending.result()
+                    self.journal.write("exit", status="sigterm")
+        """, select=["DV103"])
+        assert [f.code for f in found] == ["DV103", "DV103"]
+
+    def test_flag_then_daemon_thread_is_clean(self):
+        # the sanctioned fix shape (parallel/multihost.PreemptionGuard):
+        # set a flag, hand the blocking work to a thread — target=
+        # references are not signal-context calls
+        assert run("""
+            import signal
+            import threading
+
+            class Guard:
+                def install(self):
+                    signal.signal(signal.SIGTERM, self._on_sigterm)
+
+                def _on_sigterm(self, signum, frame):
+                    self.requested = True
+                    threading.Thread(target=self._dump, daemon=True).start()
+
+                def _dump(self):
+                    from deep_vision_tpu.obs import flight
+                    flight.emergency_dump("preempt")
+        """, select=["DV103"]) == []
+
+    def test_event_set_is_clean(self):
+        # serve/router.py's handler: Event.set never blocks
+        assert run("""
+            import signal
+            import threading
+
+            class Server:
+                def __init__(self):
+                    self._stop = threading.Event()
+
+                def install_sigterm(self):
+                    signal.signal(signal.SIGTERM, self._on_sigterm)
+
+                def _on_sigterm(self, signum, frame):
+                    self._stop.set()
+        """, select=["DV103"]) == []
+
+    def test_str_join_not_a_thread_join(self):
+        assert run("""
+            import signal
+
+            def handler(signum, frame):
+                print(", ".join(["a", "b"]))
+
+            signal.signal(signal.SIGTERM, handler)
+        """, select=["DV103"]) == []
+
+    def test_queue_ops_in_handler_flag(self):
+        assert codes("""
+            import queue
+            import signal
+
+            class S:
+                def __init__(self):
+                    self._q = queue.Queue()
+                    signal.signal(signal.SIGTERM, self._on_term)
+
+                def _on_term(self, signum, frame):
+                    self._q.put(None)
+        """, select=["DV103"]) == ["DV103"]
+
+
+# -- DV104 future-protocol misuse --------------------------------------------
+
+class TestDV104:
+    def test_set_result_without_notify_flags(self):
+        found = run("""
+            def resolve(req, row):
+                req.future.set_result(row)
+        """, select=["DV104"])
+        assert [f.code for f in found] == ["DV104"]
+        assert "InvalidStateError" in found[0].message
+
+    def test_set_exception_without_notify_flags(self):
+        assert codes("""
+            def fail(req, exc):
+                req.future.set_exception(exc)
+        """, select=["DV104"]) == ["DV104"]
+
+    def test_notify_in_scope_is_clean(self):
+        # the PR 6 fix shape (serve/router._fail_request)
+        assert run("""
+            def fail(req, exc):
+                if not req.future.set_running_or_notify_cancel():
+                    return
+                req.future.set_exception(exc)
+        """, select=["DV104"]) == []
+
+    def test_locally_created_future_is_clean(self):
+        # a promise the scope owns: nobody can have cancelled it yet
+        assert run("""
+            from concurrent.futures import Future
+
+            def make():
+                f = Future()
+                f.set_result(1)
+                return f
+        """, select=["DV104"]) == []
+
+
+# -- suppression + baseline interplay ----------------------------------------
+
+DV101_SRC = """
+import threading
+
+class Worker:
+    def __init__(self):
+        self.count = 0
+        self._t = threading.Thread(target=self._loop)
+
+    def _loop(self):
+        self.count += 1{pragma}
+
+    def reset(self):
+        self.count = 0
+"""
+
+
+def test_dv1xx_inline_suppression():
+    dirty = textwrap.dedent(DV101_SRC.format(pragma=""))
+    kept, dropped = lint_source(dirty, "mod.py", select=["DV101"])
+    assert [f.code for f in kept] == ["DV101"]
+    clean = textwrap.dedent(DV101_SRC.format(
+        pragma="  # jaxlint: disable=DV101 -- test-only counter"))
+    kept, dropped = lint_source(clean, "mod.py", select=["DV101"])
+    assert kept == []
+    assert [f.code for f in dropped] == ["DV101"]
+
+
+def test_dv1xx_baseline_interplay(tmp_path, capsys):
+    """A baselined DV101 finding is accepted; a second identical one (or
+    a drifted line) still matches on (code, path, symbol, message)."""
+    mod = tmp_path / "mod.py"
+    mod.write_text(textwrap.dedent(DV101_SRC.format(pragma="")))
+    (tmp_path / "pyproject.toml").write_text(textwrap.dedent("""
+        [tool.jaxlint]
+        paths = ["mod.py"]
+        baseline = "baseline.json"
+    """))
+    pp = str(tmp_path / "pyproject.toml")
+    assert lint_main(["--config", pp]) == 1
+    capsys.readouterr()
+    # accept into the baseline, then the same tree is clean
+    assert lint_main(["--config", pp, "--write-baseline"]) == 0
+    capsys.readouterr()
+    assert lint_main(["--config", pp]) == 0
+    # line drift must not resurrect the accepted finding
+    mod.write_text("# a new leading comment\n" + mod.read_text())
+    assert lint_main(["--config", pp]) == 0
+
+
+def test_dv1xx_rules_registered():
+    for code in ("DV101", "DV102", "DV103", "DV104", "DV007"):
+        assert code in RULES
+        name, severity, check, doc = RULES[code]
+        assert severity in ("error", "warning") and callable(check)
+
+
+def test_repo_self_lint_concur_clean(capsys):
+    """The shipped tree is clean under the concurrency pack specifically
+    (true positives fixed, not baselined — the committed baseline stays
+    empty). This is the acceptance gate for DV101-DV104 + DV007."""
+    rc = lint_main(["--config", str(REPO_ROOT / "pyproject.toml"),
+                    "--select", "DV101,DV102,DV103,DV104,DV007"])
+    out = capsys.readouterr().out
+    assert rc == 0, f"concurlint found new violations:\n{out}"
+    baseline = json.loads(
+        (REPO_ROOT / ".jaxlint-baseline.json").read_text())
+    assert baseline["findings"] == [], "the committed baseline must stay empty"
+
+
+def test_concur_gate_catches_injected_violation(tmp_path, capsys):
+    bad = tmp_path / "bad_threads.py"
+    bad.write_text(textwrap.dedent(DV101_SRC.format(pragma="")))
+    rc = lint_main([str(bad),
+                    "--config", str(REPO_ROOT / "pyproject.toml")])
+    capsys.readouterr()
+    assert rc == 1
+
+
+# -- locksmith: runtime sanitizer ---------------------------------------------
+
+class TestLocksmith:
+    def test_forced_inversion_detected_and_journaled(self, tmp_path):
+        jp = tmp_path / "locks.jsonl"
+        journal = RunJournal(str(jp))
+        journal.manifest()
+        san = locksmith.arm(journal=journal, registry=Registry())
+        a = locksmith.lock("test.A")
+        b = locksmith.lock("test.B")
+        with a:
+            with b:
+                pass
+        with b:
+            with a:
+                pass
+        v = san.violations()
+        assert len(v) == 1
+        assert {v[0]["lock_a"], v[0]["lock_b"]} == {"test.A", "test.B"}
+        assert v[0]["stack"] and v[0]["prior_stack"]
+        locksmith.disarm()
+        journal.close()
+        events = read_journal(str(jp))
+        viol = [e for e in events if e["event"] == "lock_order_violation"]
+        assert len(viol) == 1
+        assert viol[0]["lock_a"] and viol[0]["lock_b"]
+        from tools.check_journal import check_journal
+
+        assert check_journal(str(jp), strict=True) == []
+
+    def test_inversion_detected_across_threads(self):
+        san = locksmith.arm(registry=Registry())
+        a = locksmith.lock("thr.A")
+        b = locksmith.lock("thr.B")
+        first_done = threading.Event()
+
+        def path_ab():
+            with a:
+                with b:
+                    pass
+            first_done.set()
+
+        def path_ba():
+            first_done.wait(5)  # sequenced: detection, not a real deadlock
+            with b:
+                with a:
+                    pass
+
+        t1 = threading.Thread(target=path_ab)
+        t2 = threading.Thread(target=path_ba)
+        t1.start(); t2.start()
+        t1.join(5); t2.join(5)
+        v = san.violations()
+        assert len(v) == 1
+        assert v[0]["thread"] != v[0]["prior_thread"]
+
+    def test_violation_latched_per_pair(self):
+        san = locksmith.arm(registry=Registry())
+        a = locksmith.lock("latch.A")
+        b = locksmith.lock("latch.B")
+        with a:
+            with b:
+                pass
+        for _ in range(3):
+            with b:
+                with a:
+                    pass
+        assert len(san.violations()) == 1
+
+    def test_consistent_order_clean(self):
+        san = locksmith.arm(registry=Registry())
+        a = locksmith.lock("ok.A")
+        b = locksmith.lock("ok.B")
+        for _ in range(5):
+            with a:
+                with b:
+                    pass
+        assert san.violations() == []
+
+    def test_hold_contention_event(self, tmp_path):
+        jp = tmp_path / "hold.jsonl"
+        journal = RunJournal(str(jp))
+        san = locksmith.arm(journal=journal, registry=Registry(),
+                            hold_ms=1.0)
+        lk = locksmith.lock("slow.lock")
+        with lk:
+            time.sleep(0.02)
+        rep = san.report()
+        assert rep["locks"]["slow.lock"]["hold_contentions"] == 1
+        assert rep["max_hold_lock"] == "slow.lock"
+        assert rep["max_hold_ms"] >= 10.0
+        locksmith.disarm()
+        journal.close()
+        cont = [e for e in read_journal(str(jp))
+                if e["event"] == "lock_contention"]
+        assert len(cont) == 1 and cont[0]["kind"] == "hold"
+        assert cont[0]["lock"] == "slow.lock" and cont[0]["ms"] >= 10.0
+
+    def test_wait_contention_event(self):
+        san = locksmith.arm(registry=Registry(), wait_ms=5.0)
+        lk = locksmith.lock("contended.lock")
+        holding = threading.Event()
+
+        def holder():
+            with lk:
+                holding.set()
+                time.sleep(0.05)
+
+        t = threading.Thread(target=holder)
+        t.start()
+        holding.wait(5)
+        with lk:  # blocks ~50ms on the holder
+            pass
+        t.join(5)
+        rep = san.report()
+        assert rep["locks"]["contended.lock"]["wait_contentions"] >= 1
+        assert rep["top_contended"] == "contended.lock"
+
+    def test_condition_wait_releases_hold(self):
+        # a dispatcher parked on an empty queue is not a marathon hold
+        san = locksmith.arm(registry=Registry(), hold_ms=10.0)
+        cv = locksmith.condition("park.cv")
+
+        def waiter():
+            with cv:
+                cv.wait(timeout=0.1)
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        t.join(5)
+        rep = san.report()
+        assert rep["locks"]["park.cv"]["hold_contentions"] == 0
+
+    def test_condition_notify_roundtrip(self):
+        locksmith.arm(registry=Registry())
+        cv = locksmith.condition("rt.cv")
+        got = []
+
+        def consumer():
+            with cv:
+                while not got:
+                    cv.wait(timeout=1.0)
+
+        t = threading.Thread(target=consumer)
+        t.start()
+        time.sleep(0.02)
+        with cv:
+            got.append(1)
+            cv.notify_all()
+        t.join(5)
+        assert not t.is_alive()
+
+    def test_reentrant_same_name_no_self_violation(self):
+        san = locksmith.arm(registry=Registry())
+        lk = locksmith.rlock("re.lock")
+        with lk:
+            with lk:
+                pass
+        assert san.violations() == []
+
+    def test_disabled_overhead_probe(self):
+        """Disabled-mode cost: one module-global load + None check per
+        op on top of the raw primitive (the faults.fire / flight.note
+        budget; chaos-smoke enforces 2us, this a looser CI bound)."""
+        assert locksmith.get_sanitizer() is None
+        lk = locksmith.lock("idle.lock")
+        n = 50_000
+        t0 = time.perf_counter()
+        for _ in range(n):
+            with lk:
+                pass
+        ns = (time.perf_counter() - t0) / n * 1e9
+        assert ns < 20_000, f"disabled lock cycle cost {ns:.0f}ns"
+
+    def test_instrumented_lock_pickles(self):
+        lk = locksmith.lock("pickle.lock")
+        clone = pickle.loads(pickle.dumps(lk))
+        assert clone.name == "pickle.lock"
+        with clone:
+            assert clone.locked()
+        assert not clone.locked()
+
+    def test_rlock_pickle_keeps_reentrancy(self):
+        # regression: an rlock that unpickled as a plain Lock would
+        # self-deadlock in the worker on the first nested acquire
+        clone = pickle.loads(pickle.dumps(locksmith.rlock("pickle.rlock")))
+        with clone:
+            with clone:  # must not deadlock
+                pass
+
+    def test_arm_from_env(self, monkeypatch):
+        monkeypatch.delenv(locksmith.ENV_ARM, raising=False)
+        assert locksmith.arm_from_env() is None
+        assert locksmith.get_sanitizer() is None
+        monkeypatch.setenv(locksmith.ENV_ARM, "1")
+        monkeypatch.setenv(locksmith.ENV_HOLD_MS, "123.0")
+        san = locksmith.arm_from_env()
+        assert san is not None and locksmith.get_sanitizer() is san
+        assert san.hold_ms == 123.0
+
+    def test_report_disarmed_placeholder(self):
+        assert locksmith.get_sanitizer() is None
+        rep = locksmith.report()
+        assert rep["armed"] is False and rep["violations"] == []
+
+
+# -- locksmith x serve: a clean drain journals zero violations ----------------
+
+def _toy_fn(variables, images):
+    flat = images.reshape((images.shape[0], -1))
+    return {"scores": flat @ variables["w"],
+            "mean": images.mean(axis=(1, 2, 3))}
+
+
+@pytest.mark.filterwarnings("ignore:Some donated buffers")
+def test_clean_serve_drain_zero_violations(tmp_path):
+    """The acceptance fixture: a real Server lifecycle (warmup, mixed
+    submits from several threads, drain) under the armed sanitizer
+    journals ZERO lock_order_violation events — the serving plane's lock
+    discipline, runtime-checked."""
+    import jax.numpy as jnp
+
+    from deep_vision_tpu.serve import Engine, Server
+
+    jp = tmp_path / "serve.jsonl"
+    journal = RunJournal(str(jp), kind="serve")
+    journal.manifest(config={"name": "concurlint_serve", "task": "serving"})
+    san = locksmith.arm(journal=journal, registry=Registry())
+
+    img = (4, 4, 1)
+    w = np.random.RandomState(0).randn(16, 3).astype(np.float32)
+    eng = Engine(registry=Registry())
+    eng.register("toy", _toy_fn, {"w": jnp.asarray(w)}, input_shape=img,
+                 buckets=(1, 2, 4))
+    eng.warmup()
+    server = Server(eng, journal=journal, registry=Registry(),
+                    max_wait_ms=2.0)
+    server.start()
+
+    errs = []
+
+    def client(n, seed):
+        rng = np.random.RandomState(seed)
+        try:
+            futs = [server.submit("toy", rng.rand(*img).astype(np.float32))
+                    for _ in range(n)]
+            for fu in futs:
+                fu.result(timeout=60)
+        except Exception as e:  # pragma: no cover - diagnostic
+            errs.append(e)
+
+    threads = [threading.Thread(target=client, args=(4, i))
+               for i in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(60)
+    summary = server.drain("close")
+    assert not errs and summary["outcome"] == "flushed"
+    assert san.violations() == []
+    locksmith.disarm()
+    journal.close()
+    events = read_journal(str(jp))
+    assert not any(e["event"] == "lock_order_violation" for e in events)
+    from tools.check_journal import check_journal
+
+    assert check_journal(str(jp), strict=True) == []

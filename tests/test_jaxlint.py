@@ -825,6 +825,115 @@ def test_dv006_static_tests_not_flagged():
     """, select=["DV006"]) == []
 
 
+# -- DV007 trace-time-constant ------------------------------------------------
+
+def test_dv007_from_import_time_in_jit():
+    # DV005 catches `time.time()`; the bare alias form escapes its
+    # attribute matching — DV007 closes the hole
+    found = run("""
+        import jax
+        from time import time, perf_counter
+
+        @jax.jit
+        def step(x):
+            t0 = perf_counter()
+            return x * time() + t0
+    """, select=["DV007"])
+    assert [f.code for f in found] == ["DV007", "DV007"]
+    assert "trace time" in found[0].message
+
+
+def test_dv007_from_import_random_in_jit():
+    assert [f.code for f in run("""
+        import jax
+        from random import randint
+
+        @jax.jit
+        def step(x):
+            return x + randint(0, 9)
+    """, select=["DV007"])] == ["DV007"]
+
+
+def test_dv007_rng_object_method_in_jit():
+    # np.random.default_rng() itself is DV005 territory; the *object's*
+    # method calls are only visible to DV007's assignment tracking
+    found = run("""
+        import jax
+        import numpy as np
+
+        rng = np.random.default_rng(0)
+
+        @jax.jit
+        def step(x):
+            return x + rng.normal()
+    """, select=["DV007"])
+    assert [f.code for f in found] == ["DV007"]
+    assert "rng.normal" in found[0].message
+
+
+def test_dv007_jax_random_alias_not_flagged():
+    # `from jax import random` is the sanctioned sampler, not stdlib
+    # impurity — the alias map must exclude it
+    assert run("""
+        import jax
+        from jax import random
+
+        @jax.jit
+        def step(x, key):
+            return x + random.normal(key)
+    """, select=["DV007"]) == []
+
+
+def test_dv007_host_use_outside_jit_not_flagged():
+    assert run("""
+        import numpy as np
+        from time import perf_counter
+
+        rng = np.random.default_rng(0)
+
+        def host_loop(x):
+            t0 = perf_counter()
+            return x + rng.normal() + t0
+    """, select=["DV007"]) == []
+
+
+def test_dv007_datetime_now_in_jit():
+    assert [f.code for f in run("""
+        import jax
+        import datetime
+
+        @jax.jit
+        def step(x):
+            return x * datetime.datetime.now().microsecond
+    """, select=["DV007"])] == ["DV007"]
+
+
+def test_dv007_datetime_constructor_is_pure():
+    # only .now()/.today() is impure; the class constructor is a literal
+    # (regression: the alias map used to register the class name as a
+    # bare-call trap and flag `datetime(1970, 1, 1)`)
+    found = run("""
+        import jax
+        from datetime import datetime
+
+        EPOCH = None
+
+        @jax.jit
+        def step(x):
+            epoch = datetime(1970, 1, 1)
+            return x + epoch.toordinal()
+    """, select=["DV007"])
+    assert found == []
+    assert [f.code for f in run("""
+        import jax
+        from datetime import datetime
+
+        @jax.jit
+        def step(x):
+            return x * datetime.now().microsecond
+    """, select=["DV007"])] == ["DV007"]
+
+
 # -- suppressions -------------------------------------------------------------
 
 def test_inline_suppression_same_line():

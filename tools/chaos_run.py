@@ -37,10 +37,17 @@ obs/merge.py):
                   host slow on three steps) must merge into one timeline
                   whose `straggler` events finger the slow host, passing
                   `check_journal --strict` and `obs_report --merged`.
+  6. locksmith    the runtime lock-order sanitizer (obs/locksmith.py) is
+                  armed in every child (DVT_LOCKSMITH=1) and around the
+                  in-process phases — all of which must journal ZERO
+                  `lock_order_violation` events — and a forced A->B/B->A
+                  inversion must be detected, journaled with both
+                  acquisition stacks, and pass `--strict`.
 
 Plus overhead probes: with no spec installed an injection point is one
-module-global load + None check, and flight recording (one tap call per
-journal event) must stay under 2% of the measured phase-1 step time.
+module-global load + None check, flight recording (one tap call per
+journal event) must stay under 2% of the measured phase-1 step time,
+and a disabled locksmith lock cycle pays the same None-check budget.
 
 Exit status 0 = every phase held; 1 = a contract is broken.
 """
@@ -135,7 +142,12 @@ def write_shards(data_dir: str) -> None:
 
 def run_child(train_args: List[str], log_path: str,
               timeout: float = 600.0) -> int:
-    env = dict(os.environ, PYTHONPATH=ROOT, JAX_PLATFORMS="cpu")
+    # every child trains with the runtime lock sanitizer armed
+    # (train_cli.arm_from_env): an inversion between the journal, flight,
+    # health-watchdog, or data-budget locks journals a typed
+    # lock_order_violation event the parent then asserts absent
+    env = dict(os.environ, PYTHONPATH=ROOT, JAX_PLATFORMS="cpu",
+               DVT_LOCKSMITH="1")
     # a parent-installed spec must never leak into a child that did not
     # ask for one (phase 3 resumes WITHOUT faults)
     env.pop("DVT_FAULT_SPEC", None)
@@ -311,6 +323,71 @@ def probe_autoprof(work: str, f: "Failures") -> None:
             "check_journal --strict accepts profile_capture events")
 
 
+def probe_locksmith(work: str, f: "Failures") -> None:
+    """The runtime half of the concurrency contracts (obs/locksmith.py):
+    a forced A->B / B->A inversion must be detected and journaled as a
+    typed `lock_order_violation` (passing --strict), and the DISABLED
+    wrapper must cost one module-global None check on top of the raw
+    primitive — the same budget as faults.fire and flight.note."""
+    import threading
+
+    from deep_vision_tpu.obs import RunJournal, locksmith
+    from deep_vision_tpu.obs.registry import Registry
+
+    j_path = os.path.join(work, "journal_locksmith.jsonl")
+    journal = RunJournal(j_path)
+    journal.manifest()
+    san = locksmith.arm(journal=journal, registry=Registry())
+    a = locksmith.lock("probe.A")
+    b = locksmith.lock("probe.B")
+    done = threading.Event()
+
+    def inverted():
+        # the second thread takes the locks in the OPPOSITE order —
+        # sequenced after the first path fully released, so the probe
+        # demonstrates detection without gambling on a real deadlock
+        with b:
+            with a:
+                done.set()
+
+    with a:
+        with b:
+            pass
+    t = threading.Thread(target=inverted, name="locksmith-probe")
+    t.start()
+    t.join(timeout=10)
+    f.check(done.is_set(), "forced-inversion probe thread completed")
+    v = san.violations()
+    f.check(len(v) == 1 and {v[0]["lock_a"], v[0]["lock_b"]}
+            == {"probe.A", "probe.B"},
+            f"runtime sanitizer detected the forced A->B/B->A inversion "
+            f"({len(v)} violation(s))")
+    rep = san.report()
+    f.check(rep["locks"].get("probe.A", {}).get("acquisitions", 0) >= 2,
+            "per-lock acquisition stats recorded")
+    locksmith.disarm()
+    journal.close()
+    ev = read_jsonl(j_path)
+    viol = [e for e in ev if e.get("event") == "lock_order_violation"]
+    f.check(len(viol) == 1 and viol[0].get("stack")
+            and viol[0].get("prior_stack"),
+            "violation journaled with both acquisition stacks")
+    f.check(check_journal_strict(j_path),
+            "check_journal --strict accepts lock_order_violation events")
+
+    # disabled-mode overhead: one global load + None check per op
+    lk = locksmith.lock("probe.idle")
+    n = 200_000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        with lk:
+            pass
+    ns = (time.perf_counter() - t0) / n * 1e9
+    f.check(ns < MAX_DISABLED_FIRE_NS,
+            f"disabled locksmith wrapper costs {ns:.0f}ns/cycle "
+            f"(< {MAX_DISABLED_FIRE_NS:.0f}ns)")
+
+
 def probe_obs_merge(work: str, f: "Failures") -> None:
     """Synthesize a 2-host run (host 1 straggling on three steps), merge
     via the tools/obs_merge.py CLI, and validate the straggler events,
@@ -468,11 +545,30 @@ def main(argv: Optional[List[str]] = None) -> int:
     # -- phase 4: induced regression -> exactly one capture per episode -
     print("phase 4: step-time regression triggers one profile_capture "
           "(cooldown + budget enforced)")
+    # phases 4-5 run in-process: arm the lock sanitizer around them so
+    # the journal/flight/registry lock traffic they generate runs
+    # order-checked, then assert it stayed clean
+    from deep_vision_tpu.obs import locksmith
+
+    parent_san = locksmith.arm()
     probe_autoprof(work, f)
 
     # -- phase 5: simulated 2-process run merges with a straggler -------
     print("phase 5: 2-host journal merge detects the straggler")
     probe_obs_merge(work, f)
+    f.check(not parent_san.violations(),
+            "locksmith: zero lock-order violations across the in-process "
+            "obs probes")
+    locksmith.disarm()
+
+    # -- phase 6: runtime lock sanitizer contracts ----------------------
+    print("phase 6: locksmith detects a forced inversion; disabled "
+          "wrapper stays at None-check cost")
+    probe_locksmith(work, f)
+    f.check("lock_order_violation" not in ev1
+            and not any(e.get("event") == "lock_order_violation"
+                        for e in ev3),
+            "armed children journaled zero lock_order_violation events")
 
     # -- disabled-injection overhead ------------------------------------
     ns = probe_disabled_overhead()

@@ -59,6 +59,8 @@ EVENT_FIELDS = {
     "serve_request": ("model", "latency_ms", "outcome"),
     "serve_batch": ("model", "bucket", "size"),
     "serve_drain": ("reason", "outcome", "accepted", "completed"),
+    "lock_order_violation": ("lock_a", "lock_b", "thread"),
+    "lock_contention": ("lock", "kind", "ms"),
     "note": (),
     "exit": ("status",),
     "crash": ("reason",),
@@ -77,6 +79,7 @@ FLIGHT_OUTCOMES = {"written", "failed"}
 SERVE_REQUEST_OUTCOMES = {"ok", "error", "rejected", "cancelled"}
 SERVE_DRAIN_REASONS = {"close", "sigterm"}
 SERVE_DRAIN_OUTCOMES = {"flushed", "timeout"}
+LOCK_CONTENTION_KINDS = {"hold", "wait"}
 
 
 def check_journal(path: str, require_exit: bool = False,
@@ -168,6 +171,19 @@ def check_journal(path: str, require_exit: bool = False,
             if row.get("outcome") not in SERVE_DRAIN_OUTCOMES:
                 errors.append(f"{path}:{i}: unknown serve_drain outcome "
                               f"{row.get('outcome')!r}")
+        if ev == "lock_contention":
+            if row.get("kind") not in LOCK_CONTENTION_KINDS:
+                errors.append(f"{path}:{i}: unknown lock_contention kind "
+                              f"{row.get('kind')!r}")
+            if not isinstance(row.get("ms"), (int, float)):
+                errors.append(f"{path}:{i}: lock_contention ms must be "
+                              f"numeric, got {row.get('ms')!r}")
+        if ev == "lock_order_violation":
+            for k in ("lock_a", "lock_b"):
+                if not isinstance(row.get(k), str) or not row.get(k):
+                    errors.append(f"{path}:{i}: lock_order_violation {k} "
+                                  f"must be a lock name, got "
+                                  f"{row.get(k)!r}")
         if ev == "straggler":
             if not isinstance(row.get("host"), int):
                 errors.append(f"{path}:{i}: straggler host must be a "

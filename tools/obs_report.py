@@ -84,6 +84,10 @@ def summarize_run(events: List[dict]) -> dict:
                        if e.get("event") == "profile_capture"]
     out["flight_dumps"] = [e for e in events
                            if e.get("event") == "flight_dump"]
+    out["lock_violations"] = [e for e in events
+                              if e.get("event") == "lock_order_violation"]
+    out["lock_contention"] = [e for e in events
+                              if e.get("event") == "lock_contention"]
     out["checkpoints"] = sum(
         1 for e in events if e.get("event") == "checkpoint" and e.get("saved"))
     out["benches"] = [e for e in events if e.get("event") == "bench"]
@@ -254,6 +258,35 @@ def render(summary: dict) -> str:
     for e in summary.get("flight_dumps", []):
         rows.append((f"flight {e.get('reason', '?')}",
                      f"{e.get('outcome', '?')} -> {e.get('dir', '?')}"))
+    # lock health (obs/locksmith.py events): the one-line answer to "did
+    # the serving plane's locking behave" — order violations are bugs,
+    # contention rows are the tuning signal (which lock, how long)
+    violations = summary.get("lock_violations", [])
+    contention = summary.get("lock_contention", [])
+    if violations or contention:
+        by_lock: Dict[str, List[float]] = {}
+        for e in contention:
+            if isinstance(e.get("ms"), (int, float)):
+                by_lock.setdefault(str(e.get("lock", "?")), []).append(
+                    float(e["ms"]))
+        parts = f"{len(violations)} order violation(s)"
+        if by_lock:
+            top = max(by_lock.items(), key=lambda kv: len(kv[1]))
+            holds = [float(e["ms"]) for e in contention
+                     if e.get("kind") == "hold"
+                     and isinstance(e.get("ms"), (int, float))]
+            parts += (f"; top contended {top[0]} ({len(top[1])}x, "
+                      f"worst {max(top[1]):.1f} ms)")
+            if holds:
+                parts += f"; max hold {max(holds):.1f} ms"
+        rows.append(("lock health", parts))
+        for e in violations[:4]:
+            rows.append(("  inversion",
+                         f"{e.get('lock_a')} -> {e.get('lock_b')} on "
+                         f"{e.get('thread', '?')} (reverse order seen on "
+                         f"{e.get('prior_thread', '?')})"))
+        if len(violations) > 4:
+            rows.append(("  ...", f"{len(violations) - 4} more inversions"))
     # health findings: one row per event, aggregated counts first so a
     # 10k-spike run stays readable (only the first few render verbatim)
     health = summary.get("health", [])

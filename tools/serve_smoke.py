@@ -23,7 +23,9 @@ proves the preemption path:
                   journal passes `check_journal --strict` (serve_*
                   schemas + trace), obs_report renders the serving
                   summary, and the flight dir is EMPTY — a healthy
-                  shutdown leaves no postmortem.
+                  shutdown leaves no postmortem. The runtime lock
+                  sanitizer (obs/locksmith.py), armed since startup,
+                  must report ZERO lock-order violations.
   5. sigterm      a child server under live traffic gets SIGTERM: it
                   must flush every accepted request, journal
                   `serve_drain(sigterm, flushed)`, leave a crc-valid
@@ -126,7 +128,12 @@ def child_main(argv: List[str]) -> int:
     args = p.parse_args(argv)
     import numpy as np
 
-    from deep_vision_tpu.obs import FlightRecorder, RunJournal, set_flight
+    from deep_vision_tpu.obs import (
+        FlightRecorder,
+        RunJournal,
+        locksmith,
+        set_flight,
+    )
     from deep_vision_tpu.serve import Engine, Server, ServerClosed
 
     work = args.workdir
@@ -138,6 +145,9 @@ def child_main(argv: List[str]) -> int:
                             run_id=journal.run_id)
     flight.attach(journal)
     set_flight(flight)
+    # the lock sanitizer rides the SIGTERM-drain path too: an inversion
+    # between the drain latch and the dispatchers would journal here
+    locksmith.arm(journal=journal)
 
     engine = Engine(journal=journal)
     for name, (fn, variables, buckets) in build_models(("pose",)).items():
@@ -162,9 +172,15 @@ def child_main(argv: List[str]) -> int:
     server.wait_for_stop()
     summary = server.drain("sigterm")
     t.join(timeout=5)
+    lock_report = locksmith.report()
+    locksmith.disarm()  # flushes any queued lock events into the journal
     flight.close()  # disarm the crash dump; the preempt bundle stays
     journal.close()
     print(f"drained: {summary}", flush=True)
+    if lock_report["violations"]:
+        print(f"locksmith: ORDER VIOLATIONS {lock_report['violations']}",
+              flush=True)
+        return 1
     return 0 if summary["outcome"] == "flushed" else 1
 
 
@@ -185,6 +201,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         FlightRecorder,
         RunJournal,
         Tracer,
+        locksmith,
         set_flight,
         set_tracer,
     )
@@ -207,6 +224,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     flight = FlightRecorder(flight_dir, run_id=journal.run_id)
     flight.attach(journal)
     set_flight(flight)
+    # arm the runtime lock sanitizer for the WHOLE serving run: warmup,
+    # mixed load, chaos, and drain all execute under order/hold checking,
+    # and phase 4 asserts the journal carries zero lock_order_violation
+    # events (obs/locksmith.py — the dynamic half of lint/concur.py)
+    locksmith.arm(journal=journal)
 
     # -- phase 1: AOT warmup, compile accounting ------------------------
     print("phase 1: AOT warmup compiles every (model, bucket) pair")
@@ -270,6 +292,13 @@ def main(argv: Optional[List[str]] = None) -> int:
     f.check(summary["outcome"] == "flushed" and summary["pending"] == 0,
             f"close drained everything ({summary})")
     print("  " + server.slo.render().replace("\n", "\n  "))
+    lock_report = locksmith.report()
+    f.check(not lock_report["violations"],
+            "locksmith: zero lock-order violations across warmup + load "
+            "+ chaos + drain"
+            + ("" if not lock_report["violations"]
+               else f" ({lock_report['violations'][0]})"))
+    locksmith.disarm()  # flush queued lock events before the journal closes
     tracer.close()
     set_tracer(None)
     flight.close()
@@ -296,6 +325,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     f.check(any(e.get("event") == "serve_batch"
                 and e.get("size", 0) < e.get("bucket", 0) for e in ev),
             "padding observed and journaled (occupancy < 100% somewhere)")
+    f.check(not any(e.get("event") == "lock_order_violation" for e in ev),
+            "journal carries zero lock_order_violation events")
 
     # -- phase 5: SIGTERM drain in a child server -----------------------
     print("phase 5: SIGTERM drain flushes in-flight requests + dumps "
@@ -350,6 +381,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         errs = validate_bundle(bundles[0])
         f.check(not errs, "preempt bundle structure + crc valid"
                 + ("" if not errs else f" ({errs[0]})"))
+    f.check(not any(e.get("event") == "lock_order_violation" for e in ev),
+            "sigterm journal carries zero lock_order_violation events "
+            "(locksmith armed through the drain)")
     f.check(check_journal_strict(jc),
             "check_journal --strict accepts the sigterm journal")
 
