@@ -51,7 +51,7 @@ lint-baseline:
 # obs-smoke and chaos-smoke — the telemetry artifacts must validate and
 # the resilience contracts must hold before the tests count
 verify: SHELL := /bin/bash
-verify: lint obs-smoke chaos-smoke serve-smoke
+verify: lint perf-smoke obs-smoke chaos-smoke serve-smoke
 	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
 
 # observability smoke: a tiny CPU train with tracing + health guard +
@@ -98,8 +98,26 @@ serve-smoke:
 chaos-smoke:
 	JAX_PLATFORMS=cpu python tools/chaos_run.py --workdir artifacts/chaos_smoke
 
+# perf smoke: the CPU-provable proxies behind the MFU attack — fused
+# Pallas kernels (bn_act, nms) match their lax references in interpret
+# mode, a multistep=4 Trainer superstep is step-for-step equivalent to 4
+# single dispatches with 4x fewer step events and ZERO recompiles after
+# warmup, the depth-2 device prefetcher never starves a slower consumer,
+# and check_journal --strict accepts the extended step/bench fields
+# (tools/perf_smoke.py)
+perf-smoke:
+	JAX_PLATFORMS=cpu python tools/perf_smoke.py --workdir artifacts/perf_smoke
+
 bench:
 	python bench.py
+
+# roofline anchored to the latest bench numbers: where the measured step
+# and each analytic layer sit vs the 197 TF/s / 819 GB/s pins and the
+# 30%-MFU baseline (deep_vision_tpu/tools/roofline.py --bench-json)
+BENCH_JSON ?= BENCH_r03.json
+roofline:
+	python -m deep_vision_tpu.tools.roofline --analytic \
+	  --bench-json $(BENCH_JSON) --out artifacts/roofline_bench.json
 
 # perf-evidence suite: every README perf claim regenerates from these
 bench-evidence:
@@ -135,4 +153,4 @@ ps:
 native:
 	$(MAKE) -C native
 
-.PHONY: train resume train-fg test lint lint-baseline verify obs-smoke chaos-smoke serve-smoke bench bench-evidence demo demo-gan demo-real dryrun tb ps native
+.PHONY: train resume train-fg test lint lint-baseline verify obs-smoke chaos-smoke serve-smoke perf-smoke bench bench-evidence roofline demo demo-gan demo-real dryrun tb ps native

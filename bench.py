@@ -133,6 +133,10 @@ def _emit(result: dict) -> bool:
         # descheduled before printing, the loser's path could reach
         # _hard_exit and kill the process with ZERO lines emitted
         print(json.dumps(result), flush=True)
+        # journal AFTER the stdout contract line (best-effort, never
+        # raises): emitting here — not per run mode — covers train, sweep,
+        # data AND the watchdog's degraded line with one code path
+        _journal_result(result)
     return True
 
 
@@ -616,6 +620,25 @@ def train_result_stub(args) -> dict:
     }
 
 
+#: RunJournal when --journal is set: the bench result then also lands as a
+#: typed `bench` event (same schema tools/bench_models.py writes), so
+#: BENCH_r0N trajectories are diffable with obs_report/check_journal and the
+#: multistep/per-microstep fields are queryable instead of stdout-only
+_JOURNAL = None
+
+
+def _journal_result(result: dict) -> None:
+    """Best-effort: the stdout contract line must never die to a journal
+    I/O error."""
+    if _JOURNAL is None:
+        return
+    try:
+        _JOURNAL.bench(result.get("metric", "bench"), result)
+        _JOURNAL.close()
+    except Exception as e:
+        _log(f"journal write failed ({type(e).__name__}: {e})")
+
+
 def main(args, result: dict | None = None) -> None:
     if result is None:
         result = train_result_stub(args)
@@ -643,6 +666,14 @@ def main(args, result: dict | None = None) -> None:
         wall_per_chip = batch_size / n_chips / float(np.median(window_dts))
         result["value"] = round(wall_per_chip, 1)
         result["vs_baseline"] = round(wall_per_chip / TARGET_PER_CHIP, 3)
+        # per-MICROSTEP wall time + the dispatch arithmetic: without these a
+        # multistep>1 round is incomparable to a multistep=1 one (the r0N
+        # trajectory would silently mix steps-per-dispatch regimes)
+        result["wall_ms_per_step"] = round(
+            float(np.median(window_dts)) * 1e3, 3)
+        result["dispatches_per_window"] = max(
+            1, math.ceil(TIMED_STEPS / args.multistep))
+        result["steps_per_dispatch"] = args.multistep
 
         # MFU / HBM traffic from XLA's post-fusion cost analysis (falls back
         # to analytic ResNet-50 flops). All per-chip: cost analysis is
@@ -686,6 +717,7 @@ def main(args, result: dict | None = None) -> None:
         if dev_ms is not None:
             dev_per_chip = batch_size / n_chips / (dev_ms / 1e3)
             _log(f"device step {dev_ms:.1f} ms")
+            result["device_ms_per_step"] = round(dev_ms, 3)  # per microstep
             result["device_images_per_sec_per_chip"] = round(dev_per_chip, 1)
             result["device_vs_baseline"] = round(
                 dev_per_chip / TARGET_PER_CHIP, 3
@@ -892,7 +924,18 @@ if __name__ == "__main__":
                         help="flight recorder (obs/flight.py): dump a "
                              "postmortem bundle under DIR if the bench "
                              "dies (recovery breadcrumbs included)")
+    parser.add_argument("--journal", default=None, metavar="PATH",
+                        help="also write the result as a typed `bench` "
+                             "journal event (obs/journal.py schema; "
+                             "validate with tools/check_journal.py)")
     args = parser.parse_args()
+    if args.journal:
+        from deep_vision_tpu.obs.journal import RunJournal
+
+        _JOURNAL = RunJournal(args.journal, kind="bench")
+        _JOURNAL.manifest(config={"tool": "bench", "batch": args.batch,
+                                  "multistep": args.multistep,
+                                  "data": args.data, "sweep": args.sweep})
     if args.flight_dir:
         from deep_vision_tpu.obs import FlightRecorder, set_flight
 

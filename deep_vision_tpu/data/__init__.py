@@ -35,8 +35,11 @@ from deep_vision_tpu.data.datasets import (
 )
 from deep_vision_tpu.data import transforms
 from deep_vision_tpu.data.pipeline import DataLoader, Compose
+from deep_vision_tpu.data.device_prefetch import DevicePrefetcher, PlacedBatch
 
 __all__ = [
+    "DevicePrefetcher",
+    "PlacedBatch",
     "BadRecordBudget",
     "BadRecordBudgetExceeded",
     "decode_example",

@@ -417,7 +417,12 @@ class DataLoader:
             yield batch
 
     def __iter__(self) -> Iterator[dict]:
-        """Yield batches, producing up to `prefetch` ahead on a thread."""
+        """Yield batches, producing up to `prefetch` ahead on a thread.
+
+        This is the HOST half of the prefetch story (decode/augment
+        latency); the DEVICE half — overlapping the H2D transfer itself
+        with compute — is data/device_prefetch.py, which the Trainer
+        stacks on top of this iterator (`--device-prefetch`)."""
         if self.prefetch <= 0:
             yield from self._batches()
             return

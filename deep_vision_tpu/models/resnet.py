@@ -30,12 +30,17 @@ class BasicBlock(nn.Module):
     def __call__(self, x, train: bool = True):
         residual = x
         y = ConvBN(self.features, (3, 3), strides=self.strides, dtype=self.dtype)(x, train)
-        y = ConvBN(self.features, (3, 3), act=None, dtype=self.dtype)(y, train)
-        if residual.shape != y.shape:
+        # tail: BN + skip-add + ReLU fold into one pass (nn/layers.py
+        # ConvBN residual arg -> ops/pallas/bn_act.py on TPU). Constructed
+        # before the projection so flax auto-names (ConvBN_1 here, ConvBN_2
+        # for the projection) — and with them every checkpoint — keep the
+        # exact pre-fusion variable-tree paths.
+        tail = ConvBN(self.features, (3, 3), act=nn.relu, dtype=self.dtype)
+        if x.shape[-1] != self.features or self.strides != (1, 1):
             residual = ConvBN(
                 self.features, (1, 1), strides=self.strides, act=None, dtype=self.dtype
             )(x, train)
-        return nn.relu(y + residual)
+        return tail(y, train, residual=residual)
 
 
 class BottleneckBlock(nn.Module):
@@ -51,16 +56,22 @@ class BottleneckBlock(nn.Module):
         # zero-init the last BN scale so each block starts as identity
         # (standard TPU ResNet recipe; improves large-batch training)
         y = nn.Conv(self.features * 4, (1, 1), use_bias=False, dtype=self.dtype)(y)
-        y = FusedBatchNorm(
+        # the block tail — BN apply + skip-add + ReLU — is ONE fused pass on
+        # TPU (ops/pallas/bn_act.py; act/residual args on nn/layers.py
+        # BatchNorm). Constructed before the projection ConvBN so flax
+        # auto-names (BatchNorm_0, ConvBN_2) keep the pre-fusion
+        # variable-tree paths and checkpoints stay interchangeable.
+        bn = FusedBatchNorm(
             use_running_average=not train,
             momentum=0.9,
             scale_init=nn.initializers.zeros_init(),
-        )(y)
-        if residual.shape != y.shape:
+            act="relu",
+        )
+        if x.shape[-1] != self.features * 4 or self.strides != (1, 1):
             residual = ConvBN(
                 self.features * 4, (1, 1), strides=self.strides, act=None, dtype=self.dtype
             )(x, train)
-        return nn.relu(y + residual)
+        return bn(y, residual=residual)
 
 
 class PreActBottleneckBlock(nn.Module):

@@ -97,9 +97,17 @@ class BatchNorm(nn.Module):
     scale_init: Callable = nn.initializers.ones
     bias_init: Callable = nn.initializers.zeros
     dtype: Optional[jnp.dtype] = None  # output/compute dtype; None = x.dtype
+    # act='relu' (and/or a `residual` call arg) folds the activation and the
+    # skip-add into the normalize. With the Pallas fusion enabled
+    # (ops/pallas/bn_act.fusion_enabled: TPU default, DVT_PALLAS_FUSED
+    # forces) the whole tail runs as ONE kernel pass — the big tensor
+    # crosses HBM once instead of once per op; disabled, the math is the
+    # exact pre-kernel sequence so existing numerics never drift.
+    act: Optional[str] = None
 
     @nn.compact
-    def __call__(self, x, use_running_average: Optional[bool] = None):
+    def __call__(self, x, use_running_average: Optional[bool] = None,
+                 residual=None):
         use_ra = (
             self.use_running_average
             if use_running_average is None
@@ -129,6 +137,25 @@ class BatchNorm(nn.Module):
                 ra_var.value = m * ra_var.value + (1 - m) * var
         inv = scale * jax.lax.rsqrt(var + self.epsilon)
         dt = self.dtype or x.dtype
+        if self.act is not None or residual is not None:
+            from deep_vision_tpu.ops.pallas import bn_act as _bn_act
+
+            if _bn_act.fusion_enabled():
+                # folded apply (x*a + b) is safe here: the kernel computes
+                # in f32 internally, so the bf16-cancellation concern below
+                # does not apply inside it
+                y = _bn_act.fused_scale_bias_act(
+                    x, inv, bias - mean * inv, residual=residual,
+                    act=self.act)
+                return y.astype(dt)
+            y = (x.astype(jnp.float32) - mean) * inv + bias
+            if residual is not None:
+                y = y + residual.astype(jnp.float32)
+            if self.act == "relu":
+                y = jnp.maximum(y, 0.0)
+            elif self.act is not None:
+                raise ValueError(f"unsupported act {self.act!r}")
+            return y.astype(dt)
         # normalize in f32 *inside the fusion*: per-element upcast costs no
         # HBM traffic (XLA fuses the converts), and subtracting the mean
         # before scaling avoids the bf16 cancellation of a folded x*a + b
@@ -159,7 +186,7 @@ class ConvBN(nn.Module):
     dtype: Optional[jnp.dtype] = None
 
     @nn.compact
-    def __call__(self, x, train: bool = True):
+    def __call__(self, x, train: bool = True, residual=None):
         x = nn.Conv(
             self.features,
             self.kernel,
@@ -171,10 +198,20 @@ class ConvBN(nn.Module):
             dtype=self.dtype,
         )(x)
         if self.use_bn:
+            # ReLU (and a skip tensor, when the caller passes one) fold into
+            # the BN apply — one fused pass on TPU (ops/pallas/bn_act.py),
+            # the identical unfused sequence elsewhere
+            fuse_relu = self.act is nn.relu
             x = FusedBatchNorm(
                 use_running_average=not train,
                 momentum=self.bn_momentum,
-            )(x)
+                act="relu" if fuse_relu else None,
+            )(x, residual=residual)
+            if self.act is not None and not fuse_relu:
+                x = self.act(x)
+            return x
+        if residual is not None:
+            x = x + residual
         if self.act is not None:
             x = self.act(x)
         return x

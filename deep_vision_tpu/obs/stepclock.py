@@ -223,6 +223,8 @@ class _StepRecord:
         self.sampled = sampled
         self.step: Optional[int] = None  # caller may set the optimizer step
         self.metrics: dict = {}
+        self.extra: dict = {}  # caller-supplied journal fields (e.g. the
+                               # multistep width of a scan superstep)
         self.dispatch_ms = 0.0
         self.sync_ms: Optional[float] = None
         self.step_time_ms = 0.0
@@ -257,10 +259,13 @@ class _StepRecord:
         return False
 
     def commit(self, step: Optional[int] = None,
-               metrics: Optional[dict] = None) -> None:
+               metrics: Optional[dict] = None,
+               extra: Optional[dict] = None) -> None:
         """Close the record and write registry/journal. step_time_ms spans
         enter -> commit, so deferred-commit callers fold their post-dispatch
-        host fetches into the step total without widening dispatch_ms."""
+        host fetches into the step total without widening dispatch_ms.
+        `extra` fields ride the journal step event verbatim (unknown step
+        fields are forward-compatible by the check_journal schema)."""
         if self._committed:
             return
         self._committed = True
@@ -268,6 +273,8 @@ class _StepRecord:
             self.step = step
         if metrics is not None:
             self.metrics = metrics
+        if extra:
+            self.extra.update(extra)
         self.step_time_ms = self.data_wait_ms + (
             time.perf_counter() - self._t0) * 1e3
         if self.batch_size and self.step_time_ms > 0:
@@ -290,6 +297,8 @@ class _StepRecord:
             out["hbm_bytes"] = self.hbm_bytes
         if self.hbm_peak_bytes is not None:
             out["hbm_peak_bytes"] = self.hbm_peak_bytes
+        if self.extra:
+            out.update(self.extra)
         if self.metrics:
             out["metrics"] = {k: float(v) for k, v in self.metrics.items()}
         return out

@@ -7,15 +7,40 @@ a static-shape algorithm: select max_detections boxes iteratively with
 compiles once and runs on-device. Multi-label (per-class scores thresholded
 independently, postprocess.py:58-63) with class offsets so one pass handles
 all classes.
+
+Two interchangeable selection backends behind `impl=`:
+  - 'lax'    — the vmapped `_nms_single` fori_loop below (the reference);
+  - 'pallas' — ops/pallas/nms.py, the same greedy loop pinned in VMEM
+    (one grid step per image, no HBM round-trip per selection; runs the
+    identical kernel under `interpret=True` off-TPU).
+Default ('auto'): pallas on TPU, lax elsewhere; `DVT_NMS_IMPL=lax|pallas`
+forces either (the disable flag for a suspicious-decode triage).
 """
 from __future__ import annotations
 
-from typing import Tuple
+import os
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 
 from deep_vision_tpu.ops.boxes import broadcast_iou
+
+
+def _resolve_impl(impl: Optional[str]) -> str:
+    if impl in ("lax", "pallas"):
+        return impl
+    if impl not in (None, "auto"):
+        raise ValueError(f"unknown NMS impl {impl!r} (lax|pallas|auto)")
+    env = os.environ.get("DVT_NMS_IMPL")
+    if env:
+        if env not in ("lax", "pallas"):
+            # the disable flag exists for triage — a typo ('LAX', trailing
+            # space) silently running the suspect kernel defeats it
+            raise ValueError(
+                f"DVT_NMS_IMPL={env!r} is not 'lax' or 'pallas'")
+        return env
+    return "pallas" if jax.default_backend() == "tpu" else "lax"
 
 
 def _nms_single(boxes, scores, max_detections: int, iou_threshold: float,
@@ -57,12 +82,14 @@ def non_maximum_suppression(
     max_detections: int = 100,
     iou_threshold: float = 0.5,
     score_threshold: float = 0.5,
+    impl: Optional[str] = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Batched class-aware NMS.
 
     boxes: (B, N, 4) xyxy in [0,1]; scores: (B, N); classes: (B, N) int or None.
     Returns (boxes (B,D,4), scores (B,D), classes (B,D), valid (B,) count),
     D = max_detections. Padded entries have score 0 and class -1.
+    impl: 'lax' | 'pallas' | None/'auto' (see module docstring).
     """
     if classes is None:
         classes = jnp.zeros(scores.shape, jnp.int32)
@@ -71,16 +98,32 @@ def non_maximum_suppression(
     offsets = classes.astype(boxes.dtype)[..., None] * 2.0
     shifted = boxes + offsets
 
-    def per_image(b, s, c, raw_b):
-        sel_s, sel_i = _nms_single(
-            b, s, max_detections, iou_threshold, score_threshold
-        )
-        sel_c = jnp.where(sel_i >= 0, c[jnp.maximum(sel_i, 0)], -1)
-        out_b = jnp.where((sel_i >= 0)[:, None], raw_b[jnp.maximum(sel_i, 0)], 0.0)
-        return out_b, sel_s, sel_c
+    if _resolve_impl(impl) == "pallas":
+        from deep_vision_tpu.ops.pallas.nms import pallas_nms
 
-    out_boxes, out_scores, out_classes = jax.vmap(per_image)(
-        shifted, scores, classes, boxes
-    )
+        sel_s, sel_i = pallas_nms(
+            shifted, scores, max_detections, iou_threshold, score_threshold
+        )
+        sel_s = sel_s.astype(scores.dtype)
+        safe = jnp.maximum(sel_i, 0)
+        picked = sel_i >= 0  # (B, D)
+        out_classes = jnp.where(
+            picked, jnp.take_along_axis(classes, safe, axis=1), -1)
+        out_boxes = jnp.where(
+            picked[..., None],
+            jnp.take_along_axis(boxes, safe[..., None], axis=1), 0.0)
+        out_scores = sel_s
+    else:
+        def per_image(b, s, c, raw_b):
+            sel_s, sel_i = _nms_single(
+                b, s, max_detections, iou_threshold, score_threshold
+            )
+            sel_c = jnp.where(sel_i >= 0, c[jnp.maximum(sel_i, 0)], -1)
+            out_b = jnp.where((sel_i >= 0)[:, None], raw_b[jnp.maximum(sel_i, 0)], 0.0)
+            return out_b, sel_s, sel_c
+
+        out_boxes, out_scores, out_classes = jax.vmap(per_image)(
+            shifted, scores, classes, boxes
+        )
     valid = jnp.sum((out_classes >= 0).astype(jnp.int32), axis=-1)
     return out_boxes, out_scores, out_classes, valid

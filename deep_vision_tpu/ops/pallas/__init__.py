@@ -5,6 +5,20 @@ SURVEY.md §2); here the hot ops XLA can't fuse optimally get hand-written
 TPU kernels with lax fallbacks for non-TPU platforms and interpret-mode
 tests on CPU.
 """
+from deep_vision_tpu.ops.pallas.bn_act import (
+    fused_bn_act,
+    fused_scale_bias_act,
+    fusion_enabled,
+    reference_scale_bias_act,
+)
 from deep_vision_tpu.ops.pallas.flash_attention import flash_attention
+from deep_vision_tpu.ops.pallas.nms import pallas_nms
 
-__all__ = ["flash_attention"]
+__all__ = [
+    "flash_attention",
+    "fused_bn_act",
+    "fused_scale_bias_act",
+    "fusion_enabled",
+    "pallas_nms",
+    "reference_scale_bias_act",
+]

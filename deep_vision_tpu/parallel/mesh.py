@@ -89,6 +89,13 @@ def data_sharding(mesh: Mesh, ndim: int = 1) -> NamedSharding:
     return NamedSharding(mesh, P(DATA_AXIS, *([None] * (ndim - 1))))
 
 
+def stacked_data_sharding(mesh: Mesh, ndim: int = 2) -> NamedSharding:
+    """Sharding for a (K, B, ...) stacked superstep batch (train/trainer.py
+    multistep mode): the scan axis K replicates, the batch dim shards over
+    'data' — each dispatch carries K microsteps' batches in one transfer."""
+    return NamedSharding(mesh, P(None, DATA_AXIS, *([None] * (ndim - 2))))
+
+
 def shard_batch(mesh: Mesh, batch):
     """Place a host batch (pytree of np/jnp arrays) with batch-dim sharding.
 

@@ -81,6 +81,46 @@ def make_schedule(kind: str = "constant", base_lr: float = 0.1, **kw) -> Schedul
     raise ValueError(f"unknown schedule '{kind}'")
 
 
+def _cast_float_leaves(tree, dtype):
+    """Cast floating-point array leaves; ints (step counters) untouched."""
+
+    def cast(x):
+        if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating):
+            return x.astype(dtype)
+        return x
+
+    return jax.tree_util.tree_map(cast, tree)
+
+
+def cast_optimizer_state(
+    tx: optax.GradientTransformation,
+    state_dtype,
+    compute_dtype=jnp.float32,
+) -> optax.GradientTransformation:
+    """Store optimizer state (momentum, Adam moments) in `state_dtype`.
+
+    The SGD+momentum update reads and rewrites a full params-sized trace
+    every step; at f32 that is 2x params bytes of pure HBM traffic per
+    step on top of the weights themselves. Storing the trace in bf16
+    halves it (the roofline's `params` rows in tools/roofline.py price
+    this directly). The update itself still runs in `compute_dtype`: state
+    is upcast entering the wrapped transform and the new state rounded
+    back on the way out — one rounding per step, the same error model as
+    bf16 gradient accumulation. Float leaves only; step counters and other
+    integer state pass through untouched.
+    """
+
+    def init(params):
+        return _cast_float_leaves(tx.init(params), state_dtype)
+
+    def update(updates, state, params=None, **extra):
+        state = _cast_float_leaves(state, compute_dtype)
+        updates, new_state = tx.update(updates, state, params, **extra)
+        return updates, _cast_float_leaves(new_state, state_dtype)
+
+    return optax.GradientTransformation(init, update)
+
+
 def build_optimizer(
     name: str,
     learning_rate: Schedule,
@@ -88,12 +128,18 @@ def build_optimizer(
     weight_decay: float = 0.0,
     decay_bn_bias: bool = False,
     grad_clip_norm: Optional[float] = None,
+    state_dtype=None,
     **kw,
 ) -> optax.GradientTransformation:
     """Build an injectable optimizer. `learning_rate` may be float or schedule.
 
     Returned transformation always has `opt_state.hyperparams['learning_rate']`
     (via inject_hyperparams) so host-side plateau schedules can override it.
+    `state_dtype` (e.g. jnp.bfloat16 / 'bfloat16') stores the optimizer
+    state — momentum, Adam moments — in that dtype via
+    `cast_optimizer_state`, halving the update's HBM traffic at bf16; the
+    injected hyperparams (learning_rate) stay f32 so plateau writes and
+    schedules are unaffected.
     """
 
     def _make(learning_rate):
@@ -162,7 +208,13 @@ def build_optimizer(
             )
         else:
             raise ValueError(f"unknown optimizer '{name}'")
-        return optax.chain(*chain)
+        tx = optax.chain(*chain)
+        if state_dtype is not None:
+            # cast INSIDE inject_hyperparams: the hyperparams dict (and the
+            # LR the plateau writes into it) stays f32, only the big
+            # params-shaped state rounds to state_dtype
+            tx = cast_optimizer_state(tx, jnp.dtype(state_dtype))
+        return tx
 
     return optax.inject_hyperparams(_make)(learning_rate=learning_rate)
 

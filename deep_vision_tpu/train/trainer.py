@@ -28,6 +28,7 @@ import optax
 
 from deep_vision_tpu.core.metrics import MetricLogger
 from deep_vision_tpu.core.train_state import TrainState, create_train_state
+from deep_vision_tpu.data.device_prefetch import DevicePrefetcher, PlacedBatch
 from deep_vision_tpu.obs.stepclock import StepClock
 from deep_vision_tpu.obs.trace import span
 from deep_vision_tpu.parallel.mesh import (
@@ -36,6 +37,7 @@ from deep_vision_tpu.parallel.mesh import (
     pad_batch_to,
     replicated,
     shard_batch,
+    stacked_data_sharding,
 )
 
 # one shared jitted sum: evaluate() calls it per masked multi-host batch,
@@ -55,6 +57,13 @@ class Trainer:
 
     loss_fn(outputs, batch) -> (loss, metrics_dict). The model is applied to
     `batch[input_key]` with `train=True/False` and a 'dropout' rng.
+
+    Step-time knobs (README "Making it fast"): `multistep=K` runs K
+    optimizer steps per device dispatch as one lax.scan superstep
+    (per-microstep metrics/NaN-guard preserved, step counters advance by
+    K; incompatible with checkify/EMA); `device_prefetch=N` places the
+    next N batches on the mesh from a producer thread so H2D transfer
+    overlaps compute (data/device_prefetch.py).
     """
 
     def __init__(
@@ -82,6 +91,8 @@ class Trainer:
         lr_schedule=None,  # the optax schedule behind tx, for current_lr
         health=None,  # obs.HealthMonitor or None
         autoprof=None,  # obs.AutoProfiler; built from profile_dir if None
+        multistep: int = 1,  # optimizer steps per dispatch (lax.scan)
+        device_prefetch: int = 0,  # device-resident batch buffer depth
     ):
         self.mesh = mesh if mesh is not None else create_mesh()
         self.model = model  # single source of truth for summaries/export
@@ -203,6 +214,48 @@ class Trainer:
             )
         self._eval_step = jax.jit(self._eval_step_impl)
 
+        # -- scan-multistep: K optimizer steps per dispatch ----------------
+        # One lax.scan over a (K, B, ...) stacked batch amortizes the
+        # per-dispatch host turnaround K-fold (bench.py measured the
+        # mechanism; this is the first-class Trainer mode). The scan body
+        # IS `_train_step_impl`, so per-microstep RNG (fold_in on the
+        # advancing state.step), metrics, and the skip_step NaN-guard all
+        # apply per microstep; the epoch tail (fewer than K batches left)
+        # rides the single-step executable so neither ever recompiles.
+        self.multistep = max(1, int(multistep))
+        self._train_multi = None
+        if self.multistep > 1:
+            if checkify_errors:
+                raise ValueError(
+                    "multistep > 1 is incompatible with checkify: the "
+                    "sanitizer needs the un-scanned per-step boundary to "
+                    "locate the failing op — debug at multistep=1"
+                )
+            if ema_decay is not None:
+                raise ValueError(
+                    "multistep > 1 is incompatible with ema_decay: the EMA "
+                    "shadow updates once per HOST dispatch, so K scanned "
+                    "microsteps would decay it once instead of K times and "
+                    "silently change eval — run EMA at multistep=1"
+                )
+            self._train_multi = jax.jit(
+                self._multistep_impl, donate_argnums=0
+            )
+        # device prefetch: pad/shard/device_put the NEXT batch(es) on a
+        # producer thread so H2D transfer overlaps the current step's
+        # compute (data/device_prefetch.py); depth 2 = double buffering
+        self.device_prefetch = max(0, int(device_prefetch))
+        self._prefetcher = None
+        if self.device_prefetch > 0:
+            self._prefetcher = DevicePrefetcher(
+                place_one=self._place_one,
+                depth=self.device_prefetch,
+                group=self.multistep,
+                place_group=(self._place_group
+                             if self.multistep > 1 else None),
+                registry=self.clock.registry,
+            )
+
     # -- jitted steps ------------------------------------------------------
     def _train_step_impl(self, state: TrainState, batch):
         step_rng = jax.random.fold_in(state.rng, state.step)
@@ -252,6 +305,19 @@ class Trainer:
         _, metrics = self.eval_loss_fn(outputs, batch)
         return metrics
 
+    def _multistep_impl(self, state: TrainState, batches):
+        """K optimizer steps over a (K, B, ...) stacked batch, one dispatch.
+
+        The scan body is the exact single-step impl: state.step advances
+        inside apply_gradients, so per-microstep RNG derivation
+        (fold_in(rng, step)) and the skip_step finiteness select match K
+        separate dispatches bit for bit. Returns (state, metrics) with
+        every metric leaf stacked (K,) — the per-microstep record the host
+        loop un-stacks for loggers/health."""
+        return jax.lax.scan(
+            lambda s, b: self._train_step_impl(s, b), state, batches
+        )
+
     # -- host API ----------------------------------------------------------
     def _pad_and_mask(self, batch):
         """Pad the final partial batch up to the data-axis multiple and attach
@@ -272,6 +338,58 @@ class Trainer:
             mask[:n_valid] = 1.0
             batch["_mask"] = mask
         return batch
+
+    # -- batch placement (device prefetch + multistep stacking) ------------
+    @staticmethod
+    def _pad_rows_to(batch: dict, n: int) -> dict:
+        """Zero-pad every leaf's leading dim to `n` rows; the '_mask'
+        zeros added with them keep the rows out of every masked mean."""
+        out = {}
+        for k, v in batch.items():
+            v = np.asarray(v)
+            if v.shape[0] < n:
+                pad = [(0, n - v.shape[0])] + [(0, 0)] * (v.ndim - 1)
+                v = np.pad(v, pad)
+            out[k] = v
+        return out
+
+    def _place_one(self, batch) -> PlacedBatch:
+        """Host batch -> padded/masked/sharded on the mesh (the work
+        train_step otherwise does on the critical path)."""
+        n = int(np.shape(batch[self.input_key])[0])
+        placed = shard_batch(self.mesh, self._pad_and_mask(batch))
+        return PlacedBatch(placed, n, 1)
+
+    def _place_group(self, batches) -> PlacedBatch:
+        """K host batches -> one (K, B, ...) stacked superstep batch."""
+        n = sum(int(np.shape(b[self.input_key])[0]) for b in batches)
+        return PlacedBatch(self._stack_batches(batches), n, len(batches))
+
+    def _stack_batches(self, batches):
+        """Pad/mask each batch, stack leaves along a new scan axis, and
+        place with the (replicated-K, sharded-B) layout.
+
+        A partial final batch inside the group (drop_remainder=False) is
+        additionally zero-padded up to the group's common batch size with
+        its '_mask' extended accordingly — mask-aware losses/metrics ignore
+        the extra rows exactly as they ignore the data-axis padding at
+        multistep=1, and np.stack sees uniform shapes."""
+        padded = [self._pad_and_mask(b) for b in batches]
+        sizes = [np.asarray(p[self.input_key]).shape[0] for p in padded]
+        n_max = max(sizes)
+        if min(sizes) != n_max:
+            padded = [p if n == n_max else self._pad_rows_to(p, n_max)
+                      for p, n in zip(padded, sizes)]
+
+        def _stack(*xs):
+            return np.stack([np.asarray(x) for x in xs])
+
+        stacked = jax.tree_util.tree_map(_stack, *padded)
+        return jax.tree_util.tree_map(
+            lambda x: jax.device_put(
+                x, stacked_data_sharding(self.mesh, x.ndim)),
+            stacked,
+        )
 
     @property
     def _profiling(self) -> bool:
@@ -297,7 +415,10 @@ class Trainer:
 
     def train_step(self, batch) -> dict:
         self._profiler_hook()
-        batch = shard_batch(self.mesh, self._pad_and_mask(batch))
+        if isinstance(batch, PlacedBatch):
+            batch = batch.data  # device prefetcher already padded + placed
+        else:
+            batch = shard_batch(self.mesh, self._pad_and_mask(batch))
         if self._checkify:
             err, (new_state, metrics) = self._train_step_err(self.state, batch)
             err.throw()  # located NaN/OOB/div0 inside the step, if any
@@ -307,6 +428,28 @@ class Trainer:
         if self.ema is not None:
             self.ema.update(self.state.params)
         return metrics
+
+    def train_superstep(self, batches) -> list:
+        """K optimizer steps in ONE dispatch (requires multistep > 1).
+
+        `batches`: a list of K host batch dicts, or a PlacedBatch the
+        device prefetcher stacked ahead of time. Returns K per-microstep
+        metric dicts (device scalars — fetch once, not per key)."""
+        if self._train_multi is None:
+            raise ValueError("train_superstep needs Trainer(multistep=K>1)")
+        self._profiler_hook()
+        if isinstance(batches, PlacedBatch):
+            k, stacked = batches.group, batches.data
+        else:
+            k, stacked = len(batches), self._stack_batches(batches)
+        if k != self.multistep:
+            raise ValueError(
+                f"superstep got {k} batches, configured multistep is "
+                f"{self.multistep} (the epoch tail must use train_step)"
+            )
+        self.state, metrics = self._train_multi(self.state, stacked)
+        return [jax.tree_util.tree_map(lambda v, i=i: v[i], metrics)
+                for i in range(k)]
 
     def eval_step(self, batch) -> dict:
         batch = shard_batch(self.mesh, self._pad_and_mask(batch))
@@ -500,64 +643,165 @@ class Trainer:
                   f"the save (latest on disk: {self.ckpt.latest_step()}); "
                   "exiting fit", flush=True)
 
+    def _grouped(self, data):
+        """Coalesce host batches into lists of `multistep` for the scan
+        superstep; the short epoch tail flows through as single batches so
+        the stacked executable never sees a ragged shape (no recompile)."""
+        pending = []
+        for batch in data:
+            pending.append(batch)
+            if len(pending) == self.multistep:
+                yield pending
+                pending = []
+        for batch in pending:
+            yield batch
+
     def _run_epoch(self, train_data_fn, epoch):
-        """One epoch of steps; returns ("preempted"|None, logger summary)."""
+        """One epoch of steps; returns ("preempted"|None, logger summary).
+
+        Three data paths share this loop: plain host batches, device-
+        prefetched PlacedBatches (H2D already off the critical path), and
+        multistep groups (one dispatch = K optimizer steps) — the latter
+        two composed by the prefetcher itself when both are on. The
+        grouping/prefetch stage sits INSIDE clock.iter_data so data_wait
+        honestly covers the whole wait for a dispatch's worth of input."""
         self.logger.start_epoch()
-        for batch in self.clock.iter_data(train_data_fn()):
-            n = np.shape(batch[self.input_key])[0]
-            with span("train/step", epoch=epoch) as sp:
-                with self.clock.step(batch_size=n, auto_commit=False) as rec:
-                    metrics = self.train_step(batch)
-                    rec.fence_on(metrics)
-                # these fetches block on the in-flight state — outside the
-                # with-block so dispatch_ms stays enqueue-only (the
-                # starvation signal compares data_wait against it);
-                # commit() folds their cost into step_time_ms
-                opt_step = int(self.state.step)
-                lr = self.lr_at(opt_step)
-                sp.set(step=opt_step)
-                rec.commit(step=opt_step,
-                           metrics={"loss": metrics["loss"], "lr": lr}
-                           if "loss" in metrics else {"lr": lr})
-            # anomaly triggers see the committed record (step-time/data-wait
-            # z-scores, recompile bursts, HBM high-water jumps) and arm a
-            # capture that the NEXT step's _profiler_hook starts
-            if self.prof is not None:
-                self.prof.observe_step(opt_step, rec.fields())
-            # one host fetch for loggers + health (log_step floats every
-            # metric anyway, so this adds no extra device sync)
-            metrics_f = {k: float(v) for k, v in metrics.items()}
-            loss_f = metrics_f.get("loss")
-            grad_norm_f = metrics_f.get("grad_norm")
-            skipped = (self._skip_nonfinite
-                       and metrics_f.get("skipped", 0.0) > 0)
-            if skipped:
-                # the discarded update's loss/grads are garbage: keep them
-                # out of the epoch means and TB series — the health event
-                # and skipped counter (below) carry the record instead
-                metrics_f = {k: v for k, v in metrics_f.items()
-                             if v == v and abs(v) != float("inf")}
-            # (train_learning_rate gauge: MetricLogger's NaN-guarded write)
-            self.logger.log_step(
-                opt_step, metrics_f, batch_size=n, epoch=epoch,
-                lr=lr, data_wait_ms=rec.data_wait_ms,
-                examples_per_sec=rec.examples_per_sec,
-            )
-            # health guard AFTER the step/log writes: an abort's journal
-            # then reads step -> health(non_finite) -> crash, in order
-            if self.health is not None:
-                self.health.check_step(opt_step, loss=loss_f,
-                                       grad_norm=grad_norm_f,
-                                       skipped=skipped)
-            # poll keyed to the optimizer step — globally consistent across
-            # hosts, immune to unequal agreed() call counts elsewhere
-            if self._pguard is not None and self._pguard.agreed(step=opt_step):
+        data = train_data_fn()
+        if self._prefetcher is not None:
+            data = self._prefetcher(data)
+        elif self.multistep > 1:
+            data = self._grouped(data)
+        for item in self.clock.iter_data(data):
+            is_group = isinstance(item, list) or (
+                isinstance(item, PlacedBatch) and item.group > 1)
+            if is_group:
+                status = self._superstep_and_log(item, epoch)
+            else:
+                status = self._single_step_and_log(item, epoch)
+            if status == "preempted":
                 # no end_epoch: a partial-epoch summary would pollute the
-                # history/TensorBoard rows the re-run epoch writes again.
-                # epoch-1: this epoch is incomplete, resume re-runs it
-                self._preempt_save(epoch - 1)
+                # history/TensorBoard rows the re-run epoch writes again
                 return "preempted", None
         return None, self.logger.end_epoch(epoch)
+
+    def _single_step_and_log(self, batch, epoch):
+        """The classic one-batch step body; `batch` may be a PlacedBatch."""
+        if isinstance(batch, PlacedBatch):
+            n = batch.n
+        else:
+            n = np.shape(batch[self.input_key])[0]
+        with span("train/step", epoch=epoch) as sp:
+            with self.clock.step(batch_size=n, auto_commit=False) as rec:
+                metrics = self.train_step(batch)
+                rec.fence_on(metrics)
+            # these fetches block on the in-flight state — outside the
+            # with-block so dispatch_ms stays enqueue-only (the
+            # starvation signal compares data_wait against it);
+            # commit() folds their cost into step_time_ms
+            opt_step = int(self.state.step)
+            lr = self.lr_at(opt_step)
+            sp.set(step=opt_step)
+            rec.commit(step=opt_step,
+                       metrics={"loss": metrics["loss"], "lr": lr}
+                       if "loss" in metrics else {"lr": lr})
+        # anomaly triggers see the committed record (step-time/data-wait
+        # z-scores, recompile bursts, HBM high-water jumps) and arm a
+        # capture that the NEXT step's _profiler_hook starts
+        if self.prof is not None:
+            self.prof.observe_step(opt_step, rec.fields())
+        # one host fetch for loggers + health (log_step floats every
+        # metric anyway, so this adds no extra device sync)
+        metrics_f = {k: float(v) for k, v in metrics.items()}
+        loss_f = metrics_f.get("loss")
+        grad_norm_f = metrics_f.get("grad_norm")
+        skipped = (self._skip_nonfinite
+                   and metrics_f.get("skipped", 0.0) > 0)
+        if skipped:
+            # the discarded update's loss/grads are garbage: keep them
+            # out of the epoch means and TB series — the health event
+            # and skipped counter (below) carry the record instead
+            metrics_f = {k: v for k, v in metrics_f.items()
+                         if v == v and abs(v) != float("inf")}
+        # (train_learning_rate gauge: MetricLogger's NaN-guarded write)
+        self.logger.log_step(
+            opt_step, metrics_f, batch_size=n, epoch=epoch,
+            lr=lr, data_wait_ms=rec.data_wait_ms,
+            examples_per_sec=rec.examples_per_sec,
+        )
+        # health guard AFTER the step/log writes: an abort's journal
+        # then reads step -> health(non_finite) -> crash, in order
+        if self.health is not None:
+            self.health.check_step(opt_step, loss=loss_f,
+                                   grad_norm=grad_norm_f,
+                                   skipped=skipped)
+        # poll keyed to the optimizer step — globally consistent across
+        # hosts, immune to unequal agreed() call counts elsewhere
+        if self._pguard is not None and self._pguard.agreed(step=opt_step):
+            # epoch-1: this epoch is incomplete, resume re-runs it
+            self._preempt_save(epoch - 1)
+            return "preempted"
+        return None
+
+    def _superstep_and_log(self, item, epoch):
+        """One scan dispatch = K optimizer steps; per-microstep metrics are
+        recovered from the scanned stack and logged/health-checked exactly
+        as K single steps would have been."""
+        k = self.multistep
+        if isinstance(item, PlacedBatch):
+            n_total = item.n
+        else:
+            n_total = sum(int(np.shape(b[self.input_key])[0]) for b in item)
+        with span("train/step", epoch=epoch) as sp:
+            with self.clock.step(batch_size=n_total,
+                                 auto_commit=False) as rec:
+                metrics_k = self.train_superstep(item)
+                rec.fence_on(metrics_k)
+            opt_step = int(self.state.step)
+            lr = self.lr_at(opt_step)
+            sp.set(step=opt_step, multistep=k)
+            last = metrics_k[-1]
+            # journal: ONE step event per dispatch (the thing that actually
+            # happened), stamped multistep=K; loggers below keep per-
+            # microstep series so histories stay comparable across K
+            rec.commit(step=opt_step,
+                       metrics={"loss": last["loss"], "lr": lr}
+                       if "loss" in last else {"lr": lr},
+                       extra={"multistep": k})
+        if self.prof is not None:
+            self.prof.observe_step(opt_step, rec.fields())
+        floats = jax.device_get(metrics_k)  # ONE fetch for all K microsteps
+        n_each = max(1, n_total // k)
+        for i, mf in enumerate(floats):
+            step_i = opt_step - (k - 1) + i
+            mf = {kk: float(v) for kk, v in mf.items()}
+            loss_f = mf.get("loss")
+            grad_norm_f = mf.get("grad_norm")
+            skipped = (self._skip_nonfinite and mf.get("skipped", 0.0) > 0)
+            logged = mf
+            if skipped:
+                logged = {kk: v for kk, v in mf.items()
+                          if v == v and abs(v) != float("inf")}
+            # per-microstep LR: the post-dispatch hyperparam only reflects
+            # the LAST microstep — under a schedule, re-evaluate it at each
+            # microstep's pre-update count (update t uses schedule(t-1),
+            # matching what lr_at reads after a single-step dispatch)
+            lr_i = (float(self._lr_schedule(step_i - 1))
+                    if callable(self._lr_schedule) else lr)
+            # data_wait amortizes over the K microsteps the one gather fed;
+            # examples_per_sec is the dispatch's wall rate (same for all K)
+            self.logger.log_step(
+                step_i, logged, batch_size=n_each, epoch=epoch, lr=lr_i,
+                data_wait_ms=rec.data_wait_ms / k,
+                examples_per_sec=rec.examples_per_sec,
+            )
+            if self.health is not None:
+                self.health.check_step(step_i, loss=loss_f,
+                                       grad_norm=grad_norm_f,
+                                       skipped=skipped)
+        if self._pguard is not None and self._pguard.agreed(step=opt_step):
+            self._preempt_save(epoch - 1)
+            return "preempted"
+        return None
 
     def _post_epoch(self, summary, eval_data_fn, epoch, save_every):
         # failure detection the reference has none of (SURVEY §5): a
