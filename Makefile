@@ -51,8 +51,18 @@ lint-baseline:
 # obs-smoke and chaos-smoke — the telemetry artifacts must validate and
 # the resilience contracts must hold before the tests count
 verify: SHELL := /bin/bash
-verify: lint perf-smoke obs-smoke chaos-smoke serve-smoke
+verify: lint preflight perf-smoke obs-smoke chaos-smoke serve-smoke
 	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
+
+# environment preflight: backend liveness + libtpu/client version
+# handshake, device-count/mesh-shape sanity, and checkpoint-dir
+# writability — the run-killers that used to burn minutes (MULTICHIP_r01
+# died 4 minutes into its compile on a libtpu skew; the r04 dead tunnel
+# hung to rc=124) now fail in seconds, before anything compiles. Also
+# the first act of every train_cli run (--skip-preflight opts out)
+preflight:
+	JAX_PLATFORMS=cpu python -m deep_vision_tpu.tools.preflight \
+	  --ckpt-dir artifacts/preflight_probe
 
 # observability smoke: a tiny CPU train with tracing + health guard +
 # flight recorder + a static profiler window on, then validate the
@@ -153,4 +163,4 @@ ps:
 native:
 	$(MAKE) -C native
 
-.PHONY: train resume train-fg test lint lint-baseline verify obs-smoke chaos-smoke serve-smoke perf-smoke bench bench-evidence roofline demo demo-gan demo-real dryrun tb ps native
+.PHONY: train resume train-fg test lint lint-baseline verify preflight obs-smoke chaos-smoke serve-smoke perf-smoke bench bench-evidence roofline demo demo-gan demo-real dryrun tb ps native

@@ -38,11 +38,13 @@ same boundaries.
 Resilience: the timing loop retries transient runtime/transport failures
 (the round-2 driver run died to a single tunnel hiccup, `BENCH_r02.json`)
 by rebuilding the jitted step and replaying the window; the JSON line is
-ALWAYS emitted, degraded if necessary, with an `error` field. The retry
-budget, classification, and backoff schedule come from the shared
-`deep_vision_tpu.resilience.RetryPolicy` (this file's bespoke loop was
-its prototype); the rebuild-replay choreography around it stays local
-because it is bench-specific (donated buffers die with the failure). Two hard
+ALWAYS emitted, degraded if necessary, with an `error` field. The
+rebuild-replay bookkeeping — retry budget, failure classification,
+jittered backoff, typed backend_lost/backend_recovered journal events —
+is the shared `resilience.elastic.BackendSupervisor` (this file's
+bespoke loop was its prototype; the Trainer now drives the same object);
+only the control flow stays local because it is bench-specific (donated
+buffers die with the failure, so windows replay on a rebuilt step). Two hard
 wall-clock guards make that promise hold even against a HUNG (not erroring)
 backend — the round-4 failure mode, where a dead relay tunnel blocks the
 main thread in socket recv and no exception ever fires (`BENCH_r04.json`:
@@ -77,6 +79,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from deep_vision_tpu.resilience import RetryPolicy
+from deep_vision_tpu.resilience.elastic import BackendSupervisor
 
 A100_IMG_PER_SEC = 2900.0
 TARGET_PER_CHIP = 0.9 * A100_IMG_PER_SEC
@@ -206,35 +209,19 @@ def _start_watchdog(result: dict) -> None:
 def _backend_alive(budget_s: float, probe=None):
     """(ok, error) — does a trivial device op complete within budget_s?
 
-    The op runs in a worker thread: against a dead relay it blocks forever
-    in socket recv (no exception), so a plain try/except cannot detect the
-    outage — a join timeout can. The orphaned thread stays blocked and is
+    Thin wrapper over the shared threaded probe
+    (resilience.elastic.backend_alive — a dead relay BLOCKS in socket
+    recv, so only a join timeout can see it; the same probe gates
+    tools/preflight.py). The orphaned thread stays blocked and is
     daemon-irrelevant because degraded exits go through os._exit."""
+    from deep_vision_tpu.resilience.elastic import backend_alive
+
     if probe is None and os.environ.get("BENCH_SIMULATE_DEAD"):
         # rehearsal hook: behave exactly like a dead relay (block, don't
         # raise) so the degraded path can be exercised on a healthy machine
         def probe():
             return time.sleep(7 * 24 * 3600)
-    if probe is None:
-        def probe():
-            return float(jnp.ones((), jnp.float32).sum())
-    out = {}
-
-    def run():
-        try:
-            out["value"] = probe()
-        except Exception as e:
-            out["error"] = f"{type(e).__name__}: {e}"
-
-    t = threading.Thread(target=run, daemon=True, name="bench-liveness")
-    t.start()
-    t.join(budget_s)
-    if t.is_alive():
-        return False, (f"backend liveness probe still blocked after "
-                       f"{budget_s:.0f}s (dead tunnel?)")
-    if "error" in out:
-        return False, f"backend liveness probe failed: {out['error']}"
-    return True, None
+    return backend_alive(budget_s, probe=probe)
 
 # bf16 peak of the chips this bench is expected to meet; device_kind prefix
 # match, first hit wins, conservative default otherwise.
@@ -455,30 +442,18 @@ def _retry_policy() -> RetryPolicy:
                        jitter=0.25, retry_on=Exception)
 
 
-#: the policy the live _timed_windows session is driving; _recover_backend
-#: sleeps ITS backoff so the jitter RNG advances per draw (a fresh policy
-#: here would re-seed and produce the same "jittered" delay every retry)
-#: and counters/journal stay on one object
-_ACTIVE_POLICY = None
-
-
-def _recover_backend(attempt: int) -> None:
-    """Best-effort client-side reset between retries of a dead tunnel:
-    the shared policy's backoff, then a cache clear on later attempts."""
-    # flight-recorder breadcrumb (no-op without --flight-dir): repeated
-    # backend recoveries are the context a degraded-result postmortem needs
-    try:
-        from deep_vision_tpu.obs import flight as _flight
-
-        _flight.note("bench_backend_recovery", attempt=attempt)
-    except Exception:
-        pass
-    (_ACTIVE_POLICY or _retry_policy()).backoff(attempt)
-    if attempt >= 2:
-        try:
-            jax.clear_caches()
-        except Exception as e:
-            _log(f"clear_caches failed ({type(e).__name__}: {e})")
+def _make_supervisor() -> BackendSupervisor:
+    """One BackendSupervisor per _timed_windows session: the rebuild-replay
+    bookkeeping — backoff jitter RNG (ONE RNG, advancing per draw), typed
+    backend_lost/backend_recovered journal events, flight-recorder
+    breadcrumbs, clear_caches pacing — lives in a single object
+    (resilience/elastic.py; this replaced the module-global _ACTIVE_POLICY
+    shim, whose `or _retry_policy()` fallback could silently re-seed and
+    re-draw the same "jittered" delay). retry_unclassified: a bench window
+    is a replayable pure computation, so any Exception is worth one more
+    attempt — except a version skew, which never heals mid-run."""
+    return BackendSupervisor(policy=_retry_policy(), journal=_JOURNAL,
+                             name="bench.window", retry_unclassified=True)
 
 
 def _cost_analysis(step, multistep: int, batch_per_chip: int):
@@ -525,8 +500,7 @@ def _timed_windows(batch_per_chip: int, multistep: int):
     """
     dispatches = max(1, math.ceil(TIMED_STEPS / multistep))
     steps_per_window = dispatches * multistep
-    global _ACTIVE_POLICY
-    policy = _ACTIVE_POLICY = _retry_policy()
+    sup = _make_supervisor()
     errors = []
     window_dts = []
     stale_dts = []  # pre-failure windows: degraded fallback only
@@ -534,6 +508,7 @@ def _timed_windows(batch_per_chip: int, multistep: int):
     last_good = None  # survives rebuild failures: completed windows stay
                       # attributed to a real (step, ..., devices) tuple
     attempt = 0
+    recovered_noted = False
     global _WINDOWS_DONE
     while len(window_dts) < WINDOWS:
         margin = _STOP_MARGIN_S if built else _REBUILD_MARGIN_S
@@ -574,6 +549,11 @@ def _timed_windows(batch_per_chip: int, multistep: int):
             _log(f"window {w}: {dt / steps_per_window * 1e3:.1f} ms/step")
             window_dts.append(dt / steps_per_window)
             _WINDOWS_DONE = len(window_dts)
+            if attempt and not recovered_noted:
+                # a completed window on the rebuilt step = the outage is
+                # over; journaled as a typed backend_recovered event
+                sup.on_recovered(attempt)
+                recovered_noted = True
             # the step donates its state input: refresh the snapshot so the
             # returned state is the LIVE buffer, not a donated husk
             last_good[1] = state
@@ -583,18 +563,21 @@ def _timed_windows(batch_per_chip: int, multistep: int):
             attempt += 1
             errors.append(f"{type(e).__name__}: {e}")
             _log(f"transient failure #{attempt} ({errors[-1][:200]})")
-            retrying = policy.should_retry(attempt, e)
-            policy.note(attempt, e, "retrying" if retrying else "gave_up")
+            # classification + budget + typed backend_lost event + the
+            # shared retry event, all through the supervisor
+            retrying = sup.on_failure(attempt, e, context="bench.window")
+            recovered_noted = False
             if window_dts:
                 stale_dts = window_dts
                 window_dts = []  # discard pre-failure windows: one healthy
                                  # session only feeds the median
                 _WINDOWS_DONE = 0  # keep the watchdog's count honest
             if not retrying:
-                _log("retry budget exhausted")
+                _log("not retrying: budget exhausted or unretryable "
+                     "(version skew never heals mid-run)")
                 break
             built = None  # rebuild: donated/invalid buffers are gone
-            _recover_backend(attempt)
+            sup.recover(attempt)  # breadcrumb + backoff + cache clear
     if not window_dts and stale_dts:
         window_dts = stale_dts
         _WINDOWS_DONE = len(window_dts)

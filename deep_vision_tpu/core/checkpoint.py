@@ -25,6 +25,15 @@ newest remaining valid step is restored instead of crashing the run.
 `resilience.faults` injection points (`ckpt.save`, `ckpt.restore`,
 `ckpt.sidecar` incl. the after-write torn window) make every one of
 those paths testable on CPU.
+
+Elastic (cross-mesh) restore: every save records leaf-level sharding
+metadata in the sidecar (`resilience.elastic.sharding_meta` under the
+reserved `__sharding__` key), so a run checkpointed on N hosts/devices
+restores onto M — `restore(..., mesh=current_mesh)` re-places every
+restored array against the *current* mesh's NamedShardings, re-resolving
+each saved PartitionSpec per dimension and replicating whatever the new
+topology cannot honor. Proven on CPU by saving under an 8-device mesh
+and restoring under 4 and 1 (tests/test_elastic.py).
 """
 from __future__ import annotations
 
@@ -39,6 +48,7 @@ import jax
 import orbax.checkpoint as ocp
 
 from deep_vision_tpu.resilience import RetryPolicy, faults
+from deep_vision_tpu.resilience import elastic
 
 _SIDECAR_RE = re.compile(r"host_state_(\d+)\.json$")
 _SIDECAR_FORMAT = 1
@@ -92,6 +102,10 @@ class CheckpointManager:
             name="ckpt.restore", max_attempts=3, base_delay_s=0.2,
             max_delay_s=5.0, journal=journal,
         )
+        #: did the last restore() place arrays itself (mesh= given)?
+        #: Callers that blanket-replicate after a legacy restore consult
+        #: this so they don't clobber a metadata-driven placement.
+        self.last_restore_placed = False
         self._options = ocp.CheckpointManagerOptions(
             max_to_keep=max_to_keep,
             save_interval_steps=save_interval_steps,
@@ -239,19 +253,22 @@ class CheckpointManager:
         self._reload()
 
     def _restore_with_fallback(
-        self, do_restore: Callable[[int], Any], step: Optional[int]
+        self, do_restore: Callable[[int, Optional[dict]], Any],
+        step: Optional[int]
     ) -> Tuple[Optional[int], Any, Optional[dict]]:
         """(restored_step, value, host_state); (None, None, None) when no
         valid checkpoint remains. Explicit `step` = validate-or-raise (the
         operator pinned it; silently restoring a different one would be
         worse than failing); `step=None` = newest valid, quarantining
-        losers along the way."""
-        def attempt(s: int):
+        losers along the way. `do_restore` receives the step's (already
+        validated) host sidecar so a cross-mesh restorer can derive the
+        target shardings BEFORE orbax places anything."""
+        def attempt(s: int, host_state: Optional[dict]):
             # transient I/O (OSError family) is retried here, so only a
             # failure that SURVIVES the retry budget can condemn a step
             def once():
                 faults.fire("ckpt.restore")
-                return do_restore(s)
+                return do_restore(s, host_state)
 
             return self._restore_retry.call(once)
 
@@ -266,7 +283,7 @@ class CheckpointManager:
             if err is not None:
                 raise CheckpointCorruptError(
                     f"checkpoint step {step} in {self.directory!r}: {err}")
-            return step, attempt(step), host_state
+            return step, attempt(step, host_state), host_state
         sidecar_steps = set(self._sidecar_steps())
         for s in sorted(self._mgr.all_steps(), reverse=True):
             host_state, err = self._read_sidecar(s)
@@ -279,7 +296,7 @@ class CheckpointManager:
                        "(save died before the sidecar landed)")
             if err is None:
                 try:
-                    return s, attempt(s), host_state
+                    return s, attempt(s, host_state), host_state
                 except (KeyboardInterrupt, SystemExit):
                     raise
                 except Exception as e:
@@ -287,6 +304,21 @@ class CheckpointManager:
             self._quarantine(s, err)
             sidecar_steps.discard(s)
         return None, None, None
+
+    def _typed_restorer(self, template, mesh) -> Callable:
+        """The do_restore closure shared by restore/restore_tree: with a
+        `mesh`, the template is handed to orbax as ABSTRACT arrays whose
+        shardings come from the step's sidecar metadata re-resolved
+        against that mesh — each array lands once, already placed (no
+        restore-then-re-place double transfer)."""
+        def do_restore(s: int, host_state: Optional[dict]):
+            tmpl = template
+            if mesh is not None:
+                meta = (host_state or {}).get(elastic.SHARDING_META_KEY)
+                tmpl = elastic.abstract_template(template, meta, mesh)
+            return self._mgr.restore(s, args=ocp.args.StandardRestore(tmpl))
+
+        return do_restore
 
     # -- save/restore API ---------------------------------------------------
 
@@ -303,35 +335,76 @@ class CheckpointManager:
                 return False
             self._best_value = v
         faults.fire("ckpt.save")
-        saved = self._mgr.save(
-            step, args=ocp.args.StandardSave(state_arrays(state))
-        )
+        arrays = state_arrays(state)
+        saved = self._mgr.save(step, args=ocp.args.StandardSave(arrays))
         # multi-host: orbax coordinates the array save across processes;
         # the JSON sidecar is host-side state, written once by the primary.
         # REQUIRES a shared checkpoint filesystem (the standard orbax
         # multi-host setup): non-primary hosts read the same sidecar on
         # restore. With per-host local directories they would see
         # host_state=None and resume with divergent plateau/LR state.
-        if saved and host_state is not None and jax.process_index() == 0:
-            self._write_sidecar(step, host_state)
+        # Every save now carries a sidecar: the leaf-level sharding
+        # metadata it embeds is what lets a later restore re-place the
+        # arrays on a DIFFERENT mesh (elastic cross-mesh resume).
         if saved and jax.process_index() == 0:
+            self._write_sidecar(step, self._with_sharding(host_state, arrays))
             self._gc_sidecars()
         return saved
 
-    def restore(self, state, step: Optional[int] = None):
+    @staticmethod
+    def _with_sharding(host_state: Optional[dict], tree) -> dict:
+        doc = dict(host_state) if host_state else {}
+        try:
+            doc[elastic.SHARDING_META_KEY] = elastic.sharding_meta(tree)
+        except Exception:
+            pass  # metadata is an upgrade, never a reason to fail a save
+        return doc
+
+    def _place_restored(self, found: int, restored, host_state, mesh):
+        """Strip the sharding metadata out of the host sidecar and, when a
+        `mesh` was given, re-place every restored leaf against it — the
+        cross-mesh half of an elastic resume. Returns (tree, host_state)."""
+        self.last_restore_placed = False
+        meta = None
+        if isinstance(host_state, dict):
+            meta = host_state.pop(elastic.SHARDING_META_KEY, None)
+        if mesh is None:
+            return restored, host_state
+        # the typed restorer already landed every array on its target
+        # sharding (abstract template); this pass is a near-free identity
+        # (device_put to an equal sharding short-circuits) that also
+        # covers managers whose do_restore did not pre-place
+        restored, stats = elastic.replace_on_mesh(restored, meta, mesh)
+        self.last_restore_placed = True
+        if self.journal is not None and meta:
+            self.journal.write(
+                "note", note="ckpt_resharded", step=int(found),
+                saved_mesh=meta.get("mesh"),
+                saved_devices=meta.get("device_count"),
+                mesh={str(k): int(v) for k, v in mesh.shape.items()},
+                **stats,
+            )
+        return restored, host_state
+
+    def restore(self, state, step: Optional[int] = None, mesh=None):
         """Restore into the structure of `state`; returns (state, host_state).
 
         With `step=None`, walks the fallback chain: corrupt/incomplete
         steps are quarantined and the newest valid one wins. When nothing
-        valid remains, returns the input state untouched (fresh start)."""
+        valid remains, returns the input state untouched (fresh start).
+
+        With `mesh`, the restored arrays are re-placed against THAT mesh
+        using the sharding metadata the save recorded — a checkpoint from
+        an 8-device run restores onto 4 (or 1) with every leaf landing on
+        the new topology (specs the new mesh cannot honor replicate)."""
         template = state_arrays(state)
         found, restored, host_state = self._restore_with_fallback(
-            lambda s: self._mgr.restore(
-                s, args=ocp.args.StandardRestore(template)),
-            step,
-        )
+            self._typed_restorer(template, mesh), step)
         if found is None:
+            self.last_restore_placed = False
             return state, None
+        restored, host_state = self._place_restored(
+            found, restored, host_state, mesh)
         return state.replace(**restored), host_state
 
     def save_tree(self, step: int, tree, host_state: Optional[dict] = None):
@@ -341,24 +414,22 @@ class CheckpointManager:
         CycleGAN/tensorflow/train.py:133-148)."""
         faults.fire("ckpt.save")
         saved = self._mgr.save(step, args=ocp.args.StandardSave(tree))
-        if saved and host_state is not None and jax.process_index() == 0:
-            self._write_sidecar(step, host_state)
         if saved and jax.process_index() == 0:
+            self._write_sidecar(step, self._with_sharding(host_state, tree))
             self._gc_sidecars()
         return saved
 
-    def restore_tree(self, template, step: Optional[int] = None):
+    def restore_tree(self, template, step: Optional[int] = None, mesh=None):
         """Restore a pytree saved by `save_tree` into `template`'s structure;
         returns (tree, host_state) or (None, None) when nothing valid is
-        saved (same quarantine-and-fall-back semantics as `restore`)."""
+        saved (same quarantine-and-fall-back and cross-mesh `mesh=`
+        semantics as `restore`)."""
         found, restored, host_state = self._restore_with_fallback(
-            lambda s: self._mgr.restore(
-                s, args=ocp.args.StandardRestore(template)),
-            step,
-        )
+            self._typed_restorer(template, mesh), step)
         if found is None:
+            self.last_restore_placed = False
             return None, None
-        return restored, host_state
+        return self._place_restored(found, restored, host_state, mesh)
 
     def restore_variables(self, step: Optional[int] = None) -> dict:
         """Template-free restore of just the model variables.

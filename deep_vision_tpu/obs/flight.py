@@ -345,6 +345,40 @@ def find_bundles(flight_dir: str) -> List[str]:
     return out
 
 
+# -- preemption escalation: checkpoint-now-and-requeue ------------------------
+
+#: exit code a preempted run returns after its checkpoint landed:
+#: EX_TEMPFAIL, the conventional "transient failure — requeue me" code
+#: (sendmail, SLURM requeue policies). Distinct from 0 (done, do not
+#: reschedule) and 1 (bug, do not reschedule), so the scheduler that
+#: SIGTERMed the VM can resubmit the job to resume from the preempt
+#: checkpoint.
+REQUEUE_EXIT_CODE = 75
+
+_requeue_requested = False
+
+
+def request_requeue() -> None:
+    """Mark this run preempted-with-checkpoint: the CLI exits with
+    `REQUEUE_EXIT_CODE` so the scheduler requeues instead of declaring the
+    job finished or failed. Called by the Trainer's SIGTERM escalation
+    after the preempt checkpoint is on disk (the flight `preempt` bundle
+    was already dumped from the signal hook)."""
+    global _requeue_requested
+    _requeue_requested = True
+
+
+def requeue_requested() -> bool:
+    return _requeue_requested
+
+
+def clear_requeue() -> None:
+    """Reset the latch (CLI entry, tests): the flag is process-wide and a
+    long-lived process may host several runs."""
+    global _requeue_requested
+    _requeue_requested = False
+
+
 # -- process-wide active recorder ---------------------------------------------
 
 _active: Optional[FlightRecorder] = None

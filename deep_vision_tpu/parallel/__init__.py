@@ -1,9 +1,12 @@
 from deep_vision_tpu.parallel.mesh import (
     MeshSpec,
+    ShardingCoverageError,
+    assert_sharding_coverage,
     create_mesh,
     data_sharding,
     replicated,
     shard_batch,
+    sharding_coverage,
     local_mesh_devices,
 )
 from deep_vision_tpu.parallel.moe import (

@@ -9,6 +9,13 @@ clean lab run):
   forms; typed `retry` journal events and metrics counters. Shared by
   bench.py's rebuild-replay loop, the checkpoint sidecar writer, and
   shard opens in the tolerant record reader.
+- `elastic`: the accelerator-layer arc — backend-failure classification
+  (connection loss / dead-tunnel timeout / libtpu version skew),
+  `BackendSupervisor` rebuild-replay choreography with typed
+  `backend_lost`/`backend_recovered` journal events, cross-mesh
+  checkpoint sharding metadata (restore a run saved on N devices onto
+  M), and the threaded `backend_alive` liveness probe shared by bench
+  and `tools/preflight.py`.
 - `faults`: `FaultInjector` — seeded, deterministic faults driven by a
   `--fault-spec` string, with named injection points at every I/O
   boundary that cost one None-check when disabled. The mechanism behind
@@ -21,6 +28,14 @@ their data: the bad-record budget + dead-letter writer in
 jax-free at import (like obs/registry) so spawned data workers can use
 both without dragging in a backend.
 """
+from deep_vision_tpu.resilience.elastic import (
+    BACKEND_LOST_KINDS,
+    BackendSupervisor,
+    backend_alive,
+    classify_backend_error,
+    replace_on_mesh,
+    sharding_meta,
+)
 from deep_vision_tpu.resilience.faults import (
     ENV_SEED,
     ENV_SPEC,
@@ -36,6 +51,8 @@ from deep_vision_tpu.resilience.faults import (
 from deep_vision_tpu.resilience.retry import DEFAULT_RETRY_ON, RetryPolicy
 
 __all__ = [
+    "BACKEND_LOST_KINDS",
+    "BackendSupervisor",
     "DEFAULT_RETRY_ON",
     "ENV_SEED",
     "ENV_SPEC",
@@ -43,9 +60,13 @@ __all__ = [
     "FaultInjector",
     "FaultSpecError",
     "RetryPolicy",
+    "backend_alive",
+    "classify_backend_error",
     "fire",
     "install",
     "install_spec",
     "installed",
+    "replace_on_mesh",
+    "sharding_meta",
     "transform",
 ]

@@ -1,0 +1,249 @@
+"""Environment preflight: fail in seconds, not minutes.
+
+    python -m deep_vision_tpu.tools.preflight [--ckpt-dir DIR]
+        [--mesh-data N] [--mesh-model M] [--expect-devices N]
+        [--budget SECONDS] [--json]
+
+Every accelerator-layer failure in the repo's own run history burned
+minutes before dying: MULTICHIP_r01 spent ~4 minutes compiling before a
+libtpu client/terminal version skew killed the first dispatch, and the
+BENCH_r04/r05 dead tunnels HUNG (no exception) until an external timeout
+fired at rc=124. This preflight front-loads those verdicts:
+
+  client_versions   jax vs jaxlib (major, minor) agreement — the
+                    client-side half of a version skew
+  backend           a trivial device op must complete within --budget,
+                    run on a probe THREAD (a dead relay blocks in socket
+                    recv forever; only a join timeout can see it). Any
+                    error it raises is classified
+                    (resilience.elastic.classify_backend_error): the
+                    MULTICHIP_r01 FAILED_PRECONDITION surfaces here as
+                    `version_skew` in seconds, before any real compile.
+                    Pass detail reports N x device_kind + the platform
+                    version string — the terminal half of the handshake.
+  mesh_shape        the requested (data, model) layout resolves over the
+                    live device count (and matches --expect-devices when
+                    given): a MULTICHIP launch asking for {'data': 4,
+                    'model': 2} on a degraded 6-chip slice fails here,
+                    not in the partitioner.
+  ckpt_dir          checkpoint-directory writability, probed with the
+                    same tmp+fsync+rename shape the crc32c sidecar uses:
+                    a read-only or mis-mounted volume fails before the
+                    first epoch trains into an unsaveable run.
+
+Runnable standalone (`make preflight`; exit 0 pass / 1 fail, one line
+per check) and as the first act of `train_cli` (--skip-preflight opts
+out). All checks are pure functions over injectable inputs so the
+pass/fail classification is unit-testable without breaking hardware.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from dataclasses import asdict, dataclass
+from typing import Callable, List, Optional, Tuple
+
+from deep_vision_tpu.resilience.elastic import (
+    KIND_VERSION_SKEW,
+    backend_alive,
+)
+
+#: default probe budget: a healthy backend answers a trivial op in
+#: milliseconds (CPU) to ~a second (cold TPU client); a dead tunnel never
+#: does. Env-overridable for slow relays (DVT_PREFLIGHT_BUDGET_S).
+DEFAULT_BUDGET_S = float(os.environ.get("DVT_PREFLIGHT_BUDGET_S", "60"))
+
+
+@dataclass
+class CheckResult:
+    name: str
+    ok: bool
+    detail: str
+    kind: str = ""  # failure classification (elastic.BACKEND_LOST_KINDS)
+    elapsed_ms: float = 0.0
+
+
+# -- the checks (pure over injectable inputs) ---------------------------------
+
+def check_client_versions(jax_version: Optional[str] = None,
+                          jaxlib_version: Optional[str] = None) -> CheckResult:
+    """jax and jaxlib must agree on (major, minor): the client-side half
+    of a version skew (the installed pair drifting apart is the usual way
+    one side of the libtpu handshake goes stale)."""
+    if jax_version is None or jaxlib_version is None:
+        import jax
+        import jaxlib
+
+        jax_version = jax_version or jax.__version__
+        jaxlib_version = jaxlib_version or jaxlib.__version__
+    detail = f"jax {jax_version}, jaxlib {jaxlib_version}"
+
+    def mm(v: str) -> Tuple[str, ...]:
+        return tuple(v.split(".")[:2])
+
+    if mm(jax_version) != mm(jaxlib_version):
+        return CheckResult("client_versions", False,
+                           detail + " — (major, minor) disagree",
+                           kind=KIND_VERSION_SKEW)
+    return CheckResult("client_versions", True, detail)
+
+
+def check_backend(budget_s: float = DEFAULT_BUDGET_S,
+                  probe: Optional[Callable] = None) -> CheckResult:
+    """The liveness + handshake probe: one trivial device op, threaded.
+
+    A hang (dead tunnel) is reported as `timeout`; a raised exception is
+    classified from the exception OBJECT (the type gate applies) — the
+    libtpu client/terminal skew raises FAILED_PRECONDITION on the first
+    dispatch and lands here as `version_skew` seconds into the run
+    instead of minutes."""
+    ok, err, kind = backend_alive(budget_s, probe=probe, with_kind=True)
+    if not ok:
+        return CheckResult("backend", False, err, kind=kind)
+    try:
+        import jax
+
+        devs = jax.devices()
+        # the terminal half of the handshake: on TPU this is the libtpu
+        # build string MULTICHIP_r01's skew error quoted
+        version = str(getattr(getattr(devs[0], "client", None),
+                              "platform_version", "") or "")
+        detail = (f"{len(devs)} x {devs[0].device_kind} "
+                  f"({devs[0].platform}"
+                  + (f", {version.splitlines()[0]}" if version else "")
+                  + ")")
+    except Exception as e:  # probe passed but introspection is exotic
+        detail = f"alive (introspection unavailable: {type(e).__name__})"
+    return CheckResult("backend", True, detail)
+
+
+def check_mesh_shape(n_devices: int, data: int = -1, model: int = 1,
+                     expect_devices: Optional[int] = None) -> CheckResult:
+    """Does the requested (data, model) layout resolve over `n_devices`?"""
+    from deep_vision_tpu.parallel.mesh import MeshSpec
+
+    if expect_devices is not None and n_devices != expect_devices:
+        return CheckResult(
+            "mesh_shape", False,
+            f"expected {expect_devices} devices, found {n_devices} "
+            "(degraded slice, or the wrong machine)")
+    try:
+        d, m = MeshSpec(data=data, model=model).resolve(n_devices)
+    except ValueError as e:
+        return CheckResult("mesh_shape", False, str(e))
+    return CheckResult("mesh_shape", True,
+                       f"{{'data': {d}, 'model': {m}}} over "
+                       f"{n_devices} device(s)")
+
+
+def check_ckpt_dir(path: str) -> CheckResult:
+    """Writability probe with the sidecar's own durability shape
+    (tmp + fsync + rename), cleaned up after itself."""
+    probe = os.path.join(path, f".preflight-{os.getpid()}")
+    tmp = probe + ".tmp"
+    try:
+        os.makedirs(path, exist_ok=True)
+        with open(tmp, "wb") as f:
+            f.write(b"preflight")
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, probe)
+        with open(probe, "rb") as f:
+            if f.read() != b"preflight":
+                return CheckResult("ckpt_dir", False,
+                                   f"{path}: read-back mismatch "
+                                   "(corrupting filesystem?)")
+    except OSError as e:
+        return CheckResult("ckpt_dir", False,
+                           f"{path}: {type(e).__name__}: {e}")
+    finally:
+        for p in (tmp, probe):
+            try:
+                os.remove(p)
+            except OSError:
+                pass
+    return CheckResult("ckpt_dir", True, f"{path} writable (tmp+fsync+rename)")
+
+
+# -- the runner ----------------------------------------------------------------
+
+def run_preflight(data: int = -1, model: int = 1,
+                  expect_devices: Optional[int] = None,
+                  ckpt_dir: Optional[str] = None,
+                  budget_s: float = DEFAULT_BUDGET_S,
+                  probe: Optional[Callable] = None,
+                  journal=None) -> Tuple[bool, List[CheckResult]]:
+    """Run every applicable check; returns (all_ok, results).
+
+    Ordering matters: the backend probe runs FIRST because when it fails
+    nothing downstream (device count, mesh resolve) is meaningful — those
+    checks are skipped rather than cascading the same root cause."""
+    results: List[CheckResult] = []
+
+    def run(fn, *args, **kw) -> CheckResult:
+        t0 = time.perf_counter()
+        r = fn(*args, **kw)
+        r.elapsed_ms = round((time.perf_counter() - t0) * 1e3, 1)
+        results.append(r)
+        return r
+
+    run(check_client_versions)
+    backend = run(check_backend, budget_s=budget_s, probe=probe)
+    if backend.ok:
+        import jax
+
+        run(check_mesh_shape, len(jax.devices()), data=data, model=model,
+            expect_devices=expect_devices)
+    if ckpt_dir:
+        run(check_ckpt_dir, ckpt_dir)
+    ok = all(r.ok for r in results)
+    if journal is not None:
+        try:
+            journal.write("note", note="preflight",
+                          ok=ok, checks=[asdict(r) for r in results])
+        except Exception:
+            pass
+    return ok, results
+
+
+def render(results: List[CheckResult], out=sys.stderr) -> None:
+    for r in results:
+        verdict = "PASS" if r.ok else "FAIL"
+        kind = f" [{r.kind}]" if r.kind else ""
+        print(f"preflight: {verdict} {r.name}{kind} — {r.detail} "
+              f"({r.elapsed_ms:.0f} ms)", file=out)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--ckpt-dir", default=None,
+                   help="also probe this checkpoint dir for writability")
+    p.add_argument("--mesh-data", type=int, default=-1,
+                   help="requested data-axis size (-1: all remaining)")
+    p.add_argument("--mesh-model", type=int, default=1,
+                   help="requested model-axis size")
+    p.add_argument("--expect-devices", type=int, default=None,
+                   help="fail unless exactly this many devices are live")
+    p.add_argument("--budget", type=float, default=DEFAULT_BUDGET_S,
+                   help="seconds the backend probe may take before the "
+                        "tunnel is declared dead")
+    p.add_argument("--json", action="store_true",
+                   help="print one machine-readable JSON line to stdout")
+    args = p.parse_args(argv)
+    ok, results = run_preflight(
+        data=args.mesh_data, model=args.mesh_model,
+        expect_devices=args.expect_devices, ckpt_dir=args.ckpt_dir,
+        budget_s=args.budget,
+    )
+    render(results)
+    if args.json:
+        print(json.dumps({"ok": ok,
+                          "checks": [asdict(r) for r in results]}))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

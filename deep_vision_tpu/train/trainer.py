@@ -93,6 +93,7 @@ class Trainer:
         autoprof=None,  # obs.AutoProfiler; built from profile_dir if None
         multistep: int = 1,  # optimizer steps per dispatch (lax.scan)
         device_prefetch: int = 0,  # device-resident batch buffer depth
+        backend_supervisor=None,  # resilience.BackendSupervisor or None
     ):
         self.mesh = mesh if mesh is not None else create_mesh()
         self.model = model  # single source of truth for summaries/export
@@ -143,6 +144,21 @@ class Trainer:
                 self.state.params)
         self._pguard = None  # PreemptionGuard, live only inside fit
         self._closed = False
+        self.preempted = False  # latched by the SIGTERM escalation path
+        # backend-loss recovery (resilience/elastic.py BackendSupervisor):
+        # with one installed, fit() treats a classified backend failure
+        # (dropped connection, dead-tunnel timeout) as an expected input —
+        # rebuild the jitted step from host-side seeds + checkpoint, replay
+        # from the last completed step. The host-side ingredients of that
+        # rebuild are kept here; everything device-resident is derived.
+        self.backend = backend_supervisor
+        if self.backend is not None and self.backend.journal is None:
+            self.backend.journal = journal
+            if self.backend.policy.journal is None:
+                self.backend.policy.journal = journal
+        self._tx = tx
+        self._sample_input = sample_input
+        self._init_rng = rng
 
         state = create_train_state(model, tx, sample_input, rng)
         # device boundary: state lives replicated on the mesh from here on
@@ -199,21 +215,6 @@ class Trainer:
         # of silently propagating garbage. ~2x step cost — a debugging mode,
         # vs --debug-nans which re-runs ops eagerly only after a NaN fetch.
         self._checkify = checkify_errors
-        if checkify_errors:
-            from jax.experimental import checkify
-
-            checked = checkify.checkify(
-                self._train_step_impl, errors=checkify.all_checks
-            )
-            # jaxlint: disable=DV003 -- checkify debug mode: keep the pre-step state un-donated so a thrown error can be inspected against the exact inputs that produced it
-            self._train_step_err = jax.jit(checked)
-            self._train_step = None
-        else:
-            self._train_step = jax.jit(
-                self._train_step_impl, donate_argnums=0
-            )
-        self._eval_step = jax.jit(self._eval_step_impl)
-
         # -- scan-multistep: K optimizer steps per dispatch ----------------
         # One lax.scan over a (K, B, ...) stacked batch amortizes the
         # per-dispatch host turnaround K-fold (bench.py measured the
@@ -223,7 +224,6 @@ class Trainer:
         # apply per microstep; the epoch tail (fewer than K batches left)
         # rides the single-step executable so neither ever recompiles.
         self.multistep = max(1, int(multistep))
-        self._train_multi = None
         if self.multistep > 1:
             if checkify_errors:
                 raise ValueError(
@@ -238,9 +238,7 @@ class Trainer:
                     "microsteps would decay it once instead of K times and "
                     "silently change eval — run EMA at multistep=1"
                 )
-            self._train_multi = jax.jit(
-                self._multistep_impl, donate_argnums=0
-            )
+        self._build_jitted_steps()
         # device prefetch: pad/shard/device_put the NEXT batch(es) on a
         # producer thread so H2D transfer overlaps the current step's
         # compute (data/device_prefetch.py); depth 2 = double buffering
@@ -257,6 +255,33 @@ class Trainer:
             )
 
     # -- jitted steps ------------------------------------------------------
+    def _build_jitted_steps(self) -> None:
+        """(Re)create the jitted step callables. Called once at init and
+        again by the backend-loss recovery path: after a client rebuild
+        the old executables reference dead buffers, so the wrappers are
+        remade from the pure impl methods (the impls close over nothing
+        device-resident — everything flows through state/batch args)."""
+        if self._checkify:
+            from jax.experimental import checkify
+
+            checked = checkify.checkify(
+                self._train_step_impl, errors=checkify.all_checks
+            )
+            # jaxlint: disable=DV003 -- checkify debug mode: keep the pre-step state un-donated so a thrown error can be inspected against the exact inputs that produced it
+            self._train_step_err = jax.jit(checked)
+            self._train_step = None
+        else:
+            self._train_step = jax.jit(
+                self._train_step_impl, donate_argnums=0
+            )
+            self._train_step_err = None
+        self._eval_step = jax.jit(self._eval_step_impl)
+        self._train_multi = None
+        if self.multistep > 1:
+            self._train_multi = jax.jit(
+                self._multistep_impl, donate_argnums=0
+            )
+
     def _train_step_impl(self, state: TrainState, batch):
         step_rng = jax.random.fold_in(state.rng, state.step)
 
@@ -571,6 +596,7 @@ class Trainer:
             if handle_preemption else None
         )
         self._closed = False  # fit may be re-entered after a close()
+        self.preempted = False  # re-armed per fit: the latch reports THIS run
         if self.health is not None:
             self.health.start_watchdog()  # no-op without a timeout
         import contextlib
@@ -580,15 +606,40 @@ class Trainer:
             with ctx:
                 if eval_first and eval_data_fn is not None:
                     self.evaluate(eval_data_fn(), epoch=start_epoch)
-                for epoch in range(start_epoch, epochs):
-                    with span("train/epoch", epoch=epoch):
-                        status, summary = self._run_epoch(train_data_fn,
-                                                          epoch)
-                    if status == "preempted":
-                        return self.state
-                    if self._post_epoch(summary, eval_data_fn, epoch,
-                                        save_every) == "preempted":
-                        return self.state
+                epoch = start_epoch
+                attempt = 0  # backend rebuild-replay attempts so far
+                while epoch < epochs:
+                    try:
+                        with span("train/epoch", epoch=epoch):
+                            status, summary = self._run_epoch(train_data_fn,
+                                                              epoch)
+                        if status == "preempted":
+                            return self.state
+                        if self._post_epoch(summary, eval_data_fn, epoch,
+                                            save_every) == "preempted":
+                            return self.state
+                        if attempt and self.backend is not None:
+                            # a full epoch on the rebuilt backend = real
+                            # progress: the outage is over
+                            self.backend.on_recovered(
+                                attempt, step=int(self.state.step))
+                            attempt = 0
+                    except (KeyboardInterrupt, SystemExit):
+                        raise
+                    except Exception as e:
+                        # backend-loss detection + rebuild-replay (the
+                        # choreography bench.py prototyped, lifted here):
+                        # only failures the supervisor classifies as a
+                        # lost backend are retried — program bugs, NaN
+                        # aborts, and version skew propagate unchanged
+                        attempt += 1
+                        if self.backend is None or not self.backend.on_failure(
+                                attempt, e, step=None, context="train/fit"):
+                            raise
+                        self.backend.recover(attempt)
+                        epoch = self._rebuild_after_backend_loss(start_epoch)
+                        continue
+                    epoch += 1
         finally:
             self._pguard = None
             self._stop_trace()  # stop gate never reached (short run)
@@ -622,14 +673,63 @@ class Trainer:
                                epoch=epoch, saved=bool(saved))
         return bool(saved)
 
+    def _rebuild_after_backend_loss(self, fallback_epoch: int) -> int:
+        """Rebuild the device-side world from host-side seeds + checkpoint
+        after a lost backend; returns the epoch to replay from.
+
+        Everything device-resident is reconstructed: the compiled-
+        executable caches are dropped (they pin the dead client), the
+        jitted wrappers are remade, a fresh TrainState is re-initialized
+        from the SAME host seeds (bit-equivalent to the original init),
+        and — when a checkpoint manager holds a valid step — `resume()`
+        replays from the last completed checkpoint (riding the quarantine
+        fallback chain and the cross-mesh re-placement). Without a
+        checkpoint the honest floor is a from-scratch replay, journaled
+        as such."""
+        try:
+            jax.clear_caches()
+        except Exception:
+            pass
+        state = create_train_state(self.model, self._tx, self._sample_input,
+                                   self._init_rng)
+        self.state = jax.device_put(state, replicated(self.mesh))
+        if self.ema is not None:
+            from deep_vision_tpu.train.ema import EmaParams
+
+            self.ema = EmaParams(self.state.params, decay=self.ema.decay,
+                                 warmup=self.ema.warmup)
+        self._build_jitted_steps()
+        if self.ckpt is not None and self.ckpt.latest_step() is not None:
+            return self.resume()  # journals 'resumed'; restores EMA/loggers
+        if self.journal is not None:
+            self.journal.write(
+                "note", note="backend rebuilt without a checkpoint: "
+                             "replaying from scratch",
+                epoch=int(fallback_epoch))
+        return fallback_epoch
+
     def _preempt_save(self, epoch: int) -> None:
-        """Synchronous best-effort checkpoint on the preemption path, honest
-        about the outcome (the VM dies shortly; the operator must know
-        whether the step made it to disk)."""
+        """The SIGTERM escalation ladder's final rung: checkpoint-now-and-
+        requeue. The flight recorder already dumped its `preempt` bundle
+        from the signal hook; here (at the cross-host-agreed step
+        boundary, on the main thread) the state is checkpointed
+        synchronously through the atomic crc32c sidecar path, journaled as
+        a typed `preempt_checkpoint` event, and the run is marked for the
+        scheduler's requeue exit code (obs.flight.REQUEUE_EXIT_CODE) —
+        honest about the outcome either way (the VM dies shortly; the
+        operator must know whether the step made it to disk)."""
+        from deep_vision_tpu.obs import flight as _flight
+
         step = int(self.state.step)
+        self.preempted = True
         if self.ckpt is None:
             print(f"preempted at step {step}: NO checkpoint manager, "
                   "state not saved; exiting fit", flush=True)
+            if self.journal is not None:
+                self.journal.write("preempt_checkpoint", step=step,
+                                   epoch=int(epoch), saved=False,
+                                   reason="no checkpoint manager")
+            _flight.request_requeue()
             return
         saved = self._save_checkpoint(epoch)
         self.ckpt.wait()
@@ -642,6 +742,11 @@ class Trainer:
             print(f"preempted at step {step}: checkpoint manager DECLINED "
                   f"the save (latest on disk: {self.ckpt.latest_step()}); "
                   "exiting fit", flush=True)
+        if self.journal is not None:
+            self.journal.write("preempt_checkpoint", step=step,
+                               epoch=int(epoch), saved=bool(saved),
+                               dir=self.ckpt.directory)
+        _flight.request_requeue()
 
     def _grouped(self, data):
         """Coalesce host batches into lists of `multistep` for the scan
@@ -874,16 +979,26 @@ class Trainer:
         instead — resume() survives a save the crash tore in half. When
         NOTHING valid remains, returns 0: restarting from scratch is the
         honest floor of the degradation ladder, and the journal records
-        why."""
+        why.
+
+        Cross-mesh: the restore is handed THIS trainer's mesh, so a
+        checkpoint written on a different topology (8 devices, say) lands
+        re-placed against the current one (4, or 1) per the sharding
+        metadata the save recorded — a preempted run resumes on whatever
+        slice the scheduler gives back."""
         assert self.ckpt is not None, "no CheckpointManager configured"
         with span("checkpoint/restore", step=step if step is not None
                   else -1):
-            self.state, host_state = self.ckpt.restore(self.state, step)
+            self.state, host_state = self.ckpt.restore(self.state, step,
+                                                       mesh=self.mesh)
         if self.journal is not None:
             self.journal.write(
                 "note", note="resumed", step=int(self.state.step),
                 host_state_found=host_state is not None)
-        self.state = jax.device_put(self.state, replicated(self.mesh))
+        if not getattr(self.ckpt, "last_restore_placed", False):
+            # legacy manager (or nothing restored): the old blanket
+            # replicate keeps the state on this trainer's mesh
+            self.state = jax.device_put(self.state, replicated(self.mesh))
         if self.ema is not None:
             restored_ema, ema_host = (None, None)
             if self._ema_ckpt is not None:

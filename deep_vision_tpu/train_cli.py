@@ -327,7 +327,8 @@ def build_trainer(cfg: ExperimentConfig, train_fn, ckpt_dir: Optional[str],
                   autoprof=None,
                   multistep: int = 1,
                   device_prefetch: int = 0,
-                  opt_state_dtype: Optional[str] = None):
+                  opt_state_dtype: Optional[str] = None,
+                  backend_supervisor=None):
     import functools
 
     import jax.numpy as jnp
@@ -405,6 +406,7 @@ def build_trainer(cfg: ExperimentConfig, train_fn, ckpt_dir: Optional[str],
         telemetry_sample_every=telemetry_sample_every,
         health=health, autoprof=autoprof,
         multistep=multistep, device_prefetch=device_prefetch,
+        backend_supervisor=backend_supervisor,
     )
 
 
@@ -778,6 +780,23 @@ def main(argv: Optional[List[str]] = None) -> int:
                              "stderr and a 'health' journal event (a hung "
                              "multi-host collective stays diagnosable "
                              "post-mortem)")
+    parser.add_argument("--skip-preflight", action="store_true",
+                        help="skip the environment preflight (backend "
+                             "liveness + version handshake, mesh-shape "
+                             "sanity, checkpoint-dir writability) that "
+                             "otherwise runs first so a doomed run fails "
+                             "in seconds instead of minutes "
+                             "(tools/preflight.py, `make preflight`)")
+    parser.add_argument("--backend-retries", type=int, default=0,
+                        metavar="N",
+                        help="treat a lost backend (dropped connection, "
+                             "dead-tunnel timeout) as an expected input: "
+                             "rebuild the jitted step, restore the last "
+                             "checkpoint, and replay, up to N times — "
+                             "journaled as typed backend_lost/"
+                             "backend_recovered events "
+                             "(resilience/elastic.py BackendSupervisor; "
+                             "0 = fail on the first backend error)")
     parser.add_argument("--fault-spec", default=None, metavar="SPEC",
                         help="inject deterministic faults at named I/O "
                              "points (resilience/faults.py), e.g. "
@@ -851,11 +870,34 @@ def main(argv: Optional[List[str]] = None) -> int:
                              "Hourglass/tensorflow/main.py:50-65")
     args = parser.parse_args(argv)
 
+    # the requeue latch is process-wide and main() may be called more than
+    # once per process (tests, notebooks): this run's verdict starts clean
+    from deep_vision_tpu.obs import flight as _flight_mod
+
+    _flight_mod.clear_requeue()
     if args.debug_nans:
         import jax as _jax_cfg
 
         _jax_cfg.config.update("jax_debug_nans", True)
     cfg = get_config(args.model)
+
+    # environment preflight FIRST (tools/preflight.py): a dead tunnel, a
+    # libtpu version skew, or an unwritable checkpoint volume fails here
+    # in seconds — before any dataloader, compile, or epoch burns minutes
+    # proving the same thing (MULTICHIP_r01 died 4 minutes in on what this
+    # catches up front)
+    if not args.skip_preflight:
+        from deep_vision_tpu.tools.preflight import render, run_preflight
+
+        pf_ckpt = args.ckpt_dir or os.path.join("checkpoints", cfg.name)
+        if args.checkpoint and args.checkpoint != "auto":
+            pf_ckpt = args.checkpoint  # saves follow the resume dir
+        pf_ok, pf_results = run_preflight(ckpt_dir=pf_ckpt)
+        if not pf_ok:
+            render(pf_results)
+            print("preflight FAILED: fix the environment (or pass "
+                  "--skip-preflight to proceed anyway)", flush=True)
+            return 1
     if args.epochs is not None:
         cfg.epochs = args.epochs
     if args.batch_size is not None:
@@ -1018,6 +1060,15 @@ def main(argv: Optional[List[str]] = None) -> int:
                     print(f"preempted in epoch {epoch}: "
                           + ("checkpoint written" if saved
                              else "checkpoint DECLINED (nothing new to save)"))
+                    # same SIGTERM escalation as Trainer._preempt_save:
+                    # typed event + the scheduler's requeue exit code
+                    if journal is not None:
+                        journal.write(
+                            "preempt_checkpoint",
+                            step=int(gan_ckpt.latest_step() or 0),
+                            epoch=epoch, saved=bool(saved),
+                            dir=ckpt_dir)
+                    _flight_mod.request_requeue()
                     break
                 if (epoch + 1) % gan_save_every == 0:
                     trainer.save(gan_ckpt, epoch)
@@ -1025,7 +1076,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         _maybe_upload(args, ckpt_dir)
         _finish_obs(args, journal, tracer=tracer, health=health,
                     autoprof=autoprof, flight=flight)
-        return 0
+        # a graceful preemption exits with the requeue code (EX_TEMPFAIL):
+        # the scheduler resubmits and the run resumes from the preempt
+        # checkpoint — on whatever mesh the new allocation provides
+        return (_flight_mod.REQUEUE_EXIT_CODE
+                if _flight_mod.requeue_requested() else 0)
 
     ckpt_dir = args.ckpt_dir or os.path.join("checkpoints", cfg.name)
     journal = _make_journal(args, cfg, budget=budget)
@@ -1035,6 +1090,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     autoprof = _make_autoprof(
         args, journal, ckpt_dir,
         window=_parse_profile_window(parser, args.profile_window))
+    supervisor = None
+    if args.backend_retries > 0:
+        from deep_vision_tpu.resilience.elastic import BackendSupervisor
+
+        supervisor = BackendSupervisor(max_retries=args.backend_retries,
+                                       journal=journal, name="train.backend")
     trainer = build_trainer(cfg, train_fn, ckpt_dir,
                             tb_dir=args.tensorboard_dir,
                             checkify_errors=args.checkify,
@@ -1046,7 +1107,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                             device_prefetch=args.device_prefetch,
                             opt_state_dtype=(
                                 None if args.opt_state_dtype == "float32"
-                                else args.opt_state_dtype))
+                                else args.opt_state_dtype),
+                            backend_supervisor=supervisor)
     if journal is not None:
         # an unwinding run (exception/SIGTERM) still stops an in-flight
         # profiler trace and flushes writers via the atexit crash path
@@ -1087,7 +1149,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     _maybe_upload(args, ckpt_dir)
     _finish_obs(args, journal, tracer=tracer, health=health,
                 autoprof=autoprof, flight=flight)
-    return 0
+    # SIGTERM escalation epilogue: the preempt checkpoint is on disk and
+    # journaled — exit with the requeue code so the scheduler resubmits
+    # (resume rides the cross-mesh restore if the new slice is smaller)
+    return (_flight_mod.REQUEUE_EXIT_CODE
+            if _flight_mod.requeue_requested() else 0)
 
 
 if __name__ == "__main__":

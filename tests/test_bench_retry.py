@@ -29,6 +29,15 @@ def _fresh_bench_process_state(monkeypatch):
     monkeypatch.setattr(bench, "_backend_alive", lambda *a, **k: (True, None))
 
 
+def _instant_retries(monkeypatch):
+    """Zero-delay retry schedule: the BackendSupervisor bench builds via
+    _retry_policy() must not sleep real backoff in unit tests (budget
+    still honors a monkeypatched MAX_RETRIES at call time)."""
+    monkeypatch.setattr(bench, "_retry_policy", lambda: bench.RetryPolicy(
+        name="bench.window", max_attempts=bench.MAX_RETRIES + 1,
+        base_delay_s=0.0, jitter=0.0, retry_on=Exception))
+
+
 class _FlakyStep:
     """Raises on the Nth call, healthy otherwise."""
 
@@ -69,7 +78,7 @@ def test_transient_failure_mid_window_rebuilds_and_completes(monkeypatch):
         [bench.WARMUP_STEPS + bench.TIMED_STEPS + 5, None]
     )
     monkeypatch.setattr(bench, "build_bench", fake_build)
-    monkeypatch.setattr(bench, "_recover_backend", lambda attempt: None)
+    _instant_retries(monkeypatch)
     (dts, step, state, batch, bs, n_chips, devs, errors) = (
         bench._timed_windows(8, 1)
     )
@@ -103,7 +112,7 @@ def test_retry_exhaustion_keeps_completed_windows(monkeypatch, capsys):
         return step, None, batch, batch_per_chip, 1, [fake_dev]
 
     monkeypatch.setattr(bench, "build_bench", build_once_then_die)
-    monkeypatch.setattr(bench, "_recover_backend", lambda attempt: None)
+    _instant_retries(monkeypatch)
     monkeypatch.setattr(bench, "_device_step_ms", lambda *a, **kw: None)
     monkeypatch.setattr(bench, "MAX_RETRIES", 2)
     args = types.SimpleNamespace(batch=8, multistep=1)
@@ -119,7 +128,7 @@ def test_main_emits_json_even_when_everything_fails(monkeypatch, capsys):
         raise RuntimeError("tunnel down")
 
     monkeypatch.setattr(bench, "build_bench", always_broken)
-    monkeypatch.setattr(bench, "_recover_backend", lambda attempt: None)
+    _instant_retries(monkeypatch)
     monkeypatch.setattr(bench, "MAX_RETRIES", 2)
     args = types.SimpleNamespace(batch=8, multistep=1)
     bench.main(args)

@@ -43,6 +43,17 @@ obs/merge.py):
                   `lock_order_violation` events — and a forced A->B/B->A
                   inversion must be detected, journaled with both
                   acquisition stacks, and pass `--strict`.
+  7. shrink-mesh  the elastic loop end-to-end: a child training on a
+                  FORCED 4-device CPU mesh is SIGTERMed under live
+                  training — it must write an atomic preempt checkpoint,
+                  journal a typed `preempt_checkpoint` event, and exit
+                  with the scheduler's requeue code (EX_TEMPFAIL 75,
+                  obs.flight.REQUEUE_EXIT_CODE); a second child then
+                  resumes from that checkpoint under a 2-device mesh
+                  (cross-mesh sidecar sharding metadata), with the step
+                  counter CONTINUING from the preempt step — losses
+                  resume, they do not restart — and both journals
+                  passing `check_journal --strict`.
 
 Plus overhead probes: with no spec installed an injection point is one
 module-global load + None check, flight recording (one tap call per
@@ -140,8 +151,7 @@ def write_shards(data_dir: str) -> None:
     )
 
 
-def run_child(train_args: List[str], log_path: str,
-              timeout: float = 600.0) -> int:
+def _child_env(extra_env: Optional[dict] = None) -> dict:
     # every child trains with the runtime lock sanitizer armed
     # (train_cli.arm_from_env): an inversion between the journal, flight,
     # health-watchdog, or data-budget locks journals a typed
@@ -152,14 +162,36 @@ def run_child(train_args: List[str], log_path: str,
     # ask for one (phase 3 resumes WITHOUT faults)
     env.pop("DVT_FAULT_SPEC", None)
     env.pop("DVT_FAULT_SEED", None)
+    if extra_env:
+        env.update(extra_env)  # phase 7 REPLACES XLA_FLAGS to force a
+                               # specific virtual device count per child
+    return env
+
+
+def run_child(train_args: List[str], log_path: str,
+              timeout: float = 600.0,
+              extra_env: Optional[dict] = None) -> int:
     with open(log_path, "w") as log:
         proc = subprocess.run(
             [sys.executable, os.path.abspath(__file__), "--child"]
             + train_args,
-            cwd=ROOT, env=env, stdout=log, stderr=subprocess.STDOUT,
-            timeout=timeout,
+            cwd=ROOT, env=_child_env(extra_env), stdout=log,
+            stderr=subprocess.STDOUT, timeout=timeout,
         )
     return proc.returncode
+
+
+def start_child(train_args: List[str], log_path: str,
+                extra_env: Optional[dict] = None):
+    """Popen form for phases that signal the child mid-run (phase 7 sends
+    SIGTERM under live training); returns (proc, log_file)."""
+    log = open(log_path, "w")
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--child"] + train_args,
+        cwd=ROOT, env=_child_env(extra_env), stdout=log,
+        stderr=subprocess.STDOUT,
+    )
+    return proc, log
 
 
 def read_jsonl(path: str) -> List[dict]:
@@ -440,6 +472,97 @@ def probe_obs_merge(work: str, f: "Failures") -> None:
     f.check(rc == 0, f"obs_report --merged renders the timeline (rc={rc})")
 
 
+def phase7_shrink_mesh(work: str, data_dir: str, f: "Failures") -> None:
+    """The elastic loop, end to end on CPU: train on a forced 4-device
+    mesh, SIGTERM it under live training, then resume the run under 2
+    devices from the preempt checkpoint — the 'fleet shrank while you
+    were requeued' scenario. The first child must exit with the requeue
+    code after an atomic checkpoint + typed `preempt_checkpoint` event;
+    the second must restore that exact step (cross-mesh restore via the
+    sidecar sharding metadata) and CONTINUE counting from it."""
+    from deep_vision_tpu.obs.flight import REQUEUE_EXIT_CODE
+
+    ckpt = os.path.join(work, "ckpt_shrink")
+    j_a = os.path.join(work, "journal_shrink_preempt.jsonl")
+    j_b = os.path.join(work, "journal_shrink_resume.jsonl")
+
+    proc, log = start_child(
+        ["-m", CONFIG, "--data-dir", data_dir, "--epochs", "6",
+         "--ckpt-dir", ckpt, "--journal", j_a],
+        os.path.join(work, "phase7a.log"),
+        extra_env={"XLA_FLAGS": "--xla_force_host_platform_device_count=4"},
+    )
+    # SIGTERM only once training is demonstrably live (>= 3 step events
+    # in the journal): preempting during compile would prove less
+    try:
+        deadline = time.time() + 420
+        n_steps = 0
+        while time.time() < deadline and proc.poll() is None:
+            n_steps = sum(1 for e in read_jsonl(j_a)
+                          if e.get("event") == "step")
+            if n_steps >= 3:
+                break
+            time.sleep(0.5)
+        f.check(proc.poll() is None and n_steps >= 3,
+                f"reached live training on the 4-device mesh before "
+                f"SIGTERM ({n_steps} steps)")
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=300)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=60)
+        log.close()
+    f.check(rc == REQUEUE_EXIT_CODE,
+            f"preempted run exits with the requeue code "
+            f"({rc} == EX_TEMPFAIL {REQUEUE_EXIT_CODE})")
+    ev_a = read_jsonl(j_a)
+    mesh_a = [e for e in ev_a
+              if e.get("event") == "note" and e.get("mesh_shape")]
+    f.check(bool(mesh_a) and mesh_a[0]["mesh_shape"].get("data") == 4,
+            "first run trained on the forced 4-device mesh")
+    pc = [e for e in ev_a if e.get("event") == "preempt_checkpoint"]
+    f.check(len(pc) == 1 and pc[0].get("saved") is True,
+            f"SIGTERM escalated to an atomic preempt checkpoint "
+            f"(journaled preempt_checkpoint, saved={pc and pc[0].get('saved')})")
+    f.check(check_journal_strict(j_a),
+            "check_journal --strict accepts the preempted journal")
+    if not pc or not pc[0].get("saved"):
+        return  # nothing to resume from; the failures above tell the story
+    saved_step = int(pc[0]["step"])
+
+    rc = run_child(
+        ["-m", CONFIG, "--data-dir", data_dir, "--epochs", "6",
+         "--ckpt-dir", ckpt, "-c", ckpt, "--journal", j_b],
+        os.path.join(work, "phase7b.log"),
+        extra_env={"XLA_FLAGS": "--xla_force_host_platform_device_count=2"},
+    )
+    f.check(rc == 0, f"resumed run completed on the 2-device mesh (rc={rc})")
+    ev_b = read_jsonl(j_b)
+    mesh_b = [e for e in ev_b
+              if e.get("event") == "note" and e.get("mesh_shape")]
+    f.check(bool(mesh_b) and mesh_b[0]["mesh_shape"].get("data") == 2,
+            "resume ran on the SHRUNK 2-device mesh")
+    resumed = [e for e in ev_b
+               if e.get("event") == "note" and e.get("note") == "resumed"]
+    f.check(bool(resumed) and resumed[0].get("step") == saved_step,
+            f"cross-mesh restore landed on the preempt step "
+            f"({resumed and resumed[0].get('step')} == {saved_step})")
+    resharded = [e for e in ev_b if e.get("event") == "note"
+                 and e.get("note") == "ckpt_resharded"]
+    f.check(bool(resharded)
+            and resharded[0].get("saved_mesh", {}).get("data") == 4
+            and resharded[0].get("mesh", {}).get("data") == 2,
+            "restore journaled the 4 -> 2 device re-placement")
+    steps_b = sorted(e.get("step") for e in ev_b
+                     if e.get("event") == "step")
+    f.check(bool(steps_b) and steps_b[0] == saved_step + 1,
+            f"losses CONTINUE from the checkpoint (first resumed step "
+            f"{steps_b[:1]} == {saved_step + 1}), not restart at 1")
+    f.check(check_journal_strict(j_b),
+            "check_journal --strict accepts the resumed journal")
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     if argv and argv[0] == "--child":
@@ -569,6 +692,11 @@ def main(argv: Optional[List[str]] = None) -> int:
             and not any(e.get("event") == "lock_order_violation"
                         for e in ev3),
             "armed children journaled zero lock_order_violation events")
+
+    # -- phase 7: shrink the mesh mid-run -------------------------------
+    print("phase 7: SIGTERM under live 4-device training -> preempt "
+          "checkpoint -> resume on a 2-device mesh")
+    phase7_shrink_mesh(work, data_dir, f)
 
     # -- disabled-injection overhead ------------------------------------
     ns = probe_disabled_overhead()

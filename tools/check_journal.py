@@ -53,6 +53,9 @@ EVENT_FIELDS = {
     "fault": ("point", "kind"),
     "data_skip": ("path", "offset", "reason"),
     "ckpt_quarantine": ("step", "reason"),
+    "backend_lost": ("attempt", "error", "kind"),
+    "backend_recovered": ("attempt",),
+    "preempt_checkpoint": ("step", "saved"),
     "profile_capture": ("reason", "outcome"),
     "flight_dump": ("reason", "dir", "outcome"),
     "straggler": ("step", "gap_ms", "host"),
@@ -80,6 +83,10 @@ SERVE_REQUEST_OUTCOMES = {"ok", "error", "rejected", "cancelled"}
 SERVE_DRAIN_REASONS = {"close", "sigterm"}
 SERVE_DRAIN_OUTCOMES = {"flushed", "timeout"}
 LOCK_CONTENTION_KINDS = {"hold", "wait"}
+# resilience/elastic.py BACKEND_LOST_KINDS (kept in sync by
+# tests/test_elastic.py): the classifier's verdict on a lost backend
+BACKEND_LOST_KINDS = {"connection_lost", "timeout", "version_skew",
+                      "unknown"}
 
 
 def check_journal(path: str, require_exit: bool = False,
@@ -184,6 +191,20 @@ def check_journal(path: str, require_exit: bool = False,
                     errors.append(f"{path}:{i}: lock_order_violation {k} "
                                   f"must be a lock name, got "
                                   f"{row.get(k)!r}")
+        if ev == "backend_lost" and row.get("kind") not in BACKEND_LOST_KINDS:
+            errors.append(f"{path}:{i}: unknown backend_lost kind "
+                          f"{row.get('kind')!r}")
+        if ev == "backend_recovered" and \
+                not isinstance(row.get("attempt"), int):
+            errors.append(f"{path}:{i}: backend_recovered attempt must be "
+                          f"an int, got {row.get('attempt')!r}")
+        if ev == "preempt_checkpoint":
+            if not isinstance(row.get("saved"), bool):
+                errors.append(f"{path}:{i}: preempt_checkpoint saved must "
+                              f"be a bool, got {row.get('saved')!r}")
+            if not isinstance(row.get("step"), int):
+                errors.append(f"{path}:{i}: preempt_checkpoint step must "
+                              f"be an int, got {row.get('step')!r}")
         if ev == "straggler":
             if not isinstance(row.get("host"), int):
                 errors.append(f"{path}:{i}: straggler host must be a "
