@@ -51,7 +51,7 @@ lint-baseline:
 # obs-smoke and chaos-smoke — the telemetry artifacts must validate and
 # the resilience contracts must hold before the tests count
 verify: SHELL := /bin/bash
-verify: lint preflight perf-smoke obs-smoke chaos-smoke serve-smoke
+verify: lint preflight perf-smoke obs-smoke chaos-smoke serve-smoke fleet-smoke
 	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
 
 # environment preflight: backend liveness + libtpu/client version
@@ -97,6 +97,18 @@ obs-smoke:
 # zero lock_order_violation events
 serve-smoke:
 	JAX_PLATFORMS=cpu python tools/serve_smoke.py --workdir artifacts/serve_smoke
+
+# fleet smoke: the serving layer at fleet shape (tools/loadgen.py) — a
+# 3-replica pool under seeded load survives an injected replica death
+# request-scoped (typed replica_lost/replica_recovered + supervised
+# respawn), promotes a canary weight swap AND auto-rolls-back a
+# poisoned one under live traffic, sheds an overload blast by policy
+# (serve_shed accounting exact, p99 of admitted traffic held), drains
+# clean with a balanced fleet ledger, and compiles NOTHING after
+# warmup — including across both swaps. Locksmith armed throughout;
+# journals pass check_journal --strict; no stray flight bundles
+fleet-smoke:
+	JAX_PLATFORMS=cpu python tools/loadgen.py --workdir artifacts/fleet_smoke
 
 # resilience smoke: a record-backed CPU train under injected faults
 # (skipped bad records within budget, SIGKILL mid-checkpoint-save,
@@ -163,4 +175,4 @@ ps:
 native:
 	$(MAKE) -C native
 
-.PHONY: train resume train-fg test lint lint-baseline verify preflight obs-smoke chaos-smoke serve-smoke perf-smoke bench bench-evidence roofline demo demo-gan demo-real dryrun tb ps native
+.PHONY: train resume train-fg test lint lint-baseline verify preflight obs-smoke chaos-smoke serve-smoke fleet-smoke perf-smoke bench bench-evidence roofline demo demo-gan demo-real dryrun tb ps native

@@ -132,7 +132,11 @@ class FlightRecorder:
     def observe(self, row: dict) -> None:
         """Journal tap: route one event row into the ring buffers, and
         trigger a dump when the row itself is the emergency (a watchdog
-        hang dump, a health-abort verdict)."""
+        hang dump, a health-abort verdict). A serve-plane abort
+        (monitor="serve": the router fails one batch's requests and
+        keeps answering — a canary rejecting poisoned weights is the
+        designed outcome, not a death) is request-scoped by contract
+        and must NOT leave a crash-grade postmortem."""
         ev = row.get("event")
         with self._lock:
             self._tail.append(row)
@@ -143,7 +147,8 @@ class FlightRecorder:
         if ev == "health" and not self._dumping:
             if row.get("kind") == "hang":
                 self.dump("hang")
-            elif row.get("action") == "abort":
+            elif row.get("action") == "abort" \
+                    and row.get("monitor") != "serve":
                 self.dump("health_abort")
 
     def note(self, category: str, **fields) -> None:

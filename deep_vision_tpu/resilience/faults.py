@@ -5,8 +5,9 @@ Storage-failure handling is only trustworthy if it is *testable on CPU*
 injected failures, not by waiting for real ones. This module is the
 repo's switchboard: named injection points sit at every I/O boundary
 (record read, sample decode, checkpoint save/restore, sidecar write,
-journal flush) and compile to a single module-global None-check when no
-spec is installed, so production runs pay nothing.
+journal flush, serving-replica execution) and compile to a single
+module-global None-check when no spec is installed, so production runs
+pay nothing.
 
 Spec grammar (the `--fault-spec` CLI string)::
 
@@ -45,6 +46,7 @@ import os
 import random
 import signal
 import sys
+import threading
 from typing import List, Optional
 
 ENV_SPEC = "DVT_FAULT_SPEC"
@@ -59,6 +61,10 @@ POINTS = (
     "ckpt.restore",   # orbax array-tree restore
     "ckpt.sidecar",   # host-state JSON sidecar write (has after_write stage)
     "journal.flush",  # one journal line write+flush
+    "serve.replica",  # a serving replica's execution boundary (serve/pool.py
+                      # batch dispatch + respawn) and the swap-restore step
+                      # (serve/swap.py): io_error = replica death / failed
+                      # swap load, crash = the whole serving process dies
 )
 KINDS = ("io_error", "crash", "crash_after_write", "corrupt")
 
@@ -84,18 +90,23 @@ class _Rule:
         self.hits = 0
         self.fired = 0
         self._rng = random.Random(f"{seed}:{point}:{kind}")
+        # points can be hit from several threads at once (serve.replica
+        # fires on every pool dispatcher): the hit counter must stay
+        # exact or the @N deterministic form fires twice or never
+        self._tlock = threading.Lock()
 
     def triggers(self) -> bool:
-        self.hits += 1
-        if self.nth is not None:
-            if self.hits == self.nth:
+        with self._tlock:
+            self.hits += 1
+            if self.nth is not None:
+                if self.hits == self.nth:
+                    self.fired += 1
+                    return True
+                return False
+            if self._rng.random() < self.probability:
                 self.fired += 1
                 return True
             return False
-        if self._rng.random() < self.probability:
-            self.fired += 1
-            return True
-        return False
 
     def __repr__(self):
         when = self.nth if self.nth is not None else f"@{self.probability}"

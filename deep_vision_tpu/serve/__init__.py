@@ -18,13 +18,37 @@ predictors become a server —
   `preempt` flight bundle.
 - `slo`: `SLOTracker`, p50/p95/p99 request latency from the obs
   registry histograms plus queue-depth / batch-occupancy /
-  padding-waste gauges.
+  padding-waste gauges, offered-vs-admitted accounting, and the
+  per-replica depth gauges the pool routes by.
 
-Journal events: `serve_request`, `serve_batch`, `serve_drain` (schemas
-in obs/README.md, validated by tools/check_journal.py). Trace spans:
-`serve/warmup`, `serve/batch`, `serve/drain`. The CI teeth are
-`make serve-smoke` (tools/serve_smoke.py) and tests/test_serve.py.
+The fleet layer above one Router (the "millions of users" shape):
+
+- `pool`: `ReplicaPool`, N in-process replicas each owning a warmed
+  Engine + Server, load-aware routing, `warming/serving/draining/dead`
+  health states, replica-death detection with request-scoped failure
+  and supervised respawn (`replica_lost` / `replica_recovered` events).
+- `admission`: `AdmissionController` + `TokenBucket` — bounded
+  per-model queues and request budgets; overload sheds by policy
+  (typed `serve_shed` events, `ShedError` to the client) instead of
+  collapsing the latency tail.
+- `swap`: `SwapController` — zero-downtime canary weight swap: load via
+  the cross-mesh checkpoint restore, bind a shadow engine over the SAME
+  warmed executables (weights are a runtime argument — zero recompiles,
+  counter-verified), canary x% of live traffic, auto-promote or
+  auto-rollback (`serve_swap` events).
+
+Journal events: `serve_request`, `serve_batch`, `serve_drain`,
+`serve_shed`, `serve_swap`, `replica_lost`, `replica_recovered`
+(schemas in obs/README.md, validated by tools/check_journal.py). Trace
+spans: `serve/warmup`, `serve/batch`, `serve/drain`. The CI teeth are
+`make serve-smoke` (tools/serve_smoke.py), `make fleet-smoke`
+(tools/loadgen.py), tests/test_serve.py and tests/test_serve_pool.py.
 """
+from deep_vision_tpu.serve.admission import (
+    AdmissionController,
+    ShedError,
+    TokenBucket,
+)
 from deep_vision_tpu.serve.buckets import (
     DEFAULT_BUCKETS,
     bucket_for,
@@ -33,21 +57,33 @@ from deep_vision_tpu.serve.buckets import (
     split_rows,
 )
 from deep_vision_tpu.serve.engine import Engine, ModelEntry, ServeError
+from deep_vision_tpu.serve.pool import REPLICA_STATES, ReplicaLost, ReplicaPool
 from deep_vision_tpu.serve.queue import BatchingQueue, QueueClosed, Request
 from deep_vision_tpu.serve.router import Server, ServerClosed
-from deep_vision_tpu.serve.slo import SLOTracker
+from deep_vision_tpu.serve.slo import SHED_REASONS, SLOTracker
+from deep_vision_tpu.serve.swap import SWAP_OUTCOMES, SWAP_PHASES, SwapController
 
 __all__ = [
+    "AdmissionController",
     "BatchingQueue",
     "DEFAULT_BUCKETS",
     "Engine",
     "ModelEntry",
     "QueueClosed",
+    "REPLICA_STATES",
+    "ReplicaLost",
+    "ReplicaPool",
     "Request",
+    "SHED_REASONS",
     "SLOTracker",
+    "SWAP_OUTCOMES",
+    "SWAP_PHASES",
     "ServeError",
     "Server",
     "ServerClosed",
+    "ShedError",
+    "SwapController",
+    "TokenBucket",
     "bucket_for",
     "normalize_buckets",
     "pad_batch",

@@ -1,0 +1,138 @@
+"""Admission control and load shedding: overload degrades by policy.
+
+An overloaded server without admission control fails by latency
+collapse — every queue grows without bound, every request eventually
+answers, and the p99 quietly becomes the timeout. The production
+posture is the opposite: decide AT THE FRONT DOOR whether a request can
+be served within SLO, and reject the rest immediately (reject-newest:
+the requests already queued are the ones closest to their deadline, so
+the newcomer is the cheapest to turn away). A shed request costs one
+exception and one counter; an admitted request carries an implicit
+promise that its latency tail is defensible.
+
+Two independent budgets, both per model:
+
+- **bounded queue**: `max_queue_depth` caps requests in flight (accepted
+  but unresolved) per model across the pool. The cap is the latency
+  bound in disguise: depth x batch service time ~= worst-case queue
+  wait. Reason: `queue_full`.
+- **token bucket**: `rate_per_s` + `burst` cap the sustained admission
+  rate while allowing short bursts. Reason: `rate_limited`.
+
+A draining pool sheds everything with reason `draining` — shutdown is
+an overload of size infinity.
+
+Every shed emits a typed `serve_shed` journal event and bumps
+`serve_shed_total{model,reason}` (serve/slo.py), so the offered-vs-
+admitted gap is first-class in `SLOTracker.report()` and
+tools/obs_report.py — shed traffic can never silently flatter the p99.
+Clients see `ShedError` synchronously from `ReplicaPool.submit` (no
+Future is created for a shed request: backpressure must be cheap).
+"""
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, Optional
+
+from deep_vision_tpu.obs import locksmith
+from deep_vision_tpu.serve.engine import ServeError
+from deep_vision_tpu.serve.slo import SHED_REASONS
+
+
+class ShedError(ServeError):
+    """Request rejected by admission control; carries the shed reason."""
+
+    def __init__(self, model: str, reason: str):
+        super().__init__(f"request for {model!r} shed: {reason}")
+        self.model = model
+        self.reason = reason
+
+
+class TokenBucket:
+    """Classic token bucket: `burst` capacity, `rate_per_s` refill.
+
+    `take()` consumes one token if available. Time is injectable so
+    tests (and the seeded fleet-smoke arrival pattern) are exact.
+    """
+
+    def __init__(self, rate_per_s: float, burst: int,
+                 clock: Callable[[], float] = time.monotonic):
+        if burst < 1:
+            raise ValueError(f"burst must be >= 1, got {burst}")
+        if rate_per_s < 0:
+            raise ValueError(f"rate_per_s must be >= 0, got {rate_per_s}")
+        self.rate_per_s = float(rate_per_s)
+        self.burst = int(burst)
+        self._clock = clock
+        self._tokens = float(burst)
+        self._t = clock()
+
+    def take(self) -> bool:
+        now = self._clock()
+        self._tokens = min(self.burst,
+                           self._tokens + (now - self._t) * self.rate_per_s)
+        self._t = now
+        if self._tokens >= 1.0:
+            self._tokens -= 1.0
+            return True
+        return False
+
+
+class AdmissionController:
+    """Per-model admission verdicts for a ReplicaPool front door.
+
+    `admit(model, queue_depth)` returns None (admitted) or a shed reason
+    from `slo.SHED_REASONS`. The queue bound is checked before the rate
+    budget: a full queue means the pool is already beyond its latency
+    promise, so spending a token on a request that would be shed anyway
+    would let a burst of queue_full sheds eat the budget of the traffic
+    that CAN be served.
+
+    Thread-safe: one lock guards the per-model buckets (the pool calls
+    admit from every client thread).
+    """
+
+    def __init__(self, max_queue_depth: int = 64,
+                 rate_per_s: Optional[float] = None, burst: Optional[int] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        if max_queue_depth < 1:
+            raise ValueError(
+                f"max_queue_depth must be >= 1, got {max_queue_depth}")
+        self.max_queue_depth = int(max_queue_depth)
+        self.rate_per_s = rate_per_s
+        self.burst = int(burst if burst is not None
+                         else max(1, int(rate_per_s or 1)))
+        self._clock = clock
+        self._buckets: Dict[str, TokenBucket] = {}
+        self._lock = locksmith.lock("serve.admission")
+        self.draining = False
+
+    def _bucket(self, model: str) -> Optional[TokenBucket]:
+        if self.rate_per_s is None:
+            return None
+        b = self._buckets.get(model)
+        if b is None:
+            b = TokenBucket(self.rate_per_s, self.burst, clock=self._clock)
+            self._buckets[model] = b
+        return b
+
+    def admit(self, model: str, queue_depth: int) -> Optional[str]:
+        """None = admitted; otherwise the shed reason (SHED_REASONS)."""
+        with self._lock:
+            if self.draining:
+                return "draining"
+            if queue_depth >= self.max_queue_depth:
+                return "queue_full"
+            bucket = self._bucket(model)
+            if bucket is not None and not bucket.take():
+                return "rate_limited"
+            return None
+
+    def start_draining(self) -> None:
+        """Every subsequent request sheds with reason `draining`."""
+        with self._lock:
+            self.draining = True
+
+
+assert set(SHED_REASONS) == {"queue_full", "rate_limited", "draining"}, \
+    "admission reasons and slo.SHED_REASONS must stay in sync"

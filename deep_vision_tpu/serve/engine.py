@@ -156,6 +156,73 @@ class Engine:
     def warmed_buckets(self, name: str) -> Tuple[int, ...]:
         return tuple(b for (n, b) in self._compiled if n == name)
 
+    # -- weight swap (serve/swap.py) -----------------------------------------
+
+    @staticmethod
+    def _check_like(name: str, old, new) -> None:
+        """New variables must be executable-compatible with the old ones:
+        the compiled executables were lowered against the OLD avals, and
+        variables are a runtime argument, so same tree structure + same
+        per-leaf shape/dtype means the swap needs no compiler at all."""
+        old_s = jax.tree_util.tree_structure(old)
+        new_s = jax.tree_util.tree_structure(new)
+        if old_s != new_s:
+            raise ServeError(
+                f"swap variables for {name!r} have a different tree "
+                f"structure than the serving ones ({new_s} != {old_s}); "
+                "a structural change needs a re-warm, not a hot swap")
+        for o, n in zip(jax.tree_util.tree_leaves(old),
+                        jax.tree_util.tree_leaves(new)):
+            if (tuple(getattr(o, "shape", ())) != tuple(getattr(n, "shape", ()))
+                    or np.dtype(getattr(o, "dtype", np.float32))
+                    != np.dtype(getattr(n, "dtype", np.float32))):
+                raise ServeError(
+                    f"swap variables for {name!r} change a leaf aval "
+                    f"({getattr(n, 'shape', ())}/{getattr(n, 'dtype', '?')} "
+                    f"vs {getattr(o, 'shape', ())}/"
+                    f"{getattr(o, 'dtype', '?')}); shape/dtype changes "
+                    "need a re-warm, not a hot swap")
+
+    def set_variables(self, name: str, variables) -> None:
+        """Hot-swap `name`'s weights into the warmed executables.
+
+        Zero-downtime by construction: `run()` reads `entry.variables` at
+        dispatch, the compiled (model, bucket) executables take variables
+        as a runtime argument (argnum 0, never donated), and the avals are
+        validated to match what warmup lowered against — so the swap is
+        one attribute assignment, takes effect at the next batch, and can
+        never touch the compiler (the serve/swap.py canary path asserts
+        this with the backend-compile counter)."""
+        entry = self.entry(name)
+        self._check_like(name, entry.variables, variables)
+        entry.variables = variables
+
+    def clone_with_variables(self, variables_by_model) -> "Engine":
+        """A shadow engine over the SAME compiled executables with new
+        weights for the given models (swap canary: the shadow serves x%
+        of traffic without compiling anything). Models not named keep the
+        serving weights. The clone shares `_compiled` by reference —
+        executables are weight-agnostic, so the shadow is warm at birth."""
+        if not self._warmed:
+            raise ServeError("clone_with_variables() before warmup(): "
+                             "there are no executables to share yet")
+        for name in variables_by_model:
+            self.entry(name)  # unknown model raises the clear error
+        clone = Engine.__new__(Engine)
+        clone.journal = self.journal
+        clone._compiled = self._compiled  # shared, read-only on this path
+        clone._warmed = True
+        clone._g_warmed = self._g_warmed
+        clone._entries = {}
+        for name, entry in self._entries.items():
+            variables = variables_by_model.get(name, entry.variables)
+            if name in variables_by_model:
+                self._check_like(name, entry.variables, variables)
+            clone._entries[name] = ModelEntry(
+                name, entry.fn, variables, entry.input_shape, entry.dtype,
+                entry.buckets)
+        return clone
+
     # -- the request path ----------------------------------------------------
 
     def run(self, name: str, images):

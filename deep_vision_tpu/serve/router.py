@@ -66,12 +66,18 @@ class Server:
     def __init__(self, engine: Engine, journal=None, registry=None,
                  max_wait_ms: float = 5.0, drain_timeout_s: float = 30.0,
                  slo_ms: Optional[float] = None,
-                 health_policy: str = "warn", health=None):
+                 health_policy: str = "warn", health=None,
+                 tags: Optional[dict] = None):
         if health_policy not in HEALTH_POLICIES:
             raise ValueError(
                 f"health_policy {health_policy!r} not in {HEALTH_POLICIES}")
         self.engine = engine
         self.journal = journal
+        # extra fields stamped onto every serve_* / health journal event
+        # this server writes (a ReplicaPool passes {"replica": "r0"} so a
+        # shared fleet journal stays attributable per replica); keys must
+        # not shadow the events' own schema fields
+        self.tags = dict(tags or {})
         self.slo = SLOTracker(registry=registry, slo_ms=slo_ms)
         self.max_wait_ms = float(max_wait_ms)
         self.drain_timeout_s = float(drain_timeout_s)
@@ -182,6 +188,14 @@ class Server:
                 self._fail_request(req, decode_err)
         return req.future
 
+    def counts(self) -> dict:
+        """One consistent snapshot of the request ledger (the drain
+        invariant's four buckets) — a ReplicaPool folds these into its
+        fleet totals when it retires a dead or drained replica."""
+        with self._count_lock:
+            return {"accepted": self.accepted, "completed": self.completed,
+                    "errors": self.errors, "cancelled": self.cancelled}
+
     def _account(self, req: Request, outcome: str, latency_ms: float,
                  error: Optional[str] = None) -> None:
         """Count one request toward exactly one of completed / errors /
@@ -203,7 +217,7 @@ class Server:
             extra = {"error": error[:200]} if error else {}
             self.journal.write("serve_request", model=req.model,
                                latency_ms=round(latency_ms, 3),
-                               outcome=outcome, **extra)
+                               outcome=outcome, **self.tags, **extra)
 
     def _fail_request(self, req: Request, exc: Exception) -> None:
         latency_ms = (time.perf_counter() - req.t_submit) * 1e3
@@ -279,7 +293,7 @@ class Server:
                 padding_waste_pct=round(
                     100.0 * (bucket - len(batch)) / bucket, 1),
                 queue_wait_ms=round(queue_wait_ms, 3),
-                exec_ms=round(exec_ms, 3))
+                exec_ms=round(exec_ms, 3), **self.tags)
         if bad:
             self._emit_nonfinite(model, bad, len(batch))
         if self.health is not None:
@@ -319,7 +333,7 @@ class Server:
             self.journal.write("health", kind="non_finite",
                                policy=self.health_policy, monitor="serve",
                                fields=fields, action=self.health_policy,
-                               model=model, batch_size=size)
+                               model=model, batch_size=size, **self.tags)
 
     # -- drain / shutdown ----------------------------------------------------
 
@@ -373,7 +387,7 @@ class Server:
                 summary = {"reason": reason, "outcome": outcome,
                            **counts, "pending": max(0, pending)}
                 if self.journal is not None:
-                    self.journal.write("serve_drain", **summary)
+                    self.journal.write("serve_drain", **self.tags, **summary)
                 if reason == "sigterm":
                     # the preemption postmortem: same bundle + reason the
                     # trainer's PreemptionGuard dumps, so one flight-dir

@@ -20,18 +20,33 @@ Tracked per model:
                                      lifetime aggregate occupancy
   serve_slo_violations_total{model=} requests over the p99 target
                                      (when an slo_ms target is set)
+  serve_offered_total{model=}        every request the front door SAW,
+                                     admitted or not (serve/pool.py)
+  serve_shed_total{model=,reason=}   requests rejected by admission
+                                     control (serve/admission.py)
+
+Fleet gauges (serve/pool.py): `serve_replica_queue_depth{replica=}` —
+per-replica in-flight depth, the signal load-aware routing steers by.
 
 `report()` collapses all of it into one dict per model (the serving
 summary `tools/obs_report.py` renders from the journal has the same
 shape, so live metrics and postmortem journals read identically).
+Pools report offered vs admitted RPS side by side: a shed request never
+enters the latency histograms, so without the offered line an overloaded
+server that sheds 90% of its traffic would show a flattering p99 —
+`offered_rps`/`admitted_rps` make the gap explicit.
 """
 from __future__ import annotations
 
+import time
 from typing import Dict, Optional
 
 from deep_vision_tpu.obs.registry import Registry, get_registry
 
 OUTCOMES = ("ok", "error", "rejected", "cancelled")
+#: admission-control shed reasons (serve/admission.py); mirrored by
+#: tools/check_journal.py's serve_shed enum
+SHED_REASONS = ("queue_full", "rate_limited", "draining")
 
 
 class SLOTracker:
@@ -42,6 +57,7 @@ class SLOTracker:
         self.registry = registry or get_registry()
         self.slo_ms = slo_ms
         self._models: Dict[str, dict] = {}
+        self._replica_depth: Dict[str, object] = {}
 
     def _m(self, model: str) -> dict:
         m = self._models.get(model)
@@ -82,14 +98,67 @@ class SLOTracker:
                 "violations": r.counter(
                     "serve_slo_violations_total",
                     "requests over the slo_ms target", labels=lbl),
+                "offered": r.counter(
+                    "serve_offered_total",
+                    "requests offered at the front door (incl. shed)",
+                    labels=lbl),
+                "shed": {reason: r.counter(
+                    "serve_shed_total", "requests shed by admission control",
+                    labels={"model": model, "reason": reason})
+                    for reason in SHED_REASONS},
+                "refused": r.counter(
+                    "serve_refused_total",
+                    "requests refused by fleet failure (no serving "
+                    "replica) — NOT policy sheds", labels=lbl),
+                # wall-clock window of the offer stream, for the
+                # offered/admitted RPS in report(); benign last-writer
+                # races only nudge the window edges
+                "t_first": None,
+                "t_last": None,
             }
             self._models[model] = m
         return m
 
-    # -- recording hooks (router calls these) -------------------------------
+    # -- recording hooks (router + pool call these) -------------------------
 
     def queue_depth(self, model: str, depth: int) -> None:
         self._m(model)["depth"].set(depth)
+
+    def replica_queue_depth(self, replica: str, depth: int) -> None:
+        """Per-replica in-flight depth (serve/pool.py routing signal).
+        The gauge object is cached like _m's per-model metrics: this
+        runs per request inside the pool's routing lock, and a registry
+        get-or-create there would serialize clients on a second lock."""
+        g = self._replica_depth.get(replica)
+        if g is None:
+            g = self.registry.gauge(
+                "serve_replica_queue_depth",
+                "requests in flight on one replica",
+                labels={"replica": replica})
+            self._replica_depth[replica] = g
+        g.set(depth)
+
+    def offered(self, model: str) -> None:
+        """Count one request at the front door, before admission. The
+        offered-vs-admitted gap is the shed rate — report() exposes both
+        as RPS so shedding can't silently flatter the latency tail."""
+        m = self._m(model)
+        m["offered"].inc()
+        now = time.monotonic()
+        if m["t_first"] is None:
+            m["t_first"] = now
+        m["t_last"] = now
+
+    def shed(self, model: str, reason: str) -> None:
+        if reason not in SHED_REASONS:
+            raise ValueError(f"shed reason {reason!r} not in {SHED_REASONS}")
+        self._m(model)["shed"][reason].inc()
+
+    def refused(self, model: str) -> None:
+        """An offered request the pool could not even queue (no serving
+        replica). Kept apart from shed: a refusal is a fleet failure,
+        and counting it as admitted would flatter admitted_rps."""
+        self._m(model)["refused"].inc()
 
     def request_done(self, model: str, latency_ms: float,
                      outcome: str = "ok") -> None:
@@ -138,6 +207,23 @@ class SLOTracker:
                                       if slots else 0.0),
                 "slo_violations": int(m["violations"].value),
             }
+            offered = int(m["offered"].value)
+            if offered:
+                shed = sum(int(c.value) for c in m["shed"].values())
+                refused = int(m["refused"].value)
+                row = out[model]
+                row["offered"] = offered
+                row["shed"] = shed
+                if refused:
+                    row["refused"] = refused
+                admitted = offered - shed - refused
+                row["admitted"] = admitted
+                # the shed-can't-flatter-p99 accounting: quote the tail
+                # next to how much traffic was allowed to produce it
+                window_s = ((m["t_last"] or 0.0) - (m["t_first"] or 0.0))
+                if window_s > 0:
+                    row["offered_rps"] = offered / window_s
+                    row["admitted_rps"] = admitted / window_s
         return out
 
     def render(self) -> str:
@@ -156,5 +242,10 @@ class SLOTracker:
                 f"occupancy {r['occupancy_pct']:.1f}% "
                 f"waste {r['padding_waste_pct']:.1f}%"
                 + (f"  slo>{self.slo_ms:g}ms: {r['slo_violations']}"
-                   if self.slo_ms is not None else ""))
+                   if self.slo_ms is not None else "")
+                + (f"  offered {r['offered']} shed {r['shed']}"
+                   + (f" ({r['offered_rps']:.1f} -> "
+                      f"{r['admitted_rps']:.1f} rps)"
+                      if "offered_rps" in r else "")
+                   if "offered" in r else ""))
         return "\n".join(lines)

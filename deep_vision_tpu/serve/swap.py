@@ -1,0 +1,246 @@
+"""Zero-downtime canary weight swap: new weights under live traffic.
+
+The third fleet failure mode after replica death and overload: updating
+the model without stopping the fleet. The mechanism exploits the AOT
+engine's shape — every compiled (model, bucket) executable takes the
+variables as a RUNTIME argument (argnum 0, never donated), so new
+weights with the same avals run on the executables already warmed at
+startup. A swap therefore never touches the compiler; the controller
+proves it with the backend-compile counter at every step.
+
+The state machine (each transition a typed `serve_swap` journal event,
+`phase` in warm/canary/promote/rollback, `outcome` in
+started/ok/failed)::
+
+    warm      load the checkpoint via the cross-mesh restore path
+              (core/checkpoint.restore_tree(mesh=): arrays land placed
+              for the serving mesh, resharded if the checkpoint was
+              written on a different topology), validate avals against
+              the serving weights, bind a SHADOW engine sharing the
+              primary's executables (Engine.clone_with_variables), and
+              probe every swapped model once — compile delta must be 0.
+              Any failure here rolls back before a single user request
+              touches the new weights. The `serve.replica` fault point
+              fires at the load step, so a failed swap-restore is
+              deterministically injectable.
+    canary    mount the shadow as a canary replica taking x% of live
+              traffic (ReplicaPool.add_canary; health_policy=abort so
+              non-finite outputs become countable request errors), wait
+              for `min_canary_requests` verdict samples.
+    promote   canary healthy (error rate within budget, p99 within the
+              SLO target, replica alive): hot-swap the new variables
+              into every base replica's engine, then unmount the canary.
+    rollback  canary unhealthy (errors / SLO violation / canary death)
+              or warm failed: unmount, old weights never stopped
+              serving. Auto — a 3am swap needs no operator.
+
+Synthetic warm probes run on zeros: they prove plumbing, shapes, and
+the zero-compile contract, NOT data-dependent health — weights can be
+finite on a zero probe and explode on real traffic, which is exactly
+why the canary phase exists and judges real requests.
+"""
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional
+
+import numpy as np
+
+from deep_vision_tpu.obs import locksmith
+from deep_vision_tpu.obs.stepclock import recompile_count
+from deep_vision_tpu.resilience import faults
+from deep_vision_tpu.serve.engine import Engine, ServeError
+from deep_vision_tpu.serve.pool import ReplicaPool
+
+SWAP_PHASES = ("warm", "canary", "promote", "rollback")
+SWAP_OUTCOMES = ("started", "ok", "failed")
+
+
+class SwapController:
+    """Drives one canary weight swap at a time over a ReplicaPool.
+
+    Wire-up (what tools/loadgen.py's fleet smoke does)::
+
+        swapper = SwapController(pool, journal=journal, canary_pct=25,
+                                 min_canary_requests=8, slo_ms=500.0)
+        verdict = swapper.swap("checkpoints/resnet50", step=1200)
+        # {'outcome': 'promoted' | 'rolled_back', 'timeline': [...]}
+
+    `swap()` blocks through the state machine; live traffic must keep
+    flowing from client threads meanwhile — the canary verdict is
+    sampled from real requests the pool diverts, not from synthetic
+    probes.
+    """
+
+    def __init__(self, pool: ReplicaPool, journal=None,
+                 canary_pct: int = 25, min_canary_requests: int = 8,
+                 max_canary_error_rate: float = 0.0,
+                 slo_ms: Optional[float] = None,
+                 canary_timeout_s: float = 30.0,
+                 poll_interval_s: float = 0.02,
+                 clock=time.monotonic, sleep=time.sleep):
+        self.pool = pool
+        self.journal = journal
+        self.canary_pct = int(canary_pct)
+        self.min_canary_requests = int(min_canary_requests)
+        self.max_canary_error_rate = float(max_canary_error_rate)
+        self.slo_ms = slo_ms
+        self.canary_timeout_s = float(canary_timeout_s)
+        self.poll_interval_s = float(poll_interval_s)
+        self._clock = clock
+        self._sleep = sleep
+        self._swap_lock = locksmith.lock("serve.swap")
+        self._swap_seq = 0
+
+    # -- journal plumbing ----------------------------------------------------
+
+    def _emit(self, timeline: list, swap_id: int, phase: str, outcome: str,
+              **fields) -> None:
+        row = {"swap": swap_id, "phase": phase, "outcome": outcome, **fields}
+        timeline.append(row)
+        if self.journal is not None:
+            self.journal.write("serve_swap", **row)
+
+    # -- the load + shadow-bind step -----------------------------------------
+
+    def _load(self, source, step, models, mesh) -> Dict[str, object]:
+        """Checkpoint -> {model: variables}, placed for the serving mesh.
+
+        `source` is a core/checkpoint.CheckpointManager (or anything with
+        its restore_tree contract) or a checkpoint directory path. The
+        restore rides the cross-mesh path: the sidecar's sharding
+        metadata re-places every leaf against `mesh`, so a checkpoint
+        written by an 8-device trainer swaps into a 1-device serving
+        replica (or vice versa) without a resave."""
+        faults.fire("serve.replica")  # the injectable swap-restore boundary
+        engine = self.pool.primary_engine()
+        models = tuple(models or engine.models)
+        template = {name: engine.entry(name).variables for name in models}
+        owned = None
+        try:
+            if isinstance(source, str):
+                from deep_vision_tpu.core.checkpoint import CheckpointManager
+
+                owned = CheckpointManager(source, journal=self.journal)
+                mgr = owned
+            else:
+                mgr = source
+            tree, _host = mgr.restore_tree(template, step=step, mesh=mesh)
+        finally:
+            if owned is not None:
+                owned.close()
+        if tree is None:
+            raise ServeError(
+                f"no valid checkpoint to swap in from {source!r} "
+                f"(step={step})")
+        return {name: tree[name] for name in models}
+
+    def _probe(self, shadow: Engine, models) -> int:
+        """One zeros-batch per swapped model through the SHARED
+        executables; returns the backend-compile delta (must be 0)."""
+        c0 = recompile_count()
+        for name in models:
+            entry = shadow.entry(name)
+            bucket = min(entry.buckets)
+            shadow.run(name, np.zeros((bucket,) + entry.input_shape,
+                                      entry.dtype))
+        return recompile_count() - c0
+
+    # -- the state machine ---------------------------------------------------
+
+    def swap(self, source, step: Optional[int] = None, models=None,
+             mesh=None) -> dict:
+        """Run warm -> canary -> promote|rollback; returns the verdict
+        dict {outcome, swap, timeline}. One swap at a time (a second
+        concurrent call raises)."""
+        if not self._swap_lock.acquire(blocking=False):
+            raise ServeError("a swap is already in flight")
+        try:
+            self._swap_seq += 1
+            swap_id = self._swap_seq
+            timeline: list = []
+
+            def emit(phase, outcome, **fields):
+                self._emit(timeline, swap_id, phase, outcome, **fields)
+
+            # -- warm ------------------------------------------------------
+            emit("warm", "started", step=step)
+            try:
+                new_vars = self._load(source, step, models, mesh)
+                shadow = self.pool.primary_engine().clone_with_variables(
+                    new_vars)
+                delta = self._probe(shadow, new_vars)
+                if delta:
+                    raise ServeError(
+                        f"shadow warm compiled {delta} executable(s); a "
+                        "hot swap must reuse the warmed menu — re-warm a "
+                        "new pool for shape/structure changes")
+            except Exception as e:
+                emit("warm", "failed",
+                     error=f"{type(e).__name__}: {e}"[:200])
+                emit("rollback", "ok", reason="warm_failed")
+                return {"outcome": "rolled_back", "swap": swap_id,
+                        "reason": "warm_failed", "timeline": timeline}
+            emit("warm", "ok", compile_delta=0, models=sorted(new_vars))
+
+            # -- canary ----------------------------------------------------
+            rid = self.pool.add_canary(shadow, self.canary_pct)
+            emit("canary", "started", replica=rid, pct=self.canary_pct)
+            verdict = self._watch_canary()
+            if not verdict.pop("healthy"):
+                emit("canary", "failed", replica=rid, **verdict)
+                self.pool.remove_canary()
+                emit("rollback", "ok", reason=verdict.get("reason", "?"))
+                return {"outcome": "rolled_back", "swap": swap_id,
+                        "reason": verdict.get("reason"),
+                        "timeline": timeline}
+            emit("canary", "ok", replica=rid, **verdict)
+
+            # -- promote ---------------------------------------------------
+            # base replicas first, canary unmounted after: at every
+            # instant the whole request stream has a serving target
+            self.pool.promote_variables(new_vars)
+            self.pool.remove_canary()
+            emit("promote", "ok", models=sorted(new_vars))
+            return {"outcome": "promoted", "swap": swap_id,
+                    "timeline": timeline}
+        finally:
+            self._swap_lock.release()
+
+    def _watch_canary(self) -> dict:
+        """Sample the canary until enough verdict traffic (or timeout /
+        canary death). Healthy = alive, error rate within budget, p99
+        within the SLO target."""
+        deadline = self._clock() + self.canary_timeout_s
+        status = self.pool.canary_status()
+        while self._clock() < deadline:
+            status = self.pool.canary_status()
+            if status is None:
+                return {"healthy": False, "reason": "canary_missing"}
+            if status["state"] == "dead":
+                return {"healthy": False, "reason": "replica_lost",
+                        "canary_ok": status["completed"],
+                        "canary_err": status["errors"]}
+            done = (status["completed"] + status["errors"]
+                    + status["cancelled"])
+            if done >= self.min_canary_requests:
+                break
+            self._sleep(self.poll_interval_s)
+        else:
+            return {"healthy": False, "reason": "canary_timeout",
+                    "canary_ok": status["completed"] if status else 0,
+                    "canary_err": status["errors"] if status else 0}
+        judged = status["completed"] + status["errors"]
+        rate = status["errors"] / max(1, judged)
+        out = {"canary_ok": status["completed"],
+               "canary_err": status["errors"],
+               "error_rate": round(rate, 4)}
+        slo = status.get("slo") or {}
+        p99 = max((r.get("p99_ms", 0.0) for r in slo.values()), default=0.0)
+        if p99:
+            out["p99_ms"] = round(p99, 3)
+        if rate > self.max_canary_error_rate:
+            return {"healthy": False, "reason": "errors", **out}
+        if self.slo_ms is not None and p99 > self.slo_ms:
+            return {"healthy": False, "reason": "slo", **out}
+        return {"healthy": True, **out}

@@ -1,0 +1,735 @@
+"""Fleet-layer tier-1 suite: ReplicaPool routing + death/respawn,
+admission control + shed determinism, the canary weight-swap state
+machine (promote AND rollback, zero recompiles via the compile
+counter), the four new journal schemas, the obs_report fleet section,
+and a locksmith-armed pool lifecycle with zero violations.
+
+Runs on the pure-jnp toy model like tests/test_serve.py; the
+sustained-RPS fleet scenario is `make fleet-smoke` (tools/loadgen.py).
+"""
+import json
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deep_vision_tpu.obs import RunJournal, locksmith, read_journal
+from deep_vision_tpu.obs.registry import Registry
+from deep_vision_tpu.obs.stepclock import recompile_count
+from deep_vision_tpu.resilience import FaultInjected, faults
+from deep_vision_tpu.serve import (
+    SHED_REASONS,
+    SWAP_OUTCOMES,
+    SWAP_PHASES,
+    AdmissionController,
+    Engine,
+    ReplicaPool,
+    ServeError,
+    ShedError,
+    SwapController,
+    TokenBucket,
+)
+
+IMG = (4, 4, 1)
+
+
+def toy_fn(variables, images):
+    flat = images.reshape((images.shape[0], -1))
+    return {"scores": flat @ variables["w"],
+            "mean": images.mean(axis=(1, 2, 3))}
+
+
+def toy_variables(scale=1.0, seed=0):
+    w = np.random.RandomState(seed).randn(16, 3).astype(np.float32) * scale
+    return {"w": jnp.asarray(w)}
+
+
+def images(n, seed=1):
+    rng = np.random.RandomState(seed)
+    return [rng.rand(*IMG).astype(np.float32) for _ in range(n)]
+
+
+def build_engine_factory(registry, journal=None, buckets=(1, 2, 4)):
+    def build(rid):
+        eng = Engine(registry=registry, journal=journal)
+        eng.register("toy", toy_fn, toy_variables(), input_shape=IMG,
+                     buckets=buckets)
+        return eng
+
+    return build
+
+
+def make_pool(journal=None, replicas=2, registry=None, **kw):
+    registry = registry or Registry()
+    kw.setdefault("max_wait_ms", 3.0)
+    pool = ReplicaPool(build_engine_factory(registry, journal=journal),
+                       replicas=replicas, journal=journal,
+                       registry=registry, **kw)
+    pool.start()
+    return pool
+
+
+def wait_all_serving(pool, timeout=15.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if all(s == "serving" for s in pool.replica_states().values()):
+            return True
+        time.sleep(0.02)
+    return False
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    yield
+    faults.install(None)
+    os.environ.pop(faults.ENV_SPEC, None)
+    os.environ.pop(faults.ENV_SEED, None)
+
+
+@pytest.fixture
+def journal(tmp_path):
+    j = RunJournal(str(tmp_path / "fleet.jsonl"), kind="serve")
+    yield j
+    if not j._closed:
+        j.close()
+
+
+def strict_errors(path):
+    from tools.check_journal import check_journal
+
+    return check_journal(path, strict=True)
+
+
+# -- admission ---------------------------------------------------------------
+
+class TestAdmission:
+    def test_token_bucket_refill_math(self):
+        t = {"now": 0.0}
+        b = TokenBucket(rate_per_s=2.0, burst=3, clock=lambda: t["now"])
+        assert [b.take() for _ in range(4)] == [True, True, True, False]
+        t["now"] = 0.5  # one token refilled
+        assert b.take() and not b.take()
+        t["now"] = 100.0  # refill caps at burst
+        assert [b.take() for _ in range(4)] == [True, True, True, False]
+
+    def test_zero_rate_bucket_never_refills(self):
+        b = TokenBucket(rate_per_s=0.0, burst=2, clock=lambda: 0.0)
+        assert b.take() and b.take() and not b.take()
+
+    def test_queue_bound_precedes_rate_budget(self):
+        adm = AdmissionController(max_queue_depth=2, rate_per_s=0.0, burst=1)
+        # a full queue must not spend a token on a doomed request
+        assert adm.admit("toy", queue_depth=2) == "queue_full"
+        assert adm.admit("toy", queue_depth=0) is None  # token spent here
+        assert adm.admit("toy", queue_depth=0) == "rate_limited"
+
+    def test_draining_sheds_everything(self):
+        adm = AdmissionController(max_queue_depth=8)
+        assert adm.admit("toy", 0) is None
+        adm.start_draining()
+        assert adm.admit("toy", 0) == "draining"
+
+    def test_reasons_are_the_schema_enum(self):
+        from tools.check_journal import SERVE_SHED_REASONS
+
+        assert set(SHED_REASONS) == SERVE_SHED_REASONS
+
+    def test_rejects_garbage(self):
+        with pytest.raises(ValueError):
+            AdmissionController(max_queue_depth=0)
+        with pytest.raises(ValueError):
+            TokenBucket(rate_per_s=1.0, burst=0)
+        with pytest.raises(ValueError):
+            TokenBucket(rate_per_s=-1.0, burst=1)
+
+
+# -- engine hot-swap ---------------------------------------------------------
+
+class TestEngineSwap:
+    def _warmed(self, registry=None):
+        eng = Engine(registry=registry or Registry())
+        eng.register("toy", toy_fn, toy_variables(), input_shape=IMG,
+                     buckets=(1, 2))
+        eng.warmup()
+        return eng
+
+    def test_set_variables_serves_new_weights_without_compiling(self):
+        eng = self._warmed()
+        new = toy_variables(scale=3.0, seed=5)
+        # the eager reference compiles its own op executables — baseline
+        # AFTER it so the assertion isolates the swap + serving path
+        ref = jax.device_get(toy_fn(new, jnp.asarray(np.stack(images(2)))))
+        c0 = recompile_count()
+        eng.set_variables("toy", new)
+        out = jax.device_get(eng.run("toy", np.stack(images(2))))
+        np.testing.assert_allclose(out["scores"], ref["scores"], rtol=1e-6)
+        assert recompile_count() == c0
+
+    def test_swap_refuses_aval_and_structure_changes(self):
+        eng = self._warmed()
+        with pytest.raises(ServeError, match="shape/dtype"):
+            eng.set_variables("toy", {"w": jnp.zeros((8, 3), jnp.float32)})
+        with pytest.raises(ServeError, match="tree structure"):
+            eng.set_variables("toy", {"w": jnp.zeros((16, 3)),
+                                      "extra": jnp.zeros(())})
+
+    def test_clone_shares_executables(self):
+        eng = self._warmed()
+        new = toy_variables(scale=2.0, seed=9)
+        # eager references first: they compile op executables of their
+        # own and must not pollute the shadow's zero-compile assertion
+        ref = jax.device_get(toy_fn(new, jnp.asarray(np.stack(images(2)))))
+        ref_old = jax.device_get(
+            toy_fn(toy_variables(), jnp.asarray(np.stack(images(2)))))
+        c0 = recompile_count()
+        shadow = eng.clone_with_variables({"toy": new})
+        out = jax.device_get(shadow.run("toy", np.stack(images(2))))
+        np.testing.assert_allclose(out["scores"], ref["scores"], rtol=1e-6)
+        # the original keeps serving the OLD weights
+        old = jax.device_get(eng.run("toy", np.stack(images(2))))
+        np.testing.assert_allclose(old["scores"], ref_old["scores"],
+                                   rtol=1e-6)
+        assert recompile_count() == c0, "the shadow must be warm at birth"
+
+    def test_clone_before_warmup_refused(self):
+        eng = Engine(registry=Registry())
+        eng.register("toy", toy_fn, toy_variables(), input_shape=IMG)
+        with pytest.raises(ServeError, match="before warmup"):
+            eng.clone_with_variables({"toy": toy_variables(seed=2)})
+
+
+# -- pool routing + accounting -----------------------------------------------
+
+class TestPoolRouting:
+    def test_traffic_spreads_across_replicas(self, journal):
+        pool = make_pool(journal=journal, replicas=2)
+        try:
+            futs = [pool.submit("toy", im) for im in images(16)]
+            for f in futs:
+                assert f.result(timeout=30) is not None
+        finally:
+            pool.close()
+        journal.close()
+        replicas = {e.get("replica") for e in read_journal(journal.path)
+                    if e.get("event") == "serve_request"}
+        assert replicas == {"r0", "r1"}, \
+            "least-in-flight routing must use the whole fleet"
+        assert strict_errors(journal.path) == []
+
+    def test_pool_drain_aggregates_the_fleet_ledger(self, journal):
+        pool = make_pool(journal=journal, replicas=2)
+        futs = [pool.submit("toy", im) for im in images(6)]
+        for f in futs:
+            f.result(timeout=30)
+        summary = pool.drain("close")
+        assert summary["outcome"] == "flushed"
+        assert summary["accepted"] == 6 and summary["completed"] == 6
+        assert summary["offered"] == 6 and summary["shed"] == 0
+        assert summary["replicas"] == 2
+        # idempotent, and the pool's aggregated drain is the journal's
+        # LAST serve_drain (obs_report's verdict row)
+        assert pool.drain("close") is summary
+        journal.close()
+        drains = [e for e in read_journal(journal.path)
+                  if e.get("event") == "serve_drain"]
+        assert len(drains) == 3  # r0, r1, pool
+        assert drains[-1].get("scope") == "pool"
+        assert strict_errors(journal.path) == []
+
+    def test_submit_before_start_and_after_drain(self):
+        registry = Registry()
+        pool = ReplicaPool(build_engine_factory(registry), replicas=1,
+                           registry=registry)
+        with pytest.raises(ServeError, match="before start"):
+            pool.submit("toy", images(1)[0])
+        pool.start()
+        pool.close()
+        # shutdown is an overload of size infinity: post-drain traffic
+        # sheds by policy (typed, counted) instead of a bare refusal
+        with pytest.raises(ShedError) as ei:
+            pool.submit("toy", images(1)[0])
+        assert ei.value.reason == "draining"
+
+    def test_shed_determinism_under_seeded_arrivals(self, journal):
+        # zero-refill token budget: the Nth request sheds no matter how
+        # the scheduler interleaves — the seeded arrival pattern from
+        # tools/loadgen.py reproduces the exact same shed set
+        pool = make_pool(journal=journal, replicas=2,
+                         admission=AdmissionController(
+                             max_queue_depth=64, rate_per_s=0.0, burst=4))
+        outcomes = []
+        try:
+            futs = []
+            for im in images(10, seed=3):
+                try:
+                    futs.append(pool.submit("toy", im))
+                    outcomes.append("admitted")
+                except ShedError as e:
+                    assert e.reason == "rate_limited"
+                    outcomes.append("shed")
+            for f in futs:
+                f.result(timeout=30)
+        finally:
+            summary = pool.close()
+        assert outcomes == ["admitted"] * 4 + ["shed"] * 6
+        assert summary["shed"] == 6 and summary["accepted"] == 4
+        assert summary["offered"] == 10
+        journal.close()
+        events = read_journal(journal.path)
+        sheds = [e for e in events if e.get("event") == "serve_shed"]
+        assert len(sheds) == 6
+        assert all(e["reason"] == "rate_limited" for e in sheds)
+        assert strict_errors(journal.path) == []
+
+    def test_queue_full_sheds_when_inflight_exceeds_bound(self, journal):
+        # a huge max-wait parks requests in the queue: in-flight depth
+        # crosses the bound deterministically with no completions racing
+        pool = make_pool(journal=journal, replicas=1, max_wait_ms=60_000,
+                         admission=AdmissionController(max_queue_depth=2))
+        try:
+            futs = [pool.submit("toy", im) for im in images(2)]
+            with pytest.raises(ShedError) as ei:
+                pool.submit("toy", images(1)[0])
+            assert ei.value.reason == "queue_full"
+        finally:
+            pool.close()
+        for f in futs:
+            assert f.done()
+
+    def test_concurrent_submits_respect_the_queue_bound(self):
+        import threading
+
+        # 8 clients through the barrier at once against a depth-2 bound
+        # with requests parked (huge max-wait, no completions racing):
+        # the admission verdict and the in-flight increment are one
+        # atomic step, so EXACTLY 2 admit no matter the interleaving
+        pool = make_pool(replicas=1, max_wait_ms=60_000,
+                         admission=AdmissionController(max_queue_depth=2))
+        results = []
+        res_lock = threading.Lock()
+        barrier = threading.Barrier(8)
+
+        def client(i):
+            barrier.wait()
+            try:
+                fut = pool.submit("toy", images(1, seed=i)[0])
+                with res_lock:
+                    results.append(("ok", fut))
+            except ShedError as e:
+                with res_lock:
+                    results.append(("shed", e.reason))
+
+        threads = [threading.Thread(target=client, args=(i,), daemon=True)
+                   for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=15)
+        try:
+            assert len([r for r in results if r[0] == "ok"]) == 2, results
+            assert all(r[1] == "queue_full" for r in results
+                       if r[0] == "shed")
+        finally:
+            pool.close()
+
+    def test_slo_offered_vs_admitted_report(self):
+        pool = make_pool(replicas=1,
+                         admission=AdmissionController(
+                             max_queue_depth=64, rate_per_s=0.0, burst=2))
+        try:
+            done = []
+            for im in images(5):
+                try:
+                    done.append(pool.submit("toy", im))
+                except ShedError:
+                    pass
+            for f in done:
+                f.result(timeout=30)
+            rep = pool.slo.report()["toy"]
+            assert rep["offered"] == 5
+            assert rep["shed"] == 3
+            assert rep["admitted"] == 2
+            assert rep["offered_rps"] >= rep["admitted_rps"] > 0
+        finally:
+            pool.close()
+
+
+# -- replica death -----------------------------------------------------------
+
+class TestReplicaDeath:
+    def test_death_is_request_scoped_and_respawn_recovers(self, journal):
+        pool = make_pool(journal=journal, replicas=2)
+        c0 = recompile_count()
+        try:
+            faults.install_spec("serve.replica:io_error@1", seed=0,
+                                journal=journal, export_env=False)
+            futs = [pool.submit("toy", im) for im in images(6)]
+            outcomes = []
+            for f in futs:
+                try:
+                    f.result(timeout=30)
+                    outcomes.append("ok")
+                except ServeError:
+                    outcomes.append("lost")
+            faults.install(None)
+            # SOME requests died with the replica, the rest were served
+            # by the survivor — never the whole stream
+            assert 1 <= outcomes.count("lost") < len(futs)
+            assert wait_all_serving(pool), pool.replica_states()
+            # the pool answers after recovery, on the SAME executables
+            assert pool.submit(
+                "toy", images(1)[0]).result(timeout=30) is not None
+            assert recompile_count() == c0, \
+                "respawn must reuse the surviving warmed engine"
+        finally:
+            summary = pool.close()
+        assert summary["accepted"] == summary["completed"] \
+            + summary["errors"] + summary["cancelled"]
+        journal.close()
+        events = read_journal(journal.path)
+        lost = [e for e in events if e.get("event") == "replica_lost"]
+        rec = [e for e in events if e.get("event") == "replica_recovered"]
+        assert len(lost) == 1 and len(rec) == 1
+        assert lost[0]["replica"] == rec[0]["replica"]
+        assert lost[0]["attempt"] == 1 and rec[0]["attempt"] >= 1
+        assert strict_errors(journal.path) == []
+
+    def test_respawn_failure_retries_until_recovered(self, journal):
+        # one replica, no concurrent traffic: the point's hit sequence is
+        # exactly [death batch, respawn attempt 1, respawn attempt 2].
+        # Rule one kills the replica, rule two (its own hit counter)
+        # kills the FIRST respawn attempt — the RetryPolicy must back
+        # off and recover on the second
+        pool = make_pool(journal=journal, replicas=1)
+        try:
+            # two independent one-shot rules on the same point: hit 1 is
+            # the death batch, hit 2 is the first respawn attempt
+            faults.install_spec(
+                "serve.replica:io_error@1;serve.replica:io_error@2",
+                seed=0, journal=journal, export_env=False)
+            fut = pool.submit("toy", images(1)[0])
+            with pytest.raises(ServeError):
+                fut.result(timeout=30)
+            assert wait_all_serving(pool), pool.replica_states()
+        finally:
+            faults.install(None)
+            pool.close()
+        journal.close()
+        rec = [e for e in read_journal(journal.path)
+               if e.get("event") == "replica_recovered"]
+        assert rec and rec[-1]["attempt"] >= 1
+
+    def test_all_replicas_down_is_a_clear_error(self, journal):
+        from deep_vision_tpu.resilience import RetryPolicy
+
+        pool = make_pool(
+            journal=journal, replicas=1,
+            respawn_policy=RetryPolicy(
+                name="serve.replica", max_attempts=1, base_delay_s=0.01,
+                journal=journal, retry_on=(OSError, TimeoutError)))
+        try:
+            # every hit fires: the death AND the single respawn attempt
+            faults.install_spec("serve.replica:io_error@0.999999", seed=1,
+                                journal=journal, export_env=False)
+            fut = pool.submit("toy", images(1)[0])
+            with pytest.raises(ServeError):
+                fut.result(timeout=30)
+            deadline = time.time() + 10
+            while time.time() < deadline and \
+                    pool.replica_states()["r0"] != "dead":
+                time.sleep(0.02)
+            faults.install(None)
+            assert pool.replica_states()["r0"] == "dead"
+            with pytest.raises(ServeError, match="no serving replica"):
+                pool.submit("toy", images(1)[0])
+        finally:
+            faults.install(None)
+            summary = pool.close()
+        # the dead replica's ledger folds into the pool totals exactly
+        # ONCE (give-up already retired it; drain must not re-add), and
+        # the unroutable request is refused, not silently admitted
+        assert summary["accepted"] == 1 and summary["errors"] == 1
+        assert summary["refused"] == 1
+        assert summary["offered"] == summary["accepted"] \
+            + summary["shed"] + summary["refused"]
+
+
+# -- swap state machine ------------------------------------------------------
+
+@pytest.fixture
+def ckpt(tmp_path, journal):
+    from deep_vision_tpu.core.checkpoint import CheckpointManager
+
+    mgr = CheckpointManager(str(tmp_path / "ckpt"), journal=journal)
+    yield mgr
+    mgr.close()
+
+
+def drive_traffic_until(pool, predicate, timeout=30.0, seed=11):
+    """Feed requests until predicate() (the swap needs live traffic for
+    its canary verdict); returns the submitted futures."""
+    rng = np.random.RandomState(seed)
+    futs = []
+    deadline = time.time() + timeout
+    while time.time() < deadline and not predicate():
+        try:
+            futs.append(pool.submit(
+                "toy", rng.rand(*IMG).astype(np.float32)))
+        except Exception:
+            pass
+        time.sleep(0.004)
+    return futs
+
+
+class TestSwap:
+    def _swap_setup(self, journal, ckpt, scale=2.0, poison=False):
+        pool = make_pool(journal=journal, replicas=2)
+        if poison:
+            new = {"toy": {"w": jnp.full((16, 3), 1e38, jnp.float32)}}
+        else:
+            new = {"toy": toy_variables(scale=scale, seed=7)}
+        ckpt.save_tree(1, new)
+        ckpt.wait()
+        swapper = SwapController(pool, journal=journal, canary_pct=50,
+                                 min_canary_requests=4,
+                                 canary_timeout_s=30.0)
+        return pool, swapper, new
+
+    def _swap_in_thread(self, swapper, ckpt):
+        import threading
+
+        box = {}
+
+        def run():
+            box["verdict"] = swapper.swap(ckpt, step=1, models=("toy",))
+
+        t = threading.Thread(target=run, daemon=True)
+        t.start()
+        return t, box
+
+    def test_promote_swaps_every_replica_zero_recompiles(self, journal,
+                                                         ckpt):
+        pool, swapper, new = self._swap_setup(journal, ckpt)
+        try:
+            c0 = recompile_count()
+            t, box = self._swap_in_thread(swapper, ckpt)
+            drive_traffic_until(pool, lambda: not t.is_alive())
+            t.join(timeout=60)
+            verdict = box["verdict"]
+            assert verdict["outcome"] == "promoted", verdict
+            assert recompile_count() == c0, \
+                "the whole swap — restore, shadow warm, canary, promote " \
+                "— must never touch the compiler"
+            # every replica serves the new weights now
+            im = images(1, seed=42)[0]
+            ref = jax.device_get(toy_fn(new["toy"], jnp.asarray(im[None])))
+            for _ in range(4):  # hits both replicas (least-in-flight)
+                row = pool.submit("toy", im).result(timeout=30)
+                np.testing.assert_allclose(row["scores"], ref["scores"][0],
+                                           rtol=1e-5)
+        finally:
+            pool.close()
+        journal.close()
+        phases = [(e["phase"], e["outcome"])
+                  for e in read_journal(journal.path)
+                  if e.get("event") == "serve_swap"]
+        assert phases == [("warm", "started"), ("warm", "ok"),
+                          ("canary", "started"), ("canary", "ok"),
+                          ("promote", "ok")]
+        assert strict_errors(journal.path) == []
+
+    def test_poisoned_canary_rolls_back(self, journal, ckpt):
+        pool, swapper, _ = self._swap_setup(journal, ckpt, poison=True)
+        try:
+            t, box = self._swap_in_thread(swapper, ckpt)
+            drive_traffic_until(pool, lambda: not t.is_alive())
+            t.join(timeout=60)
+            verdict = box["verdict"]
+            assert verdict["outcome"] == "rolled_back", verdict
+            assert verdict["reason"] == "errors"
+            # the base replicas never stopped serving the OLD weights
+            im = images(1, seed=43)[0]
+            ref = jax.device_get(
+                toy_fn(toy_variables(), jnp.asarray(im[None])))
+            row = pool.submit("toy", im).result(timeout=30)
+            np.testing.assert_allclose(row["scores"], ref["scores"][0],
+                                       rtol=1e-5)
+        finally:
+            pool.close()
+        journal.close()
+        phases = [(e["phase"], e["outcome"])
+                  for e in read_journal(journal.path)
+                  if e.get("event") == "serve_swap"]
+        assert ("canary", "failed") in phases
+        assert ("rollback", "ok") in phases
+        assert ("promote", "ok") not in phases
+        assert strict_errors(journal.path) == []
+
+    def test_failed_restore_rolls_back_at_warm(self, journal, ckpt):
+        pool, swapper, _ = self._swap_setup(journal, ckpt)
+        try:
+            faults.install_spec("serve.replica:io_error@1", seed=0,
+                                journal=journal, export_env=False)
+            verdict = swapper.swap(ckpt, step=1, models=("toy",))
+            faults.install(None)
+            assert verdict["outcome"] == "rolled_back"
+            assert verdict["reason"] == "warm_failed"
+            # no canary was ever mounted; the pool is untouched
+            assert pool.canary_status() is None
+            assert pool.submit(
+                "toy", images(1)[0]).result(timeout=30) is not None
+        finally:
+            pool.close()
+        journal.close()
+        phases = [(e["phase"], e["outcome"])
+                  for e in read_journal(journal.path)
+                  if e.get("event") == "serve_swap"]
+        assert ("warm", "failed") in phases and ("rollback", "ok") in phases
+        assert strict_errors(journal.path) == []
+
+    def test_no_checkpoint_is_a_warm_failure(self, journal, ckpt):
+        pool = make_pool(journal=journal, replicas=1)
+        swapper = SwapController(pool, journal=journal)
+        try:
+            verdict = swapper.swap(ckpt, models=("toy",))
+            assert verdict["outcome"] == "rolled_back"
+            assert verdict["reason"] == "warm_failed"
+        finally:
+            pool.close()
+
+    def test_enums_match_the_schema(self):
+        from tools.check_journal import (
+            SERVE_SWAP_OUTCOMES,
+            SERVE_SWAP_PHASES,
+        )
+
+        assert set(SWAP_PHASES) == SERVE_SWAP_PHASES
+        assert set(SWAP_OUTCOMES) == SERVE_SWAP_OUTCOMES
+
+
+# -- locksmith-armed pool ----------------------------------------------------
+
+class TestLocksmithArmed:
+    def test_full_lifecycle_zero_violations(self, journal):
+        # the runtime lock sanitizer across submit/route/death/respawn/
+        # drain: the pool lock must never invert against the server's
+        # submit/count locks or the queue condition
+        locksmith.arm(journal=journal)
+        try:
+            pool = make_pool(journal=journal, replicas=2)
+            faults.install_spec("serve.replica:io_error@2", seed=0,
+                                journal=journal, export_env=False)
+            futs = [pool.submit("toy", im) for im in images(12)]
+            for f in futs:
+                try:
+                    f.result(timeout=30)
+                except ServeError:
+                    pass
+            faults.install(None)
+            wait_all_serving(pool)
+            pool.close()
+            report = locksmith.report()
+            assert report["violations"] == [], report["violations"]
+        finally:
+            faults.install(None)
+            locksmith.disarm()
+        journal.close()
+        assert not any(e.get("event") == "lock_order_violation"
+                       for e in read_journal(journal.path))
+
+
+# -- journal schema + report -------------------------------------------------
+
+class TestFleetJournalSchema:
+    def test_strict_accepts_fleet_events(self, tmp_path):
+        j = RunJournal(str(tmp_path / "j.jsonl"), kind="serve")
+        j.manifest()
+        j.write("serve_shed", model="toy", reason="queue_full")
+        j.write("serve_swap", swap=1, phase="warm", outcome="ok",
+                compile_delta=0)
+        j.write("serve_swap", swap=1, phase="canary", outcome="failed",
+                canary_ok=3, canary_err=2)
+        j.write("replica_lost", replica="r0", attempt=1,
+                error="FaultInjected: boom")
+        j.write("replica_recovered", replica="r0", attempt=2)
+        j.close()
+        assert strict_errors(j.path) == []
+
+    def test_strict_rejects_bad_fleet_enums(self, tmp_path):
+        path = str(tmp_path / "bad.jsonl")
+        rows = [
+            {"event": "serve_shed", "ts": 1.0, "run_id": "r",
+             "model": "toy", "reason": "mood"},
+            {"event": "serve_swap", "ts": 1.0, "run_id": "r",
+             "phase": "yolo", "outcome": "ok"},
+            {"event": "serve_swap", "ts": 1.0, "run_id": "r",
+             "phase": "warm", "outcome": "perhaps"},
+            {"event": "replica_lost", "ts": 1.0, "run_id": "r",
+             "replica": 3, "attempt": "one"},
+            {"event": "replica_recovered", "ts": 1.0, "run_id": "r",
+             "replica": "r0"},
+            {"event": "exit", "ts": 2.0, "run_id": "r", "status": "clean"},
+        ]
+        with open(path, "w") as f:
+            for r in rows:
+                f.write(json.dumps(r) + "\n")
+        errs = strict_errors(path)
+        assert any("serve_shed reason" in e for e in errs)
+        assert any("serve_swap phase" in e for e in errs)
+        assert any("serve_swap outcome" in e for e in errs)
+        assert any("replica_lost replica" in e for e in errs)
+        assert any("replica_lost attempt" in e for e in errs)
+        assert any("replica_recovered event missing field 'attempt'" in e
+                   for e in errs)
+
+    def test_obs_report_renders_fleet_section(self, tmp_path, capsys):
+        from tools.obs_report import main as report_main
+
+        j = RunJournal(str(tmp_path / "j.jsonl"), kind="serve")
+        j.manifest()
+        for rid, ms in (("r0", 2.0), ("r0", 3.0), ("r1", 4.0)):
+            j.write("serve_request", model="toy", latency_ms=ms,
+                    outcome="ok", replica=rid)
+        j.write("serve_request", model="toy", latency_ms=1.0,
+                outcome="error", replica="r1", error="ReplicaLost: died")
+        j.write("replica_lost", replica="r1", attempt=1, error="x")
+        j.write("replica_recovered", replica="r1", attempt=1)
+        for _ in range(3):
+            j.write("serve_shed", model="toy", reason="rate_limited")
+        j.write("serve_shed", model="toy", reason="queue_full")
+        j.write("serve_swap", swap=1, phase="warm", outcome="ok")
+        j.write("serve_swap", swap=1, phase="canary", outcome="failed",
+                canary_ok=1, canary_err=2, reason="errors")
+        j.write("serve_swap", swap=1, phase="rollback", outcome="ok",
+                reason="errors")
+        j.write("serve_drain", reason="close", outcome="flushed",
+                scope="pool", accepted=4, completed=3, errors=1,
+                cancelled=0, pending=0, shed=4, offered=8, replicas=2)
+        j.close()
+        assert report_main([j.path]) == 0
+        out = capsys.readouterr().out
+        assert "replica r0" in out and "2 ok, 0 err" in out
+        assert "lost x1 recovered x1" in out
+        assert "pool latency" in out and "p99" in out
+        assert "shed toy" in out and "queue_fullx1" in out \
+            and "rate_limitedx3" in out
+        assert "swap #1" in out and "canary failed" in out \
+            and "rollback ok" in out
+        assert "shed=4" in out and "offered=8" in out
+
+    def test_obs_report_single_server_unchanged(self, tmp_path, capsys):
+        from tools.obs_report import main as report_main
+
+        j = RunJournal(str(tmp_path / "j.jsonl"), kind="serve")
+        j.manifest()
+        j.write("serve_request", model="toy", latency_ms=2.0, outcome="ok")
+        j.write("serve_drain", reason="close", outcome="flushed",
+                accepted=1, completed=1, errors=0, pending=0)
+        j.close()
+        assert report_main([j.path]) == 0
+        out = capsys.readouterr().out
+        assert "serving toy" in out
+        assert "replica" not in out and "swap #" not in out

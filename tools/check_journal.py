@@ -62,6 +62,10 @@ EVENT_FIELDS = {
     "serve_request": ("model", "latency_ms", "outcome"),
     "serve_batch": ("model", "bucket", "size"),
     "serve_drain": ("reason", "outcome", "accepted", "completed"),
+    "serve_shed": ("model", "reason"),
+    "serve_swap": ("phase", "outcome"),
+    "replica_lost": ("replica", "attempt"),
+    "replica_recovered": ("replica", "attempt"),
     "lock_order_violation": ("lock_a", "lock_b", "thread"),
     "lock_contention": ("lock", "kind", "ms"),
     "note": (),
@@ -82,6 +86,11 @@ FLIGHT_OUTCOMES = {"written", "failed"}
 SERVE_REQUEST_OUTCOMES = {"ok", "error", "rejected", "cancelled"}
 SERVE_DRAIN_REASONS = {"close", "sigterm"}
 SERVE_DRAIN_OUTCOMES = {"flushed", "timeout"}
+# serve/slo.py SHED_REASONS and serve/swap.py SWAP_PHASES/SWAP_OUTCOMES
+# (kept in sync by tests/test_serve_pool.py)
+SERVE_SHED_REASONS = {"queue_full", "rate_limited", "draining"}
+SERVE_SWAP_PHASES = {"warm", "canary", "promote", "rollback"}
+SERVE_SWAP_OUTCOMES = {"started", "ok", "failed"}
 LOCK_CONTENTION_KINDS = {"hold", "wait"}
 # resilience/elastic.py BACKEND_LOST_KINDS (kept in sync by
 # tests/test_elastic.py): the classifier's verdict on a lost backend
@@ -178,6 +187,23 @@ def check_journal(path: str, require_exit: bool = False,
             if row.get("outcome") not in SERVE_DRAIN_OUTCOMES:
                 errors.append(f"{path}:{i}: unknown serve_drain outcome "
                               f"{row.get('outcome')!r}")
+        if ev == "serve_shed" and row.get("reason") not in SERVE_SHED_REASONS:
+            errors.append(f"{path}:{i}: unknown serve_shed reason "
+                          f"{row.get('reason')!r}")
+        if ev == "serve_swap":
+            if row.get("phase") not in SERVE_SWAP_PHASES:
+                errors.append(f"{path}:{i}: unknown serve_swap phase "
+                              f"{row.get('phase')!r}")
+            if row.get("outcome") not in SERVE_SWAP_OUTCOMES:
+                errors.append(f"{path}:{i}: unknown serve_swap outcome "
+                              f"{row.get('outcome')!r}")
+        if ev in ("replica_lost", "replica_recovered"):
+            if not isinstance(row.get("replica"), str) or not row.get("replica"):
+                errors.append(f"{path}:{i}: {ev} replica must be a replica "
+                              f"id, got {row.get('replica')!r}")
+            if not isinstance(row.get("attempt"), int):
+                errors.append(f"{path}:{i}: {ev} attempt must be an int, "
+                              f"got {row.get('attempt')!r}")
         if ev == "lock_contention":
             if row.get("kind") not in LOCK_CONTENTION_KINDS:
                 errors.append(f"{path}:{i}: unknown lock_contention kind "

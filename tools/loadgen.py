@@ -1,0 +1,459 @@
+"""Load generator + the fleet smoke: serving resilience at fleet shape.
+
+    PYTHONPATH=. JAX_PLATFORMS=cpu python tools/loadgen.py \
+        [--workdir artifacts/fleet_smoke] [--replicas 3] [--rps 150]
+
+The CI teeth behind the fleet layer of serve/ (`make fleet-smoke`, a
+`make verify` prerequisite after serve-smoke): one in-process
+ReplicaPool over N toy-model replicas on CPU, driven by a seeded
+load generator through every fleet failure mode. `LoadGen` is also a
+library — tests and future TPU runs reuse the same arrival pattern.
+
+  1. warmup      N replicas warm their engines; every (model, bucket)
+                 pair is AOT-compiled (the backend compile cache may
+                 dedupe identical computations across replicas — the
+                 assertion is the pair count plus a nonzero delta, and
+                 ZERO compiles anywhere after this phase, asserted at
+                 the end across everything below).
+  2. death       sustained seeded RPS with `serve.replica:io_error@N`
+                 injected: one replica dies mid-stream; ONLY its
+                 in-flight requests fail (request-scoped), the journal
+                 carries typed replica_lost/replica_recovered, the pool
+                 respawns the replica over the surviving warmed engine,
+                 and the p99 of admitted traffic holds the SLO through
+                 the episode.
+  3. promote     a canary weight swap under live traffic: new weights
+                 load via the cross-mesh checkpoint restore, shadow-warm
+                 on the SHARED executables, canary x% of real requests,
+                 auto-promote; responses prove the new weights serve.
+  4. rollback    a poisoned checkpoint (finite on the zeros probe,
+                 overflow on real traffic — exactly the failure a
+                 synthetic probe cannot catch): the canary's abort
+                 health policy turns it into request errors, the
+                 verdict fails, auto-rollback; the promoted weights
+                 never stop serving and the base stream never sees it.
+  5. shed        admission tightened (token budget + bounded queue),
+                 then an overload blast: excess traffic sheds by policy
+                 with typed serve_shed events (client ShedError count ==
+                 journal count == counter), offered == ok+err+shed, and
+                 the p99 of ADMITTED traffic still holds — overload
+                 degrades by policy, not by latency collapse.
+  6. drain       clean close: every admitted request flushed, the pool's
+                 aggregated serve_drain balances, journals pass
+                 check_journal --strict, obs_report renders the fleet
+                 section, locksmith (armed since startup) reports zero
+                 violations, and the flight dir is EMPTY.
+
+Exit status 0 = every contract held; 1 = something broke.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import subprocess
+import sys
+import threading
+import time
+from typing import Callable, List, Optional
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+IMG = (4, 4, 1)
+BUCKETS = (1, 2, 4)
+SLO_MS = 2000.0  # the held-through-chaos promise; generous for CI boxes
+
+
+class Failures:
+    def __init__(self):
+        self.errors: List[str] = []
+
+    def check(self, ok: bool, what: str) -> bool:
+        print(("  ok  " if ok else "  FAIL") + f"  {what}")
+        if not ok:
+            self.errors.append(what)
+        return ok
+
+
+# -- the load generator (library surface) -------------------------------------
+
+class LoadGen:
+    """Seeded open-loop load: `n_requests` at a fixed `rps` cadence.
+
+    `submit(model, image) -> Future` is the pool front door; a
+    `ShedError` counts as shed, a `ServerClosed`/`ServeError` at submit
+    as refused. The arrival pattern (request index -> model choice +
+    image bytes) is fully determined by `seed`, so a canary diversion
+    or a shed episode samples the exact same requests run over run.
+    `rps=None` blasts with no pacing (the overload shape).
+    """
+
+    def __init__(self, submit: Callable, models: List[str],
+                 rps: Optional[float], n_requests: int, seed: int = 0,
+                 timeout_s: float = 120.0):
+        self.submit = submit
+        self.models = list(models)
+        self.rps = rps
+        self.n_requests = int(n_requests)
+        self.seed = int(seed)
+        self.timeout_s = float(timeout_s)
+
+    def run(self) -> dict:
+        import numpy as np
+
+        from deep_vision_tpu.serve import ShedError
+
+        rng = np.random.RandomState(self.seed)
+        inter = (1.0 / self.rps) if self.rps else 0.0
+        futs = []
+        shed = refused = 0
+        t0 = time.perf_counter()
+        for i in range(self.n_requests):
+            if inter:
+                target = t0 + i * inter
+                delay = target - time.perf_counter()
+                if delay > 0:
+                    time.sleep(delay)
+            model = self.models[int(rng.randint(len(self.models)))]
+            image = rng.rand(*IMG).astype(np.float32)
+            t_sub = time.perf_counter()
+            try:
+                futs.append((t_sub, self.submit(model, image)))
+            except ShedError:
+                shed += 1
+            except Exception:
+                refused += 1
+        ok_lat: List[float] = []
+        errors = 0
+        deadline = time.perf_counter() + self.timeout_s
+        for t_sub, fut in futs:
+            try:
+                fut.result(timeout=max(0.1, deadline - time.perf_counter()))
+                ok_lat.append((time.perf_counter() - t_sub) * 1e3)
+            except Exception:
+                errors += 1
+        wall_s = time.perf_counter() - t0
+        ok_lat.sort()
+
+        def pct(q: float) -> float:
+            if not ok_lat:
+                return 0.0
+            return ok_lat[min(len(ok_lat) - 1,
+                              int(round(q * (len(ok_lat) - 1))))]
+
+        return {
+            "offered": self.n_requests, "ok": len(ok_lat),
+            "errors": errors, "shed": shed, "refused": refused,
+            "wall_s": round(wall_s, 3),
+            "offered_rps": round(self.n_requests / wall_s, 1) if wall_s else 0,
+            "p50_ms": round(pct(0.50), 3),
+            "p95_ms": round(pct(0.95), 3),
+            "p99_ms": round(pct(0.99), 3),
+        }
+
+
+# -- the fleet-smoke scenario -------------------------------------------------
+
+def toy_fn(variables, images):
+    flat = images.reshape((images.shape[0], -1))
+    return {"scores": flat @ variables["w"],
+            "mean": images.mean(axis=(1, 2, 3))}
+
+
+def aux_fn(variables, images):
+    flat = images.reshape((images.shape[0], -1))
+    return {"logits": flat @ variables["w"] + variables["b"]}
+
+
+def toy_variables(scale: float = 1.0, seed: int = 0):
+    import jax.numpy as jnp
+    import numpy as np
+
+    rng = np.random.RandomState(seed)
+    return {"w": jnp.asarray(rng.randn(16, 3).astype(np.float32) * scale)}
+
+
+def aux_variables(seed: int = 1):
+    import jax.numpy as jnp
+    import numpy as np
+
+    rng = np.random.RandomState(seed)
+    return {"w": jnp.asarray(rng.randn(16, 5).astype(np.float32)),
+            "b": jnp.asarray(rng.randn(5).astype(np.float32))}
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--workdir", default="artifacts/fleet_smoke")
+    p.add_argument("--replicas", type=int, default=3)
+    p.add_argument("--rps", type=float, default=150.0)
+    p.add_argument("--requests", type=int, default=150,
+                   help="requests in the sustained-load episode")
+    args = p.parse_args(argv)
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from deep_vision_tpu.core.checkpoint import CheckpointManager
+    from deep_vision_tpu.obs import (
+        FlightRecorder,
+        RunJournal,
+        Tracer,
+        locksmith,
+        set_flight,
+        set_tracer,
+    )
+    from deep_vision_tpu.obs.registry import Registry
+    from deep_vision_tpu.obs.stepclock import recompile_count
+    from deep_vision_tpu.resilience import faults
+    from deep_vision_tpu.serve import (
+        AdmissionController,
+        Engine,
+        ReplicaPool,
+        ShedError,
+        SwapController,
+    )
+
+    work = os.path.abspath(args.workdir)
+    shutil.rmtree(work, ignore_errors=True)
+    os.makedirs(work)
+    f = Failures()
+    j_path = os.path.join(work, "journal.jsonl")
+    t_path = os.path.join(work, "trace.json")
+    flight_dir = os.path.join(work, "flight")
+
+    journal = RunJournal(j_path, kind="serve")
+    journal.manifest(config={"name": "fleet_smoke", "task": "serving"})
+    tracer = Tracer(t_path, run_id=journal.run_id)
+    set_tracer(tracer)
+    flight = FlightRecorder(flight_dir, run_id=journal.run_id)
+    flight.attach(journal)
+    set_flight(flight)
+    # the lock sanitizer rides the WHOLE fleet lifecycle: warmup, load,
+    # replica death + respawn, both swaps, the shed episode, and drain —
+    # phase 6 asserts zero lock-order violations across all of it
+    locksmith.arm(journal=journal)
+    registry = Registry()
+
+    def build_engine(rid: str) -> Engine:
+        eng = Engine(journal=journal, registry=registry)
+        eng.register("toy", toy_fn, toy_variables(), input_shape=IMG,
+                     buckets=BUCKETS)
+        eng.register("aux", aux_fn, aux_variables(), input_shape=IMG,
+                     buckets=BUCKETS)
+        return eng
+
+    # -- phase 1: fleet warmup ------------------------------------------
+    print(f"phase 1: {args.replicas} replicas warm their engines (AOT)")
+    pool = ReplicaPool(build_engine, replicas=args.replicas,
+                       journal=journal, registry=registry,
+                       max_wait_ms=4.0, slo_ms=SLO_MS)
+    pool.start()
+    pairs = args.replicas * 2 * len(BUCKETS)
+    f.check(pool.warmup_stats["pairs"] == pairs,
+            f"warmed {pool.warmup_stats['pairs']}/{pairs} "
+            "(replica, model, bucket) pairs")
+    f.check(pool.warmup_stats["backend_compiles"] >= 2 * len(BUCKETS),
+            f"warmup compiled every unique computation "
+            f"({pool.warmup_stats['backend_compiles']} backend compiles; "
+            "the cache may dedupe across replicas)")
+    # prep for phases 3/4 BEFORE the compile baseline: eager host-side
+    # reference math and orbax saves compile their own tiny executables,
+    # and the zero-compile contract below is about the SERVING path —
+    # death, respawn, canary, promote, rollback, shed, drain
+    ckpt_dir = os.path.join(work, "ckpt")
+    mgr = CheckpointManager(ckpt_dir, journal=journal)
+    new_toy = {"toy": toy_variables(scale=2.0, seed=7)}
+    mgr.save_tree(1, new_toy)
+    # finite on the zeros probe, overflow on real [0,1) traffic: the
+    # poison a synthetic warm probe CANNOT catch — the canary must
+    poisoned = {"toy": {"w": jnp.full((16, 3), 1e38, jnp.float32)}}
+    mgr.save_tree(2, poisoned)
+    mgr.wait()
+    probe = np.random.RandomState(9).rand(*IMG).astype(np.float32)
+    ref = jax.device_get(toy_fn(new_toy["toy"], jnp.asarray(probe[None])))
+    c0 = recompile_count()  # NOTHING below may move this
+
+    # -- phase 2: sustained load through a replica death ----------------
+    print("phase 2: replica death under sustained load is request-scoped")
+    faults.install_spec("serve.replica:io_error@7", seed=13,
+                        journal=journal, export_env=False)
+    gen = LoadGen(pool.submit, ["toy", "aux"], rps=args.rps,
+                  n_requests=args.requests, seed=42)
+    stats = gen.run()
+    faults.install(None)
+    print(f"  load: {stats}")
+    f.check(stats["ok"] + stats["errors"] + stats["shed"]
+            + stats["refused"] == stats["offered"],
+            f"every offered request accounted "
+            f"(ok={stats['ok']} err={stats['errors']} shed={stats['shed']})")
+    f.check(1 <= stats["errors"] <= 3 * max(BUCKETS),
+            f"only the dead replica's in-flight window failed "
+            f"({stats['errors']} errors; bound = a few batches on one "
+            "replica, never the stream)")
+    f.check(stats["p99_ms"] <= SLO_MS,
+            f"p99 of admitted traffic held the SLO through the death "
+            f"({stats['p99_ms']:.1f}ms <= {SLO_MS:g}ms)")
+    deadline = time.time() + 15
+    while time.time() < deadline and not all(
+            s == "serving" for s in pool.replica_states().values()):
+        time.sleep(0.05)
+    f.check(all(s == "serving" for s in pool.replica_states().values()),
+            f"pool back to full strength ({pool.replica_states()})")
+    f.check(pool.submit("toy", np.random.RandomState(5).rand(*IMG)
+                        .astype(np.float32)).result(timeout=60) is not None,
+            "pool answers after the respawn")
+
+    # -- phase 3: canary swap, auto-promote -----------------------------
+    print("phase 3: canary weight swap promotes under live traffic")
+    stop = threading.Event()
+
+    def traffic(seed: int):
+        rng = np.random.RandomState(seed)
+        while not stop.is_set():
+            try:
+                pool.submit("toy", rng.rand(*IMG).astype(np.float32))
+            except Exception:
+                pass
+            time.sleep(0.004)
+
+    t = threading.Thread(target=traffic, args=(3,), daemon=True)
+    t.start()
+    swapper = SwapController(pool, journal=journal, canary_pct=50,
+                             min_canary_requests=6, slo_ms=SLO_MS,
+                             canary_timeout_s=60.0)
+    verdict = swapper.swap(mgr, step=1, models=("toy",))
+    f.check(verdict["outcome"] == "promoted",
+            "good weights promoted ("
+            + " -> ".join(f"{t_['phase']}:{t_['outcome']}"
+                          for t_ in verdict["timeline"]) + ")")
+    row = pool.submit("toy", probe).result(timeout=60)
+    f.check(bool(np.allclose(row["scores"], ref["scores"][0], rtol=1e-5)),
+            "responses serve the PROMOTED weights")
+
+    # -- phase 4: poisoned canary, auto-rollback ------------------------
+    print("phase 4: poisoned weights roll back; the base stream never "
+          "sees them")
+    verdict = swapper.swap(mgr, step=2, models=("toy",))
+    f.check(verdict["outcome"] == "rolled_back",
+            f"poisoned weights rolled back ({verdict.get('reason')}: "
+            + " -> ".join(f"{t_['phase']}:{t_['outcome']}"
+                          for t_ in verdict["timeline"]) + ")")
+    stop.set()
+    t.join(timeout=10)
+    row = pool.submit("toy", probe).result(timeout=60)
+    f.check(bool(np.allclose(row["scores"], ref["scores"][0], rtol=1e-5)),
+            "base replicas still serve the phase-3 weights after rollback")
+
+    # -- phase 5: overload sheds by policy ------------------------------
+    print("phase 5: overload blast sheds by policy, p99 of admitted held")
+    pool.admission = AdmissionController(max_queue_depth=16,
+                                         rate_per_s=0.0, burst=30)
+    blast = LoadGen(pool.submit, ["toy"], rps=None, n_requests=120,
+                    seed=77)
+    stats = blast.run()
+    print(f"  blast: {stats}")
+    f.check(stats["shed"] >= 90 and stats["ok"] + stats["errors"] <= 30,
+            f"token budget admitted <= 30 of 120, shed the rest "
+            f"(shed={stats['shed']})")
+    f.check(stats["ok"] + stats["errors"] + stats["shed"]
+            + stats["refused"] == stats["offered"],
+            "overload accounting balances (offered == ok+err+shed)")
+    f.check(stats["p99_ms"] <= SLO_MS,
+            f"p99 of ADMITTED traffic held through the overload "
+            f"({stats['p99_ms']:.1f}ms)")
+    slo_rep = pool.slo.report().get("toy", {})
+    f.check(slo_rep.get("offered", 0) > slo_rep.get("admitted", 0),
+            f"SLO report shows offered {slo_rep.get('offered')} > admitted "
+            f"{slo_rep.get('admitted')} — shed traffic cannot flatter p99")
+
+    # -- phase 6: clean drain, artifacts validate -----------------------
+    print("phase 6: clean drain; strict journals; zero violations; "
+          "no stray bundles; zero compiles since warmup")
+    summary = pool.drain("close")
+    f.check(summary["outcome"] == "flushed" and summary["pending"] == 0,
+            f"pool drained everything ({summary})")
+    f.check(summary["accepted"] == summary["completed"] + summary["errors"]
+            + summary["cancelled"],
+            "fleet ledger balances across death, swaps, and shed "
+            f"(accepted={summary['accepted']})")
+    f.check(summary["offered"] == summary["accepted"] + summary["shed"]
+            + summary["refused"],
+            f"offered == accepted + shed + refused "
+            f"({summary['offered']} == {summary['accepted']} + "
+            f"{summary['shed']} + {summary['refused']})")
+    f.check(recompile_count() == c0,
+            "ZERO additional compilations since warmup — through the "
+            "death, the respawn, and BOTH swaps")
+    lock_report = locksmith.report()
+    f.check(not lock_report["violations"],
+            "locksmith: zero lock-order violations across the fleet "
+            "lifecycle"
+            + ("" if not lock_report["violations"]
+               else f" ({lock_report['violations'][0]})"))
+    locksmith.disarm()
+    mgr.close()
+    tracer.close()
+    set_tracer(None)
+    flight.close()
+    set_flight(None)
+    journal.close()
+    f.check(not os.listdir(flight_dir) if os.path.isdir(flight_dir)
+            else True, "clean run left no flight bundle")
+
+    ev = []
+    with open(j_path) as fh:
+        for line in fh:
+            line = line.strip()
+            if line:
+                try:
+                    ev.append(json.loads(line))
+                except json.JSONDecodeError:
+                    pass
+    losts = [e for e in ev if e.get("event") == "replica_lost"]
+    recs = [e for e in ev if e.get("event") == "replica_recovered"]
+    f.check(len(losts) == 1 and len(recs) == 1
+            and losts[0].get("replica") == recs[0].get("replica"),
+            f"exactly one replica_lost + replica_recovered pair "
+            f"({[e.get('replica') for e in losts]})")
+    shed_events = [e for e in ev if e.get("event") == "serve_shed"]
+    f.check(len(shed_events) == summary["shed"],
+            f"serve_shed events ({len(shed_events)}) == shed counter "
+            f"({summary['shed']})")
+    swaps = [e for e in ev if e.get("event") == "serve_swap"]
+    phases = [(e.get("phase"), e.get("outcome")) for e in swaps]
+    f.check(("promote", "ok") in phases and ("rollback", "ok") in phases
+            and ("canary", "failed") in phases,
+            f"swap timeline journaled promote AND forced rollback "
+            f"({phases})")
+
+    cmd = [sys.executable, os.path.join(ROOT, "tools", "check_journal.py"),
+           j_path, "--strict", "--trace", t_path]
+    f.check(subprocess.run(cmd, cwd=ROOT,
+                           env=dict(os.environ, PYTHONPATH=ROOT)
+                           ).returncode == 0,
+            "check_journal --strict accepts the fleet journal + trace")
+    rep = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "obs_report.py"),
+         j_path],
+        cwd=ROOT, env=dict(os.environ, PYTHONPATH=ROOT),
+        stdout=subprocess.PIPE, text=True)
+    f.check(rep.returncode == 0 and "replica r0" in rep.stdout
+            and "swap #" in rep.stdout and "shed toy" in rep.stdout
+            and "pool latency" in rep.stdout,
+            "obs_report renders the fleet section (replicas, swaps, "
+            "shed, pool tail)")
+
+    if f.errors:
+        print(f"\nfleet-smoke: {len(f.errors)} contract(s) BROKEN "
+              f"(artifacts in {work})")
+        return 1
+    print(f"\nfleet-smoke: all fleet contracts held (artifacts in {work})")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

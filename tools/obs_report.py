@@ -114,12 +114,21 @@ def summarize_serving(events: List[dict]) -> Optional[dict]:
     rows: request counts, latency tail quantiles recomputed from the
     per-request events (exact, unlike the registry's bucket-resolution
     quantiles), batch occupancy and padding waste from the serve_batch
-    aggregates, and the drain verdict. None when the journal carries no
-    serving traffic — training-only reports stay unchanged."""
+    aggregates, and the drain verdict. Fleet journals (serve/pool.py:
+    replica-tagged requests, serve_shed / serve_swap / replica_lost
+    events) additionally get per-replica ok/err rows, shed counts by
+    reason, the swap timeline with the canary verdict, replica
+    lost/recovered history, and pool-level latency tails recomputed
+    exactly from the per-request events. None when the journal carries
+    no serving traffic — training-only reports stay unchanged."""
     requests = [e for e in events if e.get("event") == "serve_request"]
     batches = [e for e in events if e.get("event") == "serve_batch"]
     drains = [e for e in events if e.get("event") == "serve_drain"]
-    if not (requests or batches or drains):
+    sheds = [e for e in events if e.get("event") == "serve_shed"]
+    swaps = [e for e in events if e.get("event") == "serve_swap"]
+    lost = [e for e in events if e.get("event") == "replica_lost"]
+    recovered = [e for e in events if e.get("event") == "replica_recovered"]
+    if not (requests or batches or drains or sheds or swaps or lost):
         return None
     models: Dict[str, dict] = {}
 
@@ -164,10 +173,83 @@ def summarize_serving(events: List[dict]) -> Optional[dict]:
             row["padding_waste_pct"] = 100.0 * m["padded"] / m["slots"]
         out["models"][name] = row
     if drains:
-        last = drains[-1]
+        # the fleet verdict is the POOL's aggregated drain; a canary or
+        # replica drain mid-run (swap promote/rollback writes one) must
+        # not pose as the shutdown verdict in a crashed-run postmortem
+        pool_drains = [e for e in drains if e.get("scope") == "pool"]
+        last = (pool_drains or drains)[-1]
         out["drain"] = {k: last.get(k) for k in
                         ("reason", "outcome", "accepted", "completed",
-                         "errors", "cancelled", "pending")}
+                         "errors", "cancelled", "pending", "shed",
+                         "offered", "refused", "replicas")
+                        if last.get(k) is not None}
+    fleet = summarize_fleet(requests, sheds, swaps, lost, recovered)
+    if fleet:
+        out["fleet"] = fleet
+    return out
+
+
+def summarize_fleet(requests: List[dict], sheds: List[dict],
+                    swaps: List[dict], lost: List[dict],
+                    recovered: List[dict]) -> Optional[dict]:
+    """The per-replica / swap-timeline view of a fleet journal
+    (serve/pool.py). None when nothing carries a replica tag and no
+    fleet events exist — single-server journals render exactly as
+    before."""
+    replicas: Dict[str, dict] = {}
+
+    def replica_row(rid):
+        return replicas.setdefault(
+            rid, {"ok": 0, "error": 0, "rejected": 0, "cancelled": 0,
+                  "lost": 0, "recovered": 0})
+
+    for e in requests:
+        rid = e.get("replica")
+        if not isinstance(rid, str):
+            continue
+        row = replica_row(rid)
+        outcome = e.get("outcome")
+        row[outcome if outcome in ("ok", "error", "rejected", "cancelled")
+            else "error"] += 1
+    for key, events in (("lost", lost), ("recovered", recovered)):
+        for e in events:
+            if isinstance(e.get("replica"), str):
+                replica_row(e["replica"])[key] += 1
+    shed_rows: Dict[str, Dict[str, int]] = {}
+    for e in sheds:
+        by_reason = shed_rows.setdefault(str(e.get("model", "?")), {})
+        reason = str(e.get("reason", "?"))
+        by_reason[reason] = by_reason.get(reason, 0) + 1
+    timelines: Dict[int, List[dict]] = {}
+    for e in swaps:
+        sid = e.get("swap")
+        sid = sid if isinstance(sid, int) else 0
+        timelines.setdefault(sid, []).append(
+            {k: e.get(k) for k in
+             ("phase", "outcome", "reason", "error", "canary_ok",
+              "canary_err", "error_rate", "p99_ms", "pct", "replica")
+             if e.get(k) is not None})
+    if not (replicas or shed_rows or timelines):
+        return None
+    out: dict = {}
+    if replicas:
+        out["replicas"] = {rid: replicas[rid] for rid in sorted(replicas)}
+        # the pool-level tail across every replica and model: the number
+        # an operator pages on, exact from the per-request events
+        lat = [float(e["latency_ms"]) for e in requests
+               if e.get("outcome") == "ok"
+               and isinstance(e.get("latency_ms"), (int, float))]
+        if lat:
+            out["pool_latency"] = {
+                "n": len(lat),
+                "p50_ms": _percentile(lat, 0.5),
+                "p95_ms": _percentile(lat, 0.95),
+                "p99_ms": _percentile(lat, 0.99),
+            }
+    if shed_rows:
+        out["shed"] = shed_rows
+    if timelines:
+        out["swaps"] = [timelines[sid] for sid in sorted(timelines)]
     return out
 
 
@@ -235,6 +317,48 @@ def render(summary: dict) -> str:
                 parts += (f"  occupancy {r['occupancy_pct']:.1f}%"
                           f"  padding waste {r['padding_waste_pct']:.1f}%")
             rows.append((f"serving {name}", parts))
+        # fleet view (serve/pool.py journals): per-replica ledgers, the
+        # pool-level tail, shed-by-reason, and each swap's timeline —
+        # the 3am "which replica / which swap / how much shed" answers
+        fleet = serving.get("fleet")
+        if fleet:
+            for rid, r in fleet.get("replicas", {}).items():
+                parts = f"{r['ok']} ok, {r['error']} err"
+                if r.get("cancelled"):
+                    parts += f", {r['cancelled']} cancelled"
+                if r.get("lost"):
+                    parts += (f"  lost x{r['lost']}"
+                              f" recovered x{r['recovered']}")
+                rows.append((f"replica {rid}", parts))
+            pl = fleet.get("pool_latency")
+            if pl:
+                rows.append(("pool latency",
+                             f"p50 {pl['p50_ms']:.2f}ms "
+                             f"p95 {pl['p95_ms']:.2f}ms "
+                             f"p99 {pl['p99_ms']:.2f}ms "
+                             f"(n={pl['n']} admitted ok)"))
+            for model, by_reason in fleet.get("shed", {}).items():
+                total = sum(by_reason.values())
+                detail = " ".join(f"{k}x{n}"
+                                  for k, n in sorted(by_reason.items()))
+                rows.append((f"shed {model}", f"{total} ({detail})"))
+            for i, timeline in enumerate(fleet.get("swaps", []), 1):
+                steps = []
+                verdict = ""
+                for t in timeline:
+                    if t.get("outcome") == "started":
+                        continue  # the terminal outcome per phase tells it
+                    steps.append(f"{t.get('phase')} {t.get('outcome')}")
+                    if t.get("phase") == "canary" and "canary_ok" in t:
+                        verdict = (f"  [canary {t['canary_ok']} ok, "
+                                   f"{t.get('canary_err', 0)} err"
+                                   + (f", p99 {t['p99_ms']:.1f}ms"
+                                      if isinstance(t.get("p99_ms"),
+                                                    (int, float)) else "")
+                                   + "]")
+                    if t.get("reason"):
+                        steps[-1] += f" ({t['reason']})"
+                rows.append((f"swap #{i}", " -> ".join(steps) + verdict))
         drain = serving.get("drain")
         if drain:
             parts = (f"accepted={drain.get('accepted')} "
@@ -242,6 +366,10 @@ def render(summary: dict) -> str:
                      f"errors={drain.get('errors')}")
             if drain.get("cancelled"):
                 parts += f" cancelled={drain['cancelled']}"
+            if drain.get("shed"):
+                parts += f" shed={drain['shed']}"
+            if drain.get("offered"):
+                parts += f" offered={drain['offered']}"
             rows.append(("serve drain",
                          f"{drain.get('reason')} -> {drain.get('outcome')} "
                          f"({parts} pending={drain.get('pending')})"))
