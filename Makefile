@@ -51,7 +51,7 @@ lint-baseline:
 # obs-smoke and chaos-smoke — the telemetry artifacts must validate and
 # the resilience contracts must hold before the tests count
 verify: SHELL := /bin/bash
-verify: lint preflight perf-smoke obs-smoke chaos-smoke serve-smoke fleet-smoke
+verify: lint preflight perf-smoke obs-smoke chaos-smoke data-smoke serve-smoke fleet-smoke
 	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
 
 # environment preflight: backend liveness + libtpu/client version
@@ -120,6 +120,17 @@ fleet-smoke:
 chaos-smoke:
 	JAX_PLATFORMS=cpu python tools/chaos_run.py --workdir artifacts/chaos_smoke
 
+# data-plane smoke: the production data plane's contracts
+# (tools/data_smoke.py) — a record-backed CPU train SIGKILLed mid-epoch
+# resumes from the crc32c sidecar with a byte-identical batch stream
+# (content hashes; typed data_resume event), and a 2-consumer shared
+# dataset service streams with zero recompiles and zero starvation,
+# absorbs an injected worker crash via supervised respawn
+# (data_worker_lost/recovered) and a dropped connection via client
+# reconnect; journals pass check_journal --strict
+data-smoke:
+	JAX_PLATFORMS=cpu python tools/data_smoke.py --workdir artifacts/data_smoke
+
 # perf smoke: the CPU-provable proxies behind the MFU attack — fused
 # Pallas kernels (bn_act, nms) match their lax references in interpret
 # mode, a multistep=4 Trainer superstep is step-for-step equivalent to 4
@@ -175,4 +186,4 @@ ps:
 native:
 	$(MAKE) -C native
 
-.PHONY: train resume train-fg test lint lint-baseline verify preflight obs-smoke chaos-smoke serve-smoke fleet-smoke perf-smoke bench bench-evidence roofline demo demo-gan demo-real dryrun tb ps native
+.PHONY: train resume train-fg test lint lint-baseline verify preflight obs-smoke chaos-smoke data-smoke serve-smoke fleet-smoke perf-smoke bench bench-evidence roofline demo demo-gan demo-real dryrun tb ps native

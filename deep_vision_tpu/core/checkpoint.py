@@ -26,6 +26,14 @@ newest remaining valid step is restored instead of crashing the run.
 `ckpt.sidecar` incl. the after-write torn window) make every one of
 those paths testable on CPU.
 
+The sidecar is also the input pipeline's checkpoint home: Trainer saves
+the train DataLoader's `data/snapshot.py` DataLoaderState under the
+`data_state` host-state key (epoch, batches consumed, shard cursor,
+bad-record-budget spend), so `resume()` re-arms the batch stream at the
+exact position the model state corresponds to — the PR 10 elastic
+guarantees extended to the data plane (a resumed run must not silently
+re-visit data the step counter says it already trained on).
+
 Elastic (cross-mesh) restore: every save records leaf-level sharding
 metadata in the sidecar (`resilience.elastic.sharding_meta` under the
 reserved `__sharding__` key), so a run checkpointed on N hosts/devices

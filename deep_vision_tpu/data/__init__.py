@@ -16,7 +16,15 @@ ResNet/tensorflow/train.py:148-214). One layer, shared by every model:
   (Rescale/RandomCrop/CenterCrop/Flip/ColorJitter/Normalize) plus the
   bbox-preserving detection augments;
 - `pipeline`: threaded decode/augment workers -> fixed-shape batches ->
-  `shard_batch` onto the mesh (the host->device boundary).
+  `shard_batch` onto the mesh (the host->device boundary);
+- `snapshot`: the input pipeline as a checkpoint citizen — a
+  `DataLoaderState` (epoch, batches, shard cursor, budget spend) rides
+  the checkpoint sidecar so a kill/resume replays a byte-identical
+  batch stream instead of silently restarting from shard zero;
+- `service`: the shared dataset service — decode/augment in a spawned
+  worker pool serving pre-collated batches over local sockets to any
+  number of trainers/evals, with worker-death supervision and
+  client-side reconnect (README "The data plane").
 """
 from deep_vision_tpu.data.example_codec import decode_example, encode_example
 from deep_vision_tpu.data.records import (
@@ -36,8 +44,26 @@ from deep_vision_tpu.data.datasets import (
 from deep_vision_tpu.data import transforms
 from deep_vision_tpu.data.pipeline import DataLoader, Compose
 from deep_vision_tpu.data.device_prefetch import DevicePrefetcher, PlacedBatch
+from deep_vision_tpu.data.service import (
+    DataService,
+    DataServiceClient,
+    shard_for_host,
+)
+from deep_vision_tpu.data.snapshot import (
+    DataLoaderState,
+    SnapshotError,
+    SnapshotMismatch,
+    SnapshotUnsupported,
+)
 
 __all__ = [
+    "DataLoaderState",
+    "DataService",
+    "DataServiceClient",
+    "SnapshotError",
+    "SnapshotMismatch",
+    "SnapshotUnsupported",
+    "shard_for_host",
     "DevicePrefetcher",
     "PlacedBatch",
     "BadRecordBudget",

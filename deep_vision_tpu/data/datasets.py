@@ -221,6 +221,10 @@ class RecordDataset:
         self.seed = seed
         self.bad_record_budget = bad_record_budget
         self._epoch = 0
+        # optional snapshot.LiveCursor: updated per record read so the
+        # DataLoader snapshot can report the shard read frontier
+        # (data/snapshot.py); None costs one attribute check per shard
+        self.cursor = None
 
     def set_epoch(self, epoch: int) -> None:
         """Pin the shard-reshuffle epoch (DataLoader `num_procs` mode, where
@@ -238,6 +242,7 @@ class RecordDataset:
         out.seed = self.seed + 1000003 * index
         out.bad_record_budget = self.bad_record_budget
         out._epoch = self._epoch
+        out.cursor = None  # worker slices never report the parent frontier
         return out
 
     def _decode(self, raw: bytes) -> dict:
@@ -250,20 +255,30 @@ class RecordDataset:
             np.random.RandomState(self.seed + self._epoch).shuffle(files)
         self._epoch += 1
         budget = self.bad_record_budget
+        cur = self.cursor
+        if cur is not None:
+            cur.begin_epoch()
         if budget is None:
             from deep_vision_tpu.data.records import best_reader
 
             reader = best_reader()
-            for path in files:
+            for si, path in enumerate(files):
+                if cur is not None:
+                    cur.begin_shard(si, path)
                 for raw in reader(path):
-                    yield self._decode(raw)
+                    sample = self._decode(raw)
+                    if cur is not None:
+                        cur.advance()
+                    yield sample
             return
         from deep_vision_tpu.data.records import (
             BadRecordBudgetExceeded,
             read_records_tolerant,
         )
 
-        for path in files:
+        for si, path in enumerate(files):
+            if cur is not None:
+                cur.begin_shard(si, path)
             for offset, raw in read_records_tolerant(path, budget):
                 try:
                     sample = self._decode(raw)
@@ -277,4 +292,6 @@ class RecordDataset:
                         path, offset,
                         f"decode failed: {type(e).__name__}: {e}")
                     continue
+                if cur is not None:
+                    cur.advance()
                 yield sample

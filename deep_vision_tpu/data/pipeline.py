@@ -24,6 +24,8 @@ import numpy as np
 
 # jax-free like obs/registry: spans are no-ops unless train_cli installed a
 # tracer, and this module stays importable from spawned data workers
+from deep_vision_tpu.data import snapshot as _snapshot
+from deep_vision_tpu.obs import locksmith
 from deep_vision_tpu.obs.trace import now_us, span, trace_event
 
 
@@ -60,6 +62,20 @@ def _buffer_shuffle(samples: Iterable[dict], buffer: int,
     yield from buf
 
 
+def worker_put(out_q, stop_evt, item, timeout: float = 0.2) -> bool:
+    """Bounded queue put that keeps observing stop_evt (an abandoned
+    consumer leaves the queue full; a plain put would block past the
+    stop). Shared by the loader's worker processes and the dataset
+    service's (data/service.py) so the stop semantics cannot drift."""
+    while not stop_evt.is_set():
+        try:
+            out_q.put(item, timeout=timeout)
+            return True
+        except queue.Full:
+            continue
+    return False
+
+
 def _proc_worker(dataset, transform, epoch_seed, wid, out_q, stop_evt,
                  skip: int = 0):
     """Worker-process body: stream, transform, and ship samples.
@@ -74,15 +90,7 @@ def _proc_worker(dataset, transform, epoch_seed, wid, out_q, stop_evt,
     parent never advances the original dataset object it re-pickles).
     """
     def put(item) -> bool:
-        """Bounded put that keeps observing stop_evt (an abandoned consumer
-        leaves the queue full; a plain put would block past the stop)."""
-        while not stop_evt.is_set():
-            try:
-                out_q.put(item, timeout=0.2)
-                return True
-            except queue.Full:
-                continue
-        return False
+        return worker_put(out_q, stop_evt, item)
 
     try:
         rng = np.random.default_rng((epoch_seed, wid))
@@ -160,6 +168,26 @@ class DataLoader:
         self._map_style = hasattr(dataset, "__getitem__") and hasattr(
             dataset, "__len__"
         )
+        # -- snapshot plumbing (data/snapshot.py) --------------------------
+        # The producer writes a resumable DataLoaderState into `_ring`
+        # after every collated batch (keyed (epoch, batches)); the consumer
+        # side of __iter__ marks which key it has actually been handed, so
+        # state_dict() returns the exact consumed position even while the
+        # prefetch thread runs ahead. `_resume` arms a deterministic
+        # skip-replay for the next epoch iteration (see load_state_dict).
+        self._ring: dict = {}
+        self._ring_keys: List[tuple] = []
+        self._ring_lock = locksmith.lock("data.pipeline.snapshot")
+        self._consumed_key: Optional[tuple] = None
+        self._resume: Optional[_snapshot.DataLoaderState] = None
+        self._fp: Optional[str] = None
+        # per-batch state recording is OFF until armed (enable_snapshots /
+        # load_state_dict / Trainer attaching this loader): eval loaders
+        # and non-snapshot runs must not pay the ring/rng/cursor
+        # bookkeeping on the producer hot path — the LiveCursor is
+        # attached to the dataset only when arming, too
+        self._snapshot_on = False
+        self._cursor = None
 
     def __len__(self) -> int:
         if not self._map_style:
@@ -183,33 +211,78 @@ class DataLoader:
                 return
             yield from _buffer_shuffle(it, self.shuffle_buffer, epoch_rng)
 
-    def _transformed(self, epoch_seed: int) -> Iterator[dict]:
-        epoch_rng = np.random.default_rng(epoch_seed)
-        samples = self._samples(epoch_rng)
-        if self.transform is None:
-            yield from samples
-            return
-        # ordered parallel map: worker i gets its own derived rng stream
-        with ThreadPoolExecutor(self.num_workers) as pool:
-            window: "queue.Queue" = queue.Queue()
-            in_flight = 0
-            max_in_flight = self.num_workers * 2
+    def _transformed(self, epoch_seed: int,
+                     epoch_rng: np.random.Generator,
+                     skip: int = 0,
+                     quiet_read: int = 0) -> Iterator[dict]:
+        """Shuffled + transformed sample stream for one epoch.
 
-            def submit(sample, k):
-                rng = np.random.default_rng((epoch_seed, k))
-                return pool.submit(self.transform, sample, rng)
+        `skip` is the snapshot-resume fast-forward (data/snapshot.py): the
+        first `skip` post-shuffle samples are consumed WITHOUT transform —
+        they were already trained on before the kill — while the sample
+        index `k` keeps advancing so per-sample transform keys
+        `(epoch_seed, k)` stay aligned with the uninterrupted run's.
 
-            k = 0
-            for sample in samples:
-                window.put(submit(sample, k))
-                k += 1
-                in_flight += 1
-                if in_flight >= max_in_flight:
+        The bad-record budget's `replaying` latch is held until BOTH the
+        consumed prefix is skipped and the source has re-read past
+        `quiet_read` (the original run's read frontier from the snapshot
+        cursor): the original run dead-lettered every bad record up to
+        its frontier — which ran ahead of the consumed prefix by the
+        shuffle buffer and in-flight transforms — so re-emitting rows
+        for anything before it would double-report.
+        """
+        budget = getattr(self.dataset, "bad_record_budget", None)
+        latched = bool(skip) and budget is not None
+        if latched:
+            budget.replaying = True
+
+        def maybe_unlatch(k: int) -> None:
+            nonlocal latched
+            if not latched or k < skip:
+                return
+            if (quiet_read and self._cursor is not None
+                    and self._cursor.read_count() < quiet_read):
+                return
+            budget.replaying = False
+            latched = False
+
+        try:
+            samples = self._samples(epoch_rng)
+            if self.transform is None:
+                for k, sample in enumerate(samples):
+                    if k < skip:
+                        continue
+                    maybe_unlatch(k)
+                    yield sample
+                return
+            # ordered parallel map: worker i gets its own derived rng stream
+            with ThreadPoolExecutor(self.num_workers) as pool:
+                window: "queue.Queue" = queue.Queue()
+                in_flight = 0
+                max_in_flight = self.num_workers * 2
+
+                def submit(sample, k):
+                    rng = np.random.default_rng((epoch_seed, k))
+                    return pool.submit(self.transform, sample, rng)
+
+                k = 0
+                for sample in samples:
+                    if k < skip:
+                        k += 1
+                        continue
+                    maybe_unlatch(k)
+                    window.put(submit(sample, k))
+                    k += 1
+                    in_flight += 1
+                    if in_flight >= max_in_flight:
+                        yield window.get().result()
+                        in_flight -= 1
+                while in_flight:
                     yield window.get().result()
                     in_flight -= 1
-            while in_flight:
-                yield window.get().result()
-                in_flight -= 1
+        finally:
+            if budget is not None:
+                budget.replaying = False
 
     def _proc_samples(self, epoch_seed: int, epoch: int) -> Iterator[dict]:
         """Transformed samples from `num_procs` spawned workers, merged.
@@ -384,18 +457,45 @@ class DataLoader:
                     p.terminate()
 
     def _batches(self) -> Iterator[dict]:
-        epoch_seed = self.seed + self._epoch
+        epoch = self._epoch
+        epoch_seed = self.seed + epoch
         self._epoch += 1
+        # pin the dataset's own epoch counter to the LOADER's in every
+        # mode (was proc-mode-only): a resumed process otherwise restarts
+        # the dataset at epoch 0 and silently replays shard order from
+        # scratch while the trainer continues at epoch N — every per-epoch
+        # random decision must derive from (seed, epoch), not from how
+        # many times this process happened to iterate
+        if hasattr(self.dataset, "set_epoch"):
+            self.dataset.set_epoch(epoch)
+        resume = self._resume
+        self._resume = None
+        if resume is not None and resume.epoch != epoch:
+            resume = None  # armed for a different epoch: nothing to skip
+        skip = resume.batches * self.batch_size if resume is not None else 0
+        budget = getattr(self.dataset, "bad_record_budget", None)
+        if budget is not None:
+            if resume is not None and resume.budget_epoch_start is not None:
+                # the deterministic replay below re-spends the intra-epoch
+                # portion; start the epoch where the original did
+                budget.set_spend(resume.budget_epoch_start)
+            budget_start = budget.spend()
+        else:
+            budget_start = None
+        epoch_rng = np.random.default_rng(epoch_seed)
         if self.num_procs > 0:
-            samples: Iterable[dict] = self._proc_samples(epoch_seed, self._epoch - 1)
+            samples: Iterable[dict] = self._proc_samples(epoch_seed, epoch)
             if self.shuffle:
                 samples = _buffer_shuffle(
-                    samples, self.shuffle_buffer,
-                    np.random.default_rng(epoch_seed),
+                    samples, self.shuffle_buffer, epoch_rng,
                 )
         else:
-            samples = self._transformed(epoch_seed)
+            samples = self._transformed(
+                epoch_seed, epoch_rng, skip=skip,
+                quiet_read=int((resume.cursor or {}).get("read", 0) or 0)
+                if resume is not None else 0)
         buf: List[dict] = []
+        bi = skip // self.batch_size  # batches already consumed pre-resume
         # per-batch producer span via explicit timestamps: one batch's
         # decode+augment work spans loop iterations, so a with-block can't
         # bracket it. t0 is when the batch's first sample was requested.
@@ -407,6 +507,9 @@ class DataLoader:
                     batch = self.collate_fn(buf)
                 trace_event("data/augment_batch", t0, loader=self.name,
                             batch_size=len(buf))
+                bi += 1
+                self._record_snapshot(epoch, bi, epoch_seed, epoch_rng,
+                                      budget, budget_start)
                 yield batch
                 buf = []
                 t0 = now_us()
@@ -414,7 +517,153 @@ class DataLoader:
             batch = self.collate_fn(buf)
             trace_event("data/augment_batch", t0, loader=self.name,
                         batch_size=len(buf))
+            bi += 1
+            # the tail batch's entry is the epoch-end state, written
+            # BEFORE the yield (handed = consumed, same as _mark_consumed):
+            # a preempt save while the trainer processes the tail must
+            # find its key in the ring, not fabricate a position
+            self._record_snapshot(epoch, bi, epoch_seed, epoch_rng,
+                                  budget, budget_start, epoch_end=True)
             yield batch
+        # end-of-epoch state: resuming after the final batch means
+        # starting the NEXT epoch clean (overwrites the tail batch's
+        # entry under the same key with identical content)
+        self._record_snapshot(epoch, bi, epoch_seed, epoch_rng,
+                              budget, budget_start, epoch_end=True)
+
+    # -- snapshot/restore (data/snapshot.py) --------------------------------
+
+    def _fingerprint(self) -> str:
+        if self._fp is None:
+            self._fp = _snapshot.fingerprint(
+                self.dataset, self.batch_size, self.seed,
+                shuffle=self.shuffle, shuffle_buffer=self.shuffle_buffer,
+                drop_remainder=self.drop_remainder)
+        return self._fp
+
+    def _record_snapshot(self, epoch: int, bi: int, epoch_seed: int,
+                         epoch_rng, budget, budget_start,
+                         epoch_end: bool = False) -> None:
+        """Producer side: the resumable state AFTER batch `bi` of `epoch`
+        (or after the whole epoch), written into the bounded ring the
+        consumer-side state_dict() reads."""
+        if not self._snapshot_on or self.num_procs > 0:
+            return  # not armed (or unsupported): stay off the hot path
+        spend = budget.spend() if budget is not None else None
+        if epoch_end:
+            st = _snapshot.DataLoaderState(
+                epoch=epoch + 1, batches=0,
+                epoch_seed=self.seed + epoch + 1,
+                fingerprint=self._fingerprint(),
+                cursor=self._cursor.snapshot() if self._cursor else None,
+                budget=spend, budget_epoch_start=spend,
+            )
+        else:
+            st = _snapshot.DataLoaderState(
+                epoch=epoch, batches=bi, epoch_seed=epoch_seed,
+                fingerprint=self._fingerprint(),
+                cursor=self._cursor.snapshot() if self._cursor else None,
+                rng=_snapshot.rng_state(epoch_rng),
+                budget=spend, budget_epoch_start=budget_start,
+            )
+        key = (epoch, bi)
+        # the bound must exceed how far the producer can run ahead of the
+        # consumer (the prefetch depth), or a deep-prefetch loader could
+        # evict the very key the consumer's next state_dict() needs
+        bound = max(64, self.prefetch + 8)
+        with self._ring_lock:
+            if key not in self._ring:
+                self._ring_keys.append(key)
+            self._ring[key] = st.to_dict()
+            while len(self._ring_keys) > bound:
+                old = self._ring_keys.pop(0)
+                self._ring.pop(old, None)
+
+    def _mark_consumed(self, epoch: int, batches: int) -> None:
+        self._consumed_key = (epoch, batches)
+
+    def snapshot_supported(self) -> bool:
+        """num_procs workers interleave nondeterministically — no
+        host-side state can reproduce that stream, so snapshots refuse."""
+        return self.num_procs == 0
+
+    def enable_snapshots(self) -> None:
+        """Arm per-batch state recording (Trainer does this when the
+        loader is attached as its data_loader). Must happen before the
+        epoch whose mid-epoch positions you want to capture — epoch-
+        boundary states are exact either way."""
+        if not self.snapshot_supported():
+            raise _snapshot.SnapshotUnsupported(
+                f"DataLoader(num_procs={self.num_procs}) cannot snapshot: "
+                "worker-process interleave order is nondeterministic")
+        self._snapshot_on = True
+        if self._cursor is None and hasattr(self.dataset, "cursor"):
+            self._cursor = _snapshot.LiveCursor()
+            self.dataset.cursor = self._cursor
+
+    def state_dict(self) -> dict:
+        """The resumable position of this loader's batch stream (a
+        data/snapshot.py DataLoaderState as a JSON-clean dict), exact to
+        the batch the consumer was last handed — checkpoint it next to
+        the model (Trainer puts it in the crc32c host sidecar)."""
+        if not self.snapshot_supported():
+            raise _snapshot.SnapshotUnsupported(
+                f"DataLoader(num_procs={self.num_procs}) cannot snapshot: "
+                "worker-process interleave order is nondeterministic")
+        key = self._consumed_key
+        with self._ring_lock:
+            st = dict(self._ring[key]) if key in self._ring else None
+        if st is not None:
+            return st
+        if self._resume is not None:
+            return self._resume.to_dict()  # armed but not yet iterated
+        if key is not None:
+            # the loader HAS been iterated but the consumed position is
+            # not in the ring: either snapshots were armed after
+            # iteration started, or the ring bound failed — fabricating
+            # a position here would be the silent stream shift this
+            # module exists to refuse
+            raise _snapshot.SnapshotError(
+                f"no recorded state for consumed position {key}: call "
+                "enable_snapshots() before iterating (Trainer does this "
+                "when the loader is attached)")
+        return _snapshot.DataLoaderState(
+            epoch=self._epoch, batches=0,
+            epoch_seed=self.seed + self._epoch,
+            fingerprint=self._fingerprint(),
+        ).to_dict()
+
+    def load_state_dict(self, state: dict) -> dict:
+        """Arm a resume at `state`'s position; the next epoch iteration
+        deterministically replays and skips what was already consumed.
+        Returns a small info dict (epoch/batches/shard/record) for the
+        caller's `data_resume` journal event. Raises SnapshotMismatch
+        when the dataset or loader shape changed under the snapshot."""
+        if not self.snapshot_supported():
+            raise _snapshot.SnapshotUnsupported(
+                f"DataLoader(num_procs={self.num_procs}) cannot snapshot: "
+                "worker-process interleave order is nondeterministic")
+        st = _snapshot.validate_state(state)
+        if st.fingerprint and st.fingerprint != self._fingerprint():
+            raise _snapshot.SnapshotMismatch(
+                "data_state fingerprint mismatch: the dataset shard list "
+                "or loader shape (batch size, seed, shuffle/buffer, "
+                "drop_remainder) changed since the snapshot — resuming "
+                "would silently shift the stream")
+        self._epoch = st.epoch
+        self._resume = st
+        self._consumed_key = None
+        self.enable_snapshots()  # a restored loader keeps snapshotting
+        budget = getattr(self.dataset, "bad_record_budget", None)
+        if budget is not None and st.budget is not None:
+            # boundary snapshot: counters restore directly; mid-epoch:
+            # epoch-start values now, the replay re-spends the rest
+            budget.set_spend(
+                st.budget if st.batches == 0
+                else (st.budget_epoch_start or st.budget))
+        cur = st.cursor or {}
+        return {"epoch": st.epoch, "batches": st.batches,
+                "shard": cur.get("shard"), "record": cur.get("record")}
 
     def __iter__(self) -> Iterator[dict]:
         """Yield batches, producing up to `prefetch` ahead on a thread.
@@ -423,8 +672,16 @@ class DataLoader:
         latency); the DEVICE half — overlapping the H2D transfer itself
         with compute — is data/device_prefetch.py, which the Trainer
         stacks on top of this iterator (`--device-prefetch`)."""
+        iter_epoch = self._epoch  # the epoch _batches() is about to run
+        base = (self._resume.batches
+                if self._resume is not None
+                and self._resume.epoch == iter_epoch else 0)
         if self.prefetch <= 0:
-            yield from self._batches()
+            i = base
+            for b in self._batches():
+                i += 1
+                self._mark_consumed(iter_epoch, i)
+                yield b
             return
         # obs hooks: registry.py is jax-free, so this stays importable from
         # spawned data workers. Depth is sampled at every consumer get;
@@ -458,6 +715,7 @@ class DataLoader:
         t = threading.Thread(target=producer, daemon=True)
         t.start()
         first = True
+        i = base
         while True:
             depth = q.qsize()
             t0 = now_us()
@@ -477,6 +735,10 @@ class DataLoader:
                 c_starved.inc()
             first = False
             c_batches.inc()
+            i += 1
+            # marked BEFORE the yield: a batch handed to the consumer is
+            # consumed — a checkpoint taken mid-step must not replay it
+            self._mark_consumed(iter_epoch, i)
             yield item
         t.join()
         if err:

@@ -147,6 +147,12 @@ class BadRecordBudget:
         self.journal = journal
         self.bad = 0
         self.ok = 0
+        # snapshot-resume replay latch (data/snapshot.py): while True,
+        # record_bad still COUNTS (the deterministic replay must re-spend
+        # the epoch's budget to land on the saved position) but skips the
+        # dead-letter row, journal event, and stderr line — the original
+        # run already emitted them for this prefix
+        self.replaying = False
         self._lock = locksmith.lock("data.records.budget")
 
     @classmethod
@@ -181,6 +187,17 @@ class BadRecordBudget:
         with self._lock:
             self.ok += n
 
+    def spend(self) -> dict:
+        """The current (bad, ok) counters, for the pipeline snapshot."""
+        with self._lock:
+            return {"bad": self.bad, "ok": self.ok}
+
+    def set_spend(self, spend: dict) -> None:
+        """Restore counters from a snapshot (data/snapshot.py resume)."""
+        with self._lock:
+            self.bad = int(spend.get("bad", 0))
+            self.ok = int(spend.get("ok", 0))
+
     def _exceeded(self) -> bool:
         if self.max_count is not None and self.bad > self.max_count:
             return True
@@ -193,6 +210,16 @@ class BadRecordBudget:
         with self._lock:
             self.bad += 1
             bad = self.bad
+        if self.replaying:
+            # snapshot replay: count silently (see __init__), still abort
+            # once spent — a budget the original run exhausted must not
+            # survive the resume
+            if self._exceeded():
+                raise BadRecordBudgetExceeded(
+                    f"bad-record budget exceeded during snapshot replay "
+                    f"({self.describe()}): {self.bad} bad of "
+                    f"{self.bad + self.ok} seen")
+            return
         row = {"ts": round(time.time(), 3), "path": path,
                "offset": int(offset), "reason": reason}
         if self.dead_letter_path:

@@ -1,0 +1,763 @@
+"""Shared dataset service: decode/augment out-of-process, batches over sockets.
+
+The second half of the production data plane (ROADMAP item 4, the
+tf.data-service / Grain pattern): decoding and augmentation move out of
+the trainer process into a worker-pool service that serves pre-decoded,
+pre-collated, fixed-shape batches over local sockets — so several
+consumers (a trainer and its eval pass, two trainers, a high-RPS eval
+fleet) share ONE pipeline instead of each burning host cores on a
+private copy, and the trainer's step loop never pays decode on its
+critical path.
+
+Topology::
+
+    worker procs (spawn; disjoint dataset slices; decode+augment)
+        -> sample queue -> pump thread (global shuffle buffer, collate,
+           encode) -> bounded batch queue
+        -> accept thread -> per-client handler threads (frame I/O)
+
+    DataServiceClient(addr).batches(n)  # any number of clients
+
+Wire format: the record container's framing over a TCP stream —
+``uint64 len | crc32c(len) | payload | crc32c(payload)`` (records.py's
+masked crc) — with payloads encoded by `example_codec`, so the service
+speaks the repo's one serialization dialect end to end. A batch frame
+carries each array as raw bytes + dtype + shape features.
+
+Epoch semantics are client-side: the service runs a CONTINUOUS stream
+(each worker-pool epoch reshuffles shard order and reseeds transforms
+from (seed, epoch); the global shuffle buffer carries across the
+boundary), and clients impose their own epoch windows by step count
+(`client.batches(steps_per_epoch)`) — the tf.data-service `repeat()`
+contract that keeps N consumers from needing a distributed epoch
+barrier. Batches are always exactly `batch_size` rows (drop-remainder
+at the stream tail), so every consumer compiles once.
+
+Resilience contracts (all CPU-testable, `make data-smoke`):
+
+* worker death: a SIGKILLed/OOM-killed worker is detected by the pump's
+  watchdog, journaled as a typed `data_worker_lost` event, and respawned
+  over its slice with the already-delivered prefix skipped
+  (`data_worker_recovered`) — the serve/pool.py `replica_lost` shape at
+  the data plane. A spent restart budget fails the service loudly.
+* client reconnect: a dropped connection (server restart, injected
+  `data.service` io_error at the frame boundary) is absorbed by the
+  client's `resilience.RetryPolicy` — reconnect, re-request, counted in
+  `data_service_reconnects_total`. Requests are idempotent pops of a
+  shared stream, so a retried `get` never duplicates a batch unless the
+  failure hit AFTER the server popped it (at-most-once delivery per
+  frame; a lost in-flight batch costs one batch of data, never a hang).
+* `resilience.faults` point `data.service` (io_error/crash) fires at
+  both frame boundaries and in the worker body (env-inherited), making
+  every path above deterministically injectable.
+
+Per-host sharding: `shard_for_host(host_id, num_hosts)` is the
+assignment rule multi-host training feeds (`multihost.host_shard` →
+one service per host over its disjoint shard slice); with a file list
+it returns the actual slice. Disjointness and coverage are tested.
+
+Metrics (the host-pipeline gauges re-homed at the service boundary):
+`data_service_batches_total{role=}`, `data_service_starved_total`,
+`data_service_reconnects_total`, `data_service_queue_depth`.
+
+jax-free, like the rest of data/: the service host needs no accelerator.
+"""
+from __future__ import annotations
+
+import os
+import queue
+import socket
+import struct
+import sys
+import threading
+import time
+from typing import Callable, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from deep_vision_tpu.data.example_codec import decode_example, encode_example
+from deep_vision_tpu.data.pipeline import _buffer_shuffle, collate, worker_put
+from deep_vision_tpu.data.records import _masked_crc
+from deep_vision_tpu.obs import locksmith
+from deep_vision_tpu.resilience import RetryPolicy, faults
+
+
+class DataServiceError(RuntimeError):
+    """Terminal service failure surfaced to a client (worker restart
+    budget spent, server-side pipeline error)."""
+
+
+# -- framing (records.py's container framing, over a stream socket) ----------
+
+def send_frame(sock: socket.socket, payload: bytes) -> None:
+    """One length-prefixed crc-checked frame; the `data.service` fault
+    point fires here (io_error = dropped connection mid-protocol)."""
+    faults.fire("data.service")
+    header = struct.pack("<Q", len(payload))
+    sock.sendall(header + struct.pack("<I", _masked_crc(header))
+                 + payload + struct.pack("<I", _masked_crc(payload)))
+
+
+def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None  # peer closed
+        buf += chunk
+    return buf
+
+
+def recv_frame(sock: socket.socket) -> Optional[bytes]:
+    """Read one frame; None on clean EOF, IOError on corruption (a torn
+    stream must not be decoded as a batch)."""
+    header = _recv_exact(sock, 8)
+    if header is None:
+        return None
+    rest = _recv_exact(sock, 4)
+    if rest is None:
+        raise IOError("data.service: stream died inside a frame header")
+    (length,) = struct.unpack("<Q", header)
+    (hcrc,) = struct.unpack("<I", rest)
+    if _masked_crc(header) != hcrc:
+        raise IOError("data.service: corrupt frame header")
+    payload = _recv_exact(sock, length)
+    tail = _recv_exact(sock, 4) if payload is not None else None
+    if payload is None or tail is None:
+        raise IOError("data.service: stream died inside a frame")
+    if _masked_crc(payload) != struct.unpack("<I", tail)[0]:
+        raise IOError("data.service: corrupt frame payload")
+    faults.fire("data.service")
+    return payload
+
+
+# -- batch <-> Example encoding ----------------------------------------------
+
+def encode_batch(batch: dict) -> bytes:
+    """Collated numpy batch dict -> one Example payload: per key, the
+    array's raw bytes + dtype + shape (the pre-decoded, pre-collated
+    shape a consumer device_puts without touching a decoder)."""
+    feats: dict = {"__kind__": [b"batch"]}
+    for k in sorted(batch):
+        v = np.ascontiguousarray(np.asarray(batch[k]))
+        feats[f"t/{k}/data"] = [v.tobytes()]
+        feats[f"t/{k}/dtype"] = [str(v.dtype).encode()]
+        feats[f"t/{k}/shape"] = [int(d) for d in v.shape]
+    return encode_example(feats)
+
+
+def decode_batch(payload: bytes) -> dict:
+    feats = decode_example(payload)
+    kind = feats.get("__kind__", [b""])[0]
+    if kind == b"err":
+        raise DataServiceError(feats.get("error", [b"?"])[0].decode())
+    if kind != b"batch":
+        raise IOError(f"data.service: unexpected frame kind {kind!r}")
+    out = {}
+    for key, vals in feats.items():
+        if not key.startswith("t/") or not key.endswith("/data"):
+            continue
+        name = key[2:-5]
+        dtype = np.dtype(feats[f"t/{name}/dtype"][0].decode())
+        shape = tuple(int(d) for d in feats[f"t/{name}/shape"])
+        out[name] = np.frombuffer(vals[0], dtype).reshape(shape)
+    return out
+
+
+def _control(kind: str, **fields) -> bytes:
+    feats = {"__kind__": [kind.encode()]}
+    for k, v in fields.items():
+        feats[k] = [v.encode() if isinstance(v, str) else v]
+    return encode_example(feats)
+
+
+# -- per-host shard assignment -----------------------------------------------
+
+def shard_for_host(host_id: int, num_hosts: int,
+                   files: Optional[Sequence[str]] = None):
+    """Deterministic, disjoint, covering shard assignment per host.
+
+    Without `files`, returns the (shard_index, num_shards) pair that
+    `RecordDataset`/`record_iterator` consume — the value
+    `multihost.host_shard()` produces, validated. With `files`, returns
+    the host's round-robin slice of the list. Every shard lands on
+    exactly one host (tests/test_data_service.py proves disjointness +
+    coverage), which is what keeps a multi-host epoch from double-
+    visiting data.
+    """
+    if num_hosts < 1:
+        raise ValueError(f"num_hosts must be >= 1, got {num_hosts}")
+    if not 0 <= host_id < num_hosts:
+        raise ValueError(
+            f"host_id {host_id} outside [0, num_hosts={num_hosts})")
+    if files is None:
+        return host_id, num_hosts
+    return list(files)[host_id::num_hosts]
+
+
+# -- worker body ---------------------------------------------------------------
+
+def _service_worker(dataset, transform, seed, wid, out_q, stop_evt,
+                    skip: int = 0, respawn: bool = False,
+                    start_epoch: int = 0):
+    """Spawned PERSISTENT worker: decode+augment its dataset slice epoch
+    after epoch in one process (every per-epoch random decision derives
+    from (seed + epoch, wid), shard order via set_epoch), shipping
+    `(wid, sample)` tuples and an `("__epoch__", wid)` marker at each
+    epoch boundary. Persistence is the point: a pool respawned per
+    epoch stalls the stream for a full python startup every pass over
+    the data — workers here only ever restart on death.
+
+    The `data.service` fault point fires per sample (env-inherited, so
+    an injected crash kills a real worker process exactly the way OOM
+    does). Respawned workers do NOT fire it: a replacement re-inherits
+    the same spec, and an @N crash rule would re-kill every respawn
+    forever — a permanently poisoned slot models nothing real. One
+    injected crash = one worker death; injectable RESPAWN failure is
+    the serve.replica point's territory."""
+    import numpy as np
+
+    def put(item) -> bool:
+        return worker_put(out_q, stop_evt, item)
+
+    epoch = start_epoch
+    try:
+        while not stop_evt.is_set():
+            if hasattr(dataset, "set_epoch"):
+                dataset.set_epoch(epoch)
+            rng = np.random.default_rng((seed + epoch, wid))
+            produced = 0
+            for k, sample in enumerate(dataset):
+                if stop_evt.is_set():
+                    return
+                if k < skip:
+                    continue  # already delivered by the life this
+                    #           worker replaces (parent-counted)
+                if not respawn:
+                    faults.fire("data.service")
+                if transform is not None:
+                    sample = transform(sample, rng)
+                if not put((wid, sample)):
+                    return
+                produced += 1
+            skip = 0
+            if not put(("__epoch__", wid)):
+                return
+            epoch += 1
+            if produced == 0:
+                # an empty slice (datasets the clamp above cannot size)
+                # must not hot-loop epoch markers at full CPU
+                time.sleep(0.5)
+    except BaseException as e:  # noqa: BLE001 - surfaced in the parent
+        put(("__error__", repr(e)))
+
+
+# -- the service ---------------------------------------------------------------
+
+class DataService:
+    """One shared input pipeline serving collated batches over sockets.
+
+    dataset must expose `.split(i, n)` (the DataLoader num_procs
+    contract: RecordDataset does) and be picklable along with
+    `transform`. `port=0` binds an ephemeral port — read `.address`
+    after `start()`.
+    """
+
+    def __init__(
+        self,
+        dataset,
+        batch_size: int,
+        transform: Optional[Callable] = None,
+        num_workers: int = 2,
+        shuffle: bool = True,
+        shuffle_buffer: int = 512,
+        seed: int = 0,
+        queue_depth: int = 16,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        name: str = "default",
+        journal=None,
+        registry=None,
+        worker_restarts: int = 2,
+        worker_poll_s: float = 5.0,
+        collate_fn: Callable = collate,
+    ):
+        if not hasattr(dataset, "split"):
+            raise TypeError(
+                f"DataService needs a dataset with .split(i, n); "
+                f"{type(dataset).__name__} has none")
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.transform = transform
+        self.num_workers = max(1, num_workers)
+        files = getattr(dataset, "files", None)
+        if files is not None and not files:
+            # an empty per-host slice would clamp to zero workers and
+            # start a service that can never serve — clients would hang
+            # to a misleading retry timeout instead of reading this
+            raise ValueError(
+                "dataset has no shards for this service (empty per-host "
+                "slice? fewer shards than num_hosts)")
+        if files is not None and self.num_workers > len(files):
+            # more workers than shards hands the surplus EMPTY slices:
+            # each would hot-loop epoch markers at full CPU forever
+            print(f"data_service: clamping num_workers "
+                  f"{self.num_workers} -> {len(files)} (one shard "
+                  f"minimum per worker)", file=sys.stderr)
+            self.num_workers = len(files)
+        self.shuffle = shuffle
+        self.shuffle_buffer = shuffle_buffer
+        self.seed = seed
+        self.name = name
+        self.journal = journal
+        self.worker_restarts = worker_restarts
+        self.worker_poll_s = worker_poll_s
+        self.collate_fn = collate_fn
+        self._host, self._port = host, port
+        self._stop = threading.Event()
+        self._sock: Optional[socket.socket] = None
+        self._batches: "queue.Queue[bytes]" = queue.Queue(maxsize=queue_depth)
+        self._threads: List[threading.Thread] = []
+        self._handlers: List[threading.Thread] = []  # accept-loop only
+        # shared across pump/handler/accept threads; one lock, held only
+        # for counter math — journal writes always happen OUTSIDE it
+        self._lock = locksmith.lock("data.service")
+        self._served = 0
+        self._produced = 0
+        self._lost = 0
+        self._recovered = 0
+        self._clients: List[socket.socket] = []
+        self._failed: Optional[str] = None
+        if registry is None:
+            from deep_vision_tpu.obs.registry import get_registry
+
+            registry = get_registry()
+        labels = {"service": name}
+        self._c_batches = registry.counter(
+            "data_service_batches_total",
+            "batches served to clients", labels=dict(labels, role="server"))
+        self._c_starved = registry.counter(
+            "data_service_starved_total",
+            "client gets that found the batch queue empty", labels=labels)
+        self._g_depth = registry.gauge(
+            "data_service_queue_depth",
+            "encoded batches ready when a client asked", labels=labels)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    @property
+    def address(self) -> str:
+        return f"{self._host}:{self._port}"
+
+    def start(self) -> "DataService":
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((self._host, self._port))
+        self._port = self._sock.getsockname()[1]
+        self._sock.listen(32)
+        self._sock.settimeout(0.25)  # accept loop stays stop-responsive
+        for target, tname in ((self._pump_loop, "data-service-pump"),
+                              (self._accept_loop, "data-service-accept")):
+            t = threading.Thread(target=target, name=tname, daemon=True)
+            t.start()
+            self._threads.append(t)
+        return self
+
+    def close(self) -> None:
+        """Stop workers + threads, close sockets, journal the summary."""
+        if self._stop.is_set():
+            return
+        self._stop.set()
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+        with self._lock:
+            clients = list(self._clients)
+        for c in clients:
+            try:
+                c.close()
+            except OSError:
+                pass
+        with self._lock:
+            handlers = list(self._handlers)
+        for t in self._threads + handlers:
+            t.join(timeout=10)
+        with self._lock:
+            served, produced = self._served, self._produced
+            lost, recovered = self._lost, self._recovered
+        if self.journal is not None:
+            # produced - served = batches buffered but never consumed
+            # (the residue a drain leaves behind)
+            self.journal.write(
+                "data_service", role="server", service=self.name,
+                batches=int(served), produced=int(produced),
+                workers=int(self.num_workers),
+                workers_lost=int(lost), workers_recovered=int(recovered))
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # -- producer side -----------------------------------------------------
+
+    def _journal(self, event: str, **fields) -> None:
+        if self.journal is not None:
+            try:
+                self.journal.write(event, **fields)
+            except Exception:
+                pass  # telemetry must never kill the pipeline it observes
+
+    def _worker_stream(self) -> Iterator[dict]:
+        """The continuous merged sample stream off the persistent worker
+        pool: spawn once, supervise, respawn on death.
+
+        A dead worker is `data_worker_lost{worker, attempt, error}` then
+        (within budget) `data_worker_recovered{worker, attempt}` after
+        the respawn over the same slice at its current epoch with the
+        delivered prefix skipped — the serve/pool.py replica shape at
+        the data plane.
+
+        Each worker LIFE owns a private mp.Queue. A shared queue is a
+        trap here: a SIGKILLed writer dies holding the queue's shared
+        write lock, and every surviving/respawned worker then blocks on
+        it forever — the whole service starves off one death (observed,
+        not hypothetical). With one single-writer queue per life, a
+        death poisons only its own queue, which is simply abandoned
+        unread: samples left in it were never counted in `delivered`,
+        so the replacement (started with skip=delivered) re-produces
+        exactly those — the consumer stream sees no loss and no
+        duplicates."""
+        import multiprocessing as mp
+
+        ctx = mp.get_context("spawn")
+        stop = ctx.Event()
+        n = self.num_workers
+        shards: list = []
+        procs: list = [None] * n
+        queues: list = [None] * n
+
+        def spawn(wid: int, skip: int = 0, respawn: bool = False,
+                  start_epoch: int = 0):
+            q: "mp.Queue" = ctx.Queue(maxsize=64)
+            saved = os.environ.get("JAX_PLATFORMS")
+            os.environ["JAX_PLATFORMS"] = "cpu"  # workers never touch a chip
+            try:
+                p = ctx.Process(
+                    target=_service_worker,
+                    args=(shards[wid], self.transform, self.seed, wid,
+                          q, stop, skip, respawn, start_epoch),
+                    daemon=True,
+                )
+                p.start()
+                return p, q
+            finally:
+                if saved is None:
+                    os.environ.pop("JAX_PLATFORMS", None)
+                else:
+                    os.environ["JAX_PLATFORMS"] = saved
+
+        try:
+            for i in range(n):
+                shards.append(self.dataset.split(i, n))
+                procs[i], queues[i] = spawn(i)
+            epochs = [0] * n      # each worker's current epoch
+            delivered = [0] * n   # samples merged from its CURRENT epoch
+            restarts = [0] * n
+            last_check = time.monotonic()
+            while not self._stop.is_set():
+                got_any = False
+                for i in range(n):
+                    # bounded drain burst per worker so one fast worker
+                    # cannot starve the others' queues of service
+                    for _ in range(64):
+                        try:
+                            item = queues[i].get_nowait()
+                        except (queue.Empty, EOFError, OSError):
+                            break
+                        got_any = True
+                        if isinstance(item, tuple) and len(item) == 2 \
+                                and item[0] == "__error__":
+                            raise DataServiceError(
+                                f"data service worker failed: {item[1]}")
+                        if isinstance(item, tuple) and len(item) == 2 \
+                                and item[0] == "__epoch__":
+                            epochs[i] += 1
+                            delivered[i] = 0
+                            continue
+                        delivered[i] += 1
+                        yield item[1]
+                now = time.monotonic()
+                if now - last_check < self.worker_poll_s:
+                    if not got_any:
+                        time.sleep(0.05)
+                    continue
+                # liveness runs on the poll cadence even while OTHER
+                # workers keep producing: a dead worker next to a healthy
+                # one would otherwise never be detected (every sweep
+                # would short-circuit on got_any) and its shard slice
+                # would silently vanish from the stream
+                last_check = now
+                for wid in [i for i in range(n)
+                            if not procs[i].is_alive()]:
+                    restarts[wid] += 1
+                    with self._lock:
+                        self._lost += 1
+                    self._journal(
+                        "data_worker_lost", worker=int(wid),
+                        attempt=int(restarts[wid]),
+                        error="worker process died (OOM-killed or "
+                              "crashed)",
+                        service=self.name)
+                    if restarts[wid] > self.worker_restarts:
+                        raise DataServiceError(
+                            f"data service worker {wid} died "
+                            f"{restarts[wid]}x; restart budget "
+                            f"({self.worker_restarts}) spent")
+                    # fresh queue, dead one abandoned (see docstring)
+                    procs[wid], queues[wid] = spawn(
+                        wid, skip=delivered[wid], respawn=True,
+                        start_epoch=epochs[wid])
+                    with self._lock:
+                        self._recovered += 1
+                    self._journal(
+                        "data_worker_recovered", worker=int(wid),
+                        attempt=int(restarts[wid]), service=self.name)
+        finally:
+            stop.set()
+            # drain the live queues so workers blocked in put() observe
+            # the stop (dead workers' queues stay untouched — poisoned
+            # locks must not be re-acquired from here)
+            for i, q in enumerate(queues):
+                if procs[i] is not None and procs[i].is_alive():
+                    try:
+                        while True:
+                            q.get_nowait()
+                    except (queue.Empty, EOFError, OSError):
+                        pass
+            for p in procs:
+                if p is None:
+                    continue
+                p.join(timeout=5)
+                if p.is_alive():
+                    p.terminate()
+
+    def _pump_loop(self) -> None:
+        """samples -> global shuffle -> collate -> encode -> batch queue."""
+        try:
+            samples: Iterator[dict] = self._worker_stream()
+            if self.shuffle:
+                samples = _buffer_shuffle(
+                    samples, self.shuffle_buffer,
+                    np.random.default_rng(self.seed))
+            buf: List[dict] = []
+            for s in samples:
+                if self._stop.is_set():
+                    return
+                buf.append(s)
+                if len(buf) < self.batch_size:
+                    continue
+                payload = encode_batch(self.collate_fn(buf))
+                buf = []
+                while not self._stop.is_set():
+                    try:
+                        self._batches.put(payload, timeout=0.25)
+                        with self._lock:
+                            self._produced += 1
+                        break
+                    except queue.Full:
+                        continue
+            # stream tail (< batch_size rows): dropped — every served
+            # batch keeps the one compiled shape
+        except BaseException as e:  # noqa: BLE001 - latched for clients
+            with self._lock:
+                self._failed = f"{type(e).__name__}: {e}"
+            self._journal("note", note="data_service pump failed",
+                          error=self._failed, service=self.name)
+
+    # -- consumer side -----------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _addr = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return  # listener closed by close()
+            with self._lock:
+                self._clients.append(conn)
+            t = threading.Thread(target=self._serve_client, args=(conn,),
+                                 name="data-service-client", daemon=True)
+            t.start()
+            # handlers are tracked separately from the pump/accept threads
+            # and pruned as they finish: a reconnect-heavy client churns
+            # one handler per connection, and an ever-growing list would
+            # leak for the service's lifetime
+            with self._lock:
+                self._handlers.append(t)
+                self._handlers = [h for h in self._handlers
+                                  if h.is_alive()]
+
+    def _serve_client(self, conn: socket.socket) -> None:
+        try:
+            while not self._stop.is_set():
+                try:
+                    req = recv_frame(conn)
+                except (OSError, IOError):
+                    return  # client died mid-request; it will reconnect
+                if req is None:
+                    return  # clean client close
+                kind = decode_example(req).get("__kind__", [b""])[0]
+                if kind == b"stats":
+                    with self._lock:
+                        served = self._served
+                    send_frame(conn, _control(
+                        "stats", served=[served],
+                        depth=[self._batches.qsize()]))
+                    continue
+                if kind != b"get":
+                    send_frame(conn, _control(
+                        "err", error=f"unknown command {kind!r}"))
+                    continue
+                payload = self._pop_batch()
+                if payload is None:
+                    with self._lock:
+                        failed = self._failed
+                    send_frame(conn, _control(
+                        "err", error=failed or "service stopping"))
+                    return
+                send_frame(conn, payload)
+                self._c_batches.inc()
+                with self._lock:
+                    self._served += 1
+        except (OSError, IOError):
+            # a frame-boundary failure (incl. the injected io_error) is
+            # request-scoped: THIS connection dies, the client reconnects,
+            # every other client keeps streaming
+            return
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+            with self._lock:
+                if conn in self._clients:
+                    self._clients.remove(conn)
+
+    def _pop_batch(self) -> Optional[bytes]:
+        depth = self._batches.qsize()
+        self._g_depth.set(depth)
+        if depth == 0:
+            self._c_starved.inc()  # consumer out-ran the pipeline
+        while not self._stop.is_set():
+            with self._lock:
+                if self._failed:
+                    return None
+            try:
+                return self._batches.get(timeout=0.25)
+            except queue.Empty:
+                continue
+        return None
+
+
+# -- the client ----------------------------------------------------------------
+
+class DataServiceClient:
+    """Iterable consumer of a DataService: `batches(n)` yields n decoded
+    batch dicts, reconnecting through a `resilience.RetryPolicy` when the
+    connection drops (server restart, injected `data.service` fault)."""
+
+    def __init__(self, address: str, name: str = "client",
+                 journal=None, registry=None,
+                 retry: Optional[RetryPolicy] = None,
+                 timeout_s: float = 60.0):
+        host, _, port = address.rpartition(":")
+        self._addr = (host or "127.0.0.1", int(port))
+        self.name = name
+        self.journal = journal
+        self.timeout_s = timeout_s
+        self._retry = retry or RetryPolicy(
+            name="data.service", max_attempts=5, base_delay_s=0.05,
+            max_delay_s=1.0, journal=journal)
+        self._sock: Optional[socket.socket] = None
+        self.batches_received = 0
+        self.reconnects = 0
+        if registry is None:
+            from deep_vision_tpu.obs.registry import get_registry
+
+            registry = get_registry()
+        labels = {"service": name}
+        self._c_batches = registry.counter(
+            "data_service_batches_total", "batches served to clients",
+            labels=dict(labels, role="client"))
+        self._c_reconnects = registry.counter(
+            "data_service_reconnects_total",
+            "client reconnects after a dropped service connection",
+            labels=labels)
+
+    def _connect(self) -> socket.socket:
+        if self._sock is None:
+            self._sock = socket.create_connection(
+                self._addr, timeout=self.timeout_s)
+        return self._sock
+
+    def _drop(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    def get(self) -> dict:
+        """One batch; reconnects under the retry policy. DataServiceError
+        (a server-side terminal failure) is NOT retried — the service
+        itself said it cannot continue."""
+        out: List[dict] = []
+        tries = 0
+        for attempt in self._retry.attempts():
+            with attempt:
+                tries += 1
+                if tries > 1:
+                    # the previous attempt dropped the connection: this
+                    # one is a reconnect, the metric the smoke asserts
+                    self.reconnects += 1
+                    self._c_reconnects.inc()
+                sock = self._connect()
+                try:
+                    send_frame(sock, _control("get"))
+                    payload = recv_frame(sock)
+                except (OSError, IOError) as e:
+                    self._drop()
+                    raise OSError(f"data.service connection lost: {e}")
+                if payload is None:
+                    self._drop()
+                    raise OSError("data.service closed the connection")
+                out.append(decode_batch(payload))  # DataServiceError: no retry
+        if not out:
+            raise OSError("data.service retry loop yielded no batch")
+        self.batches_received += 1
+        self._c_batches.inc()
+        return out[0]
+
+    def batches(self, n: int) -> Iterator[dict]:
+        """A client-side epoch: exactly n fixed-shape batches."""
+        for _ in range(n):
+            yield self.get()
+
+    def close(self) -> None:
+        """Idempotent: registered as a journal closer AND called on the
+        clean path — the summary event must land exactly once."""
+        if getattr(self, "_closed", False):
+            return
+        self._closed = True
+        self._drop()
+        if self.journal is not None:
+            self.journal.write(
+                "data_service", role="client", service=self.name,
+                batches=int(self.batches_received),
+                reconnects=int(self.reconnects))

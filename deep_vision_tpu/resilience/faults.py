@@ -65,6 +65,11 @@ POINTS = (
                       # batch dispatch + respawn) and the swap-restore step
                       # (serve/swap.py): io_error = replica death / failed
                       # swap load, crash = the whole serving process dies
+    "data.service",   # the dataset service's frame boundary (data/service.py
+                      # send/recv: io_error = dropped client connection the
+                      # RetryPolicy must absorb) and its worker body
+                      # (env-inherited: crash = a worker process SIGKILLed,
+                      # the data_worker_lost/respawn path)
 )
 KINDS = ("io_error", "crash", "crash_after_write", "corrupt")
 

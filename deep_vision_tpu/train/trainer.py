@@ -94,6 +94,7 @@ class Trainer:
         multistep: int = 1,  # optimizer steps per dispatch (lax.scan)
         device_prefetch: int = 0,  # device-resident batch buffer depth
         backend_supervisor=None,  # resilience.BackendSupervisor or None
+        data_loader=None,  # snapshot-capable DataLoader (data/snapshot.py)
     ):
         self.mesh = mesh if mesh is not None else create_mesh()
         self.model = model  # single source of truth for summaries/export
@@ -151,6 +152,20 @@ class Trainer:
         # rebuild the jitted step from host-side seeds + checkpoint, replay
         # from the last completed step. The host-side ingredients of that
         # rebuild are kept here; everything device-resident is derived.
+        # input-pipeline checkpointing (data/snapshot.py): with a
+        # snapshot-capable train DataLoader attached, every checkpoint's
+        # host sidecar carries the loader's DataLoaderState and resume()
+        # re-arms it — the batch stream continues byte-identically instead
+        # of restarting from shard zero while the step counter says
+        # otherwise. With --device-prefetch N, a MID-epoch snapshot counts
+        # batches already handed to the prefetcher as consumed (up to N in
+        # flight); epoch-boundary saves (the fit() cadence) are exact.
+        self.data_loader = data_loader
+        if data_loader is not None and hasattr(data_loader,
+                                               "enable_snapshots"):
+            # arm per-batch recording BEFORE the first epoch runs so
+            # mid-epoch (preempt) saves capture an exact position
+            data_loader.enable_snapshots()
         self.backend = backend_supervisor
         if self.backend is not None and self.backend.journal is None:
             self.backend.journal = journal
@@ -659,6 +674,10 @@ class Trainer:
             }
             if self.plateau is not None:
                 host_state["plateau"] = self.plateau.state_dict()
+            if self.data_loader is not None:
+                # the input pipeline is a checkpoint citizen: its state
+                # rides the same crc32c sidecar as the plateau/loggers
+                host_state["data_state"] = self.data_loader.state_dict()
             saved = self.ckpt.save(
                 int(self.state.step), self.state, host_state=host_state,
                 metrics=val_summary,
@@ -1025,9 +1044,33 @@ class Trainer:
                 self.ema = EmaParams(self.state.params, decay=self.ema.decay,
                                      warmup=self.ema.warmup)
         if not host_state:
+            self._resume_data_state(None)
             return 0
         self.logger.load_state_dict(host_state.get("train_logger", {}))
         self.eval_logger.load_state_dict(host_state.get("val_logger", {}))
         if self.plateau is not None and "plateau" in host_state:
             self.plateau.load_state_dict(host_state["plateau"])
+        self._resume_data_state(host_state.get("data_state"))
         return int(host_state.get("epoch", -1)) + 1
+
+    def _resume_data_state(self, data_state) -> None:
+        """Re-arm the input pipeline from the sidecar's DataLoaderState
+        and journal the typed `data_resume` verdict: 'restored' = the
+        loader will replay its exact position (byte-identical stream),
+        'fresh' = the checkpoint predates --data-snapshot (or carried no
+        loader state) and the stream restarts at epoch 0 — honest, and
+        visible in obs_report instead of silent. A SnapshotMismatch
+        (dataset changed on disk) propagates: resuming on a shifted
+        stream is corruption, not degradation."""
+        if self.data_loader is None:
+            return
+        if data_state:
+            info = self.data_loader.load_state_dict(data_state)
+            if self.journal is not None:
+                self.journal.write(
+                    "data_resume", verdict="restored",
+                    epoch=int(info["epoch"]), batches=int(info["batches"]),
+                    shard=info.get("shard"), record=info.get("record"))
+        elif self.journal is not None:
+            self.journal.write("data_resume", verdict="fresh",
+                               epoch=0, batches=0)

@@ -328,7 +328,9 @@ def build_trainer(cfg: ExperimentConfig, train_fn, ckpt_dir: Optional[str],
                   multistep: int = 1,
                   device_prefetch: int = 0,
                   opt_state_dtype: Optional[str] = None,
-                  backend_supervisor=None):
+                  backend_supervisor=None,
+                  data_loader=None,
+                  steps_per_epoch: Optional[int] = None):
     import functools
 
     import jax.numpy as jnp
@@ -344,7 +346,11 @@ def build_trainer(cfg: ExperimentConfig, train_fn, ckpt_dir: Optional[str],
     from deep_vision_tpu.train import Trainer, build_optimizer
     from deep_vision_tpu.train.optimizers import ReduceLROnPlateau
 
-    steps = _steps_per_epoch(cfg, train_fn)
+    # a --data-service stream has no len(): the caller passes its epoch
+    # window so LR schedules are built for the steps that actually run
+    # (the streaming fallback of 1000 would stretch a cosine ~16x)
+    steps = (steps_per_epoch if steps_per_epoch is not None
+             else _steps_per_epoch(cfg, train_fn))
     opt_kw = dict(cfg.optimizer)
     name = opt_kw.pop("name")
     opt_kw.pop("learning_rate")
@@ -407,6 +413,7 @@ def build_trainer(cfg: ExperimentConfig, train_fn, ckpt_dir: Optional[str],
         health=health, autoprof=autoprof,
         multistep=multistep, device_prefetch=device_prefetch,
         backend_supervisor=backend_supervisor,
+        data_loader=data_loader,
     )
 
 
@@ -806,6 +813,27 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--fault-seed", type=int, default=0,
                         help="seed for probabilistic fault rules (same seed "
                              "= same fault sequence)")
+    parser.add_argument("--data-snapshot", action="store_true",
+                        help="checkpoint the input pipeline with the model "
+                             "(data/snapshot.py): every save's host sidecar "
+                             "carries the train DataLoader's position "
+                             "(epoch, batches, shard cursor, bad-record-"
+                             "budget spend) and resume replays a byte-"
+                             "identical batch stream instead of restarting "
+                             "from shard zero (typed data_resume event; "
+                             "requires a real dataset, --num-procs 0)")
+    parser.add_argument("--data-service", default=None, metavar="HOST:PORT",
+                        help="consume training batches from a shared "
+                             "dataset service (data/service.py; run one "
+                             "with tools/data_service.py) instead of a "
+                             "local pipeline — decode/augment leave this "
+                             "process, several trainers/evals share one "
+                             "stream, reconnects ride the retry policy")
+    parser.add_argument("--data-service-steps", type=int, default=64,
+                        metavar="N",
+                        help="batches per epoch window when consuming "
+                             "--data-service (the service stream is "
+                             "continuous; epochs are client-side)")
     parser.add_argument("--bad-record-budget", default=None, metavar="N|FRAC",
                         help="skip corrupt/undecodable records instead of "
                              "crashing, up to this many (>=1) or this "
@@ -927,17 +955,43 @@ def main(argv: Optional[List[str]] = None) -> int:
                 default_ckpt, "dead_letter.jsonl"),
         )
 
-    train_fn, eval_fn = build_dataloaders(
-        cfg, args.data_dir, args.fake_data, args.fake_batches, args.num_workers,
-        preprocessing=args.preprocessing, num_procs=args.num_procs,
-        bad_record_budget=budget,
-    )
+    if args.data_service:
+        # the trainer consumes the shared service — local data is only
+        # needed for the eval split, so its absence must not kill the
+        # run (the documented service-consumer invocation passes no
+        # --data-dir at all); train_fn is replaced by the service
+        # client below either way
+        try:
+            train_fn, eval_fn = build_dataloaders(
+                cfg, args.data_dir, args.fake_data, args.fake_batches,
+                args.num_workers, preprocessing=args.preprocessing,
+                num_procs=args.num_procs, bad_record_budget=budget,
+            )
+        except (FileNotFoundError, OSError) as e:
+            print(f"--data-service: no local eval dataset ({e}); "
+                  "training without an eval split")
+            train_fn, eval_fn = (lambda: []), None
+        if args.eval_only and eval_fn is None:
+            parser.error("--eval-only needs a local eval dataset, which "
+                         "--data-service could not find")
+    else:
+        train_fn, eval_fn = build_dataloaders(
+            cfg, args.data_dir, args.fake_data, args.fake_batches,
+            args.num_workers, preprocessing=args.preprocessing,
+            num_procs=args.num_procs, bad_record_budget=budget,
+        )
 
     if cfg.task in ("dcgan", "cyclegan"):
         if args.eval_only:
             parser.error(
                 f"--eval-only is not supported for GAN task {cfg.task!r} "
                 "(no scalar quality metric; use the sample grids instead)"
+            )
+        if args.data_service or args.data_snapshot:
+            parser.error(
+                "--data-service/--data-snapshot ride the standard Trainer "
+                f"checkpoint/resume path; GAN task {cfg.task!r} has its own "
+                "loop without them"
             )
         import jax as _jax
 
@@ -1090,6 +1144,37 @@ def main(argv: Optional[List[str]] = None) -> int:
     autoprof = _make_autoprof(
         args, journal, ckpt_dir,
         window=_parse_profile_window(parser, args.profile_window))
+    # -- the data plane's two new modes (data/service.py, data/snapshot.py)
+    if args.data_snapshot and args.data_service:
+        # refuse BEFORE any client/loader is built: a constructed client
+        # would register a journal closer and stamp a phantom
+        # data_service summary into a run that never happened
+        parser.error(
+            "--data-snapshot checkpoints the LOCAL pipeline; a "
+            "--data-service stream is shared across consumers and "
+            "snapshots nothing (its resume story is the trainer's "
+            "step checkpoint + the service's own restart)")
+    data_client = None
+    if args.data_service:
+        from deep_vision_tpu.data.service import DataServiceClient
+
+        data_client = DataServiceClient(args.data_service, name=cfg.name,
+                                        journal=journal)
+        svc_steps = args.data_service_steps
+        train_fn = lambda: data_client.batches(svc_steps)  # noqa: E731
+        if journal is not None:
+            # closer covers abnormal unwinds; the clean path closes below
+            journal.add_closer(data_client.close)
+    data_loader = None
+    if args.data_snapshot:
+        cand = train_fn()
+        if (hasattr(cand, "snapshot_supported")
+                and cand.snapshot_supported()):
+            data_loader = cand
+        else:
+            parser.error(
+                "--data-snapshot needs a snapshot-capable DataLoader: a "
+                "real dataset (not --fake-data) with --num-procs 0")
     supervisor = None
     if args.backend_retries > 0:
         from deep_vision_tpu.resilience.elastic import BackendSupervisor
@@ -1108,7 +1193,10 @@ def main(argv: Optional[List[str]] = None) -> int:
                             opt_state_dtype=(
                                 None if args.opt_state_dtype == "float32"
                                 else args.opt_state_dtype),
-                            backend_supervisor=supervisor)
+                            backend_supervisor=supervisor,
+                            data_loader=data_loader,
+                            steps_per_epoch=(args.data_service_steps
+                                             if args.data_service else None))
     if journal is not None:
         # an unwinding run (exception/SIGTERM) still stops an in-flight
         # profiler trace and flushes writers via the atexit crash path
@@ -1146,6 +1234,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         eval_first=args.eval_first,
     )
     trainer.close()
+    if data_client is not None:
+        data_client.close()  # idempotent: the journal closer may re-run it
     _maybe_upload(args, ckpt_dir)
     _finish_obs(args, journal, tracer=tracer, health=health,
                 autoprof=autoprof, flight=flight)

@@ -1,0 +1,594 @@
+"""The production data plane: iterator snapshots + the shared dataset service.
+
+Covers data/snapshot.py (DataLoaderState save/restore determinism — the
+byte-identical-stream contract behind `make data-smoke` and the chaos
+deterministic-resume phase), the satellite epoch-derivation fix (a
+resumed loader replays the same shard order instead of restarting its
+private epoch counter at zero), the bad-record-budget carryover, and
+data/service.py (frame codec, client/server round-trip, worker-death
+supervision, client reconnect, per-host shard assignment).
+"""
+import hashlib
+import json
+import os
+import socket
+import struct
+import threading
+
+import numpy as np
+import pytest
+
+
+# -- fixtures: tiny record shards (module-level fns stay spawn-picklable) -----
+
+def _smoke_schema(feats):
+    raw = np.frombuffer(feats["image/raw"][0], np.uint8)
+    side = int(np.sqrt(raw.size))  # 4x4 fixtures; 32x32 for real models
+    return {
+        "image": raw.reshape(side, side, 1),
+        "label": np.int32(feats["image/class/label"][0]),
+    }
+
+
+def _to_float(sample, rng):
+    return {"image": sample["image"].astype(np.float32) / 255.0,
+            "label": sample["label"]}
+
+
+def _write_shards(tmp_path, n_shards=3, per_shard=20, corrupt_at=(),
+                  side=4):
+    from deep_vision_tpu.data.example_codec import encode_example
+    from deep_vision_tpu.data.records import write_records
+
+    rng = np.random.RandomState(0)
+    for s in range(n_shards):
+        write_records(
+            str(tmp_path / f"train-{s:03d}"),
+            [encode_example({
+                "image/raw": [rng.randint(0, 256, size=(side, side, 1),
+                                          dtype=np.uint8).tobytes()],
+                "image/class/label": [i % 10],
+            }) for i in range(per_shard)],
+        )
+    for path, offset in corrupt_at:
+        p = str(tmp_path / path)
+        data = bytearray(open(p, "rb").read())
+        data[offset] ^= 0xFF  # flip a data byte: CRC catches, budget skips
+        open(p, "wb").write(bytes(data))
+    return str(tmp_path / "train-*")
+
+
+def _loader(pattern, budget=None, **kw):
+    from deep_vision_tpu.data.datasets import RecordDataset
+    from deep_vision_tpu.data.pipeline import DataLoader
+
+    ds = RecordDataset(pattern, _smoke_schema, shuffle_shards=True, seed=3,
+                       bad_record_budget=budget)
+    args = dict(batch_size=8, transform=_to_float, shuffle=True,
+                shuffle_buffer=16, num_workers=2, drop_remainder=True,
+                seed=5, prefetch=2, name="t")
+    args.update(kw)
+    dl = DataLoader(ds, **args)
+    if dl.snapshot_supported():
+        dl.enable_snapshots()  # what Trainer does for its data_loader
+    return dl
+
+
+def _hashes(batches):
+    out = []
+    for b in batches:
+        h = hashlib.sha1()
+        for k in sorted(b):
+            h.update(np.ascontiguousarray(b[k]).tobytes())
+        out.append(h.hexdigest())
+    return out
+
+
+# -- snapshot: save/restore determinism ---------------------------------------
+
+class TestSnapshot:
+    def test_mid_epoch_restore_byte_identical(self, tmp_path):
+        pattern = _write_shards(tmp_path)
+        ref = _loader(pattern)
+        epochs = [_hashes(ref) for _ in range(3)]
+
+        b = _loader(pattern)
+        assert _hashes(b) == epochs[0]
+        it = iter(b)
+        prefix = _hashes([next(it) for _ in range(3)])
+        state = b.state_dict()
+        assert state["epoch"] == 1 and state["batches"] == 3
+        del it
+
+        c = _loader(pattern)
+        info = c.load_state_dict(state)
+        assert info["epoch"] == 1 and info["batches"] == 3
+        assert prefix + _hashes(c) == epochs[1]
+        assert _hashes(c) == epochs[2]  # and the NEXT epoch stays aligned
+
+    def test_boundary_restore_continues_next_epoch(self, tmp_path):
+        pattern = _write_shards(tmp_path)
+        ref = _loader(pattern)
+        e0, e1 = _hashes(ref), _hashes(ref)
+
+        a = _loader(pattern)
+        assert _hashes(a) == e0
+        state = a.state_dict()  # epoch boundary: resume = next epoch clean
+        assert state["epoch"] == 1 and state["batches"] == 0
+        c = _loader(pattern)
+        c.load_state_dict(state)
+        assert _hashes(c) == e1
+
+    def test_mid_shard_cursor_reported(self, tmp_path):
+        pattern = _write_shards(tmp_path)
+        a = _loader(pattern, prefetch=0, shuffle=False, shuffle_buffer=0)
+        it = iter(a)
+        [next(it) for _ in range(3)]  # 24 samples: into shard 2 of 3x20
+        state = a.state_dict()
+        cur = state["cursor"]
+        assert cur is not None and cur["shard"] in a.dataset.files
+        assert cur["read"] >= 3 * 8  # the frontier covers what was consumed
+        assert cur["record"] >= 0 and cur["shard_index"] >= 1
+        del it
+        # and the mid-shard position restores byte-identically
+        ref = _loader(pattern, prefetch=0, shuffle=False, shuffle_buffer=0)
+        full = _hashes(ref)
+        c = _loader(pattern, prefetch=0, shuffle=False, shuffle_buffer=0)
+        c.load_state_dict(state)
+        assert _hashes(c) == full[3:]
+
+    def test_epoch_rng_derived_not_process_local(self, tmp_path):
+        """Satellite regression: a FRESH process (fresh loader) armed at
+        epoch N must replay epoch N's shard order — the old code derived
+        it from a private per-process iteration counter that silently
+        restarted at 0 after a kill/resume."""
+        pattern = _write_shards(tmp_path)
+        ref = _loader(pattern)
+        _, e1 = _hashes(ref), _hashes(ref)
+
+        fresh = _loader(pattern)  # new process, counter at 0
+        fresh.load_state_dict(
+            {"version": 1, "epoch": 1, "batches": 0,
+             "epoch_seed": fresh.seed + 1,
+             "fingerprint": fresh._fingerprint()})
+        assert _hashes(fresh) == e1
+
+    def test_budget_spend_carryover(self, tmp_path):
+        from deep_vision_tpu.data.records import BadRecordBudget
+
+        pattern = _write_shards(tmp_path,
+                                corrupt_at=[("train-000", 150),
+                                            ("train-001", 300)])
+        ref_budget = BadRecordBudget(max_count=50)
+        ref = _loader(pattern, budget=ref_budget)
+        e0, e1 = _hashes(ref), _hashes(ref)
+        want = ref_budget.spend()
+        assert want["bad"] > 0  # the corruption is actually exercised
+
+        b_budget = BadRecordBudget(max_count=50)
+        b = _loader(pattern, budget=b_budget)
+        it = iter(b)
+        prefix = _hashes([next(it) for _ in range(2)])
+        state = b.state_dict()
+        assert state["budget"]["bad"] >= 0
+        del it
+
+        c_budget = BadRecordBudget(max_count=50)
+        c = _loader(pattern, budget=c_budget)
+        c.load_state_dict(state)
+        rest0, rest1 = _hashes(c), _hashes(c)
+        assert prefix + rest0 == e0 and rest1 == e1
+        # the resumed run's total spend equals the uninterrupted run's
+        assert c_budget.spend() == want
+
+    def test_fingerprint_mismatch_refuses(self, tmp_path):
+        import deep_vision_tpu.data.snapshot as snap
+
+        pattern = _write_shards(tmp_path)
+        a = _loader(pattern)
+        state = a.state_dict()
+        other = tmp_path / "other"
+        other.mkdir()
+        pattern2 = _write_shards(other, n_shards=2)
+        b = _loader(pattern2)
+        with pytest.raises(snap.SnapshotMismatch):
+            b.load_state_dict(state)
+
+    def test_fingerprint_covers_loader_shape(self, tmp_path):
+        """shuffle/shuffle_buffer/drop_remainder change the post-shuffle
+        order `skip` counts in — a snapshot must refuse across them."""
+        import deep_vision_tpu.data.snapshot as snap
+
+        pattern = _write_shards(tmp_path)
+        a = _loader(pattern)
+        state = a.state_dict()
+        for changed in (_loader(pattern, shuffle_buffer=64),
+                        _loader(pattern, shuffle=False),
+                        _loader(pattern, drop_remainder=False)):
+            with pytest.raises(snap.SnapshotMismatch):
+                changed.load_state_dict(state)
+
+    def test_state_dict_refuses_unarmed_mid_epoch(self, tmp_path):
+        """Iterating before enable_snapshots() must not fabricate a
+        position — the loud-refusal half of the ring contract."""
+        import deep_vision_tpu.data.snapshot as snap
+        from deep_vision_tpu.data.datasets import RecordDataset
+        from deep_vision_tpu.data.pipeline import DataLoader
+
+        pattern = _write_shards(tmp_path)
+        dl = DataLoader(RecordDataset(pattern, _smoke_schema, seed=3), 8,
+                        shuffle=True, shuffle_buffer=16,
+                        drop_remainder=True, seed=5)
+        it = iter(dl)
+        next(it)
+        with pytest.raises(snap.SnapshotError):
+            dl.state_dict()
+        del it
+
+    def test_num_procs_refuses(self, tmp_path):
+        import deep_vision_tpu.data.snapshot as snap
+
+        pattern = _write_shards(tmp_path)
+        dl = _loader(pattern, num_procs=2)
+        with pytest.raises(snap.SnapshotUnsupported):
+            dl.state_dict()
+        with pytest.raises(snap.SnapshotUnsupported):
+            dl.load_state_dict({"epoch": 0, "batches": 0})
+
+    def test_state_validates(self):
+        import deep_vision_tpu.data.snapshot as snap
+
+        with pytest.raises(snap.SnapshotMismatch):
+            snap.validate_state({"epoch": 0, "batches": 0,
+                                 "epoch_seed": 0, "fingerprint": "",
+                                 "version": 99})
+        with pytest.raises(snap.SnapshotMismatch):
+            snap.validate_state({"epoch": -1, "batches": 0,
+                                 "epoch_seed": 0, "fingerprint": ""})
+
+
+# -- trainer integration: the sidecar carries the loader ----------------------
+
+class TestTrainerIntegration:
+    def _trainer(self, loader, ckpt_dir, journal=None):
+        import jax.numpy as jnp
+
+        from deep_vision_tpu.core import CheckpointManager
+        from deep_vision_tpu.losses import classification_loss_fn
+        from deep_vision_tpu.models import get_model
+        from deep_vision_tpu.train import Trainer, build_optimizer
+
+        return Trainer(
+            get_model("lenet5", num_classes=10),
+            build_optimizer("sgd", 0.05),
+            classification_loss_fn,
+            sample_input=jnp.zeros((8, 32, 32, 1)),
+            checkpoint_manager=CheckpointManager(str(ckpt_dir),
+                                                 journal=journal),
+            journal=journal, data_loader=loader,
+        )
+
+    def test_checkpoint_carries_data_state_and_resume_journals(
+            self, tmp_path):
+        from deep_vision_tpu.obs import RunJournal
+
+        pattern = _write_shards(tmp_path, side=32)
+        jpath = str(tmp_path / "run.jsonl")
+        journal = RunJournal(jpath)
+        loader = _loader(pattern)
+        tr = self._trainer(loader, tmp_path / "ckpt", journal)
+        tr.fit(lambda: loader, None, epochs=1)
+        tr.close()
+
+        # a fresh "process": new loader, new trainer, resume
+        loader2 = _loader(pattern)
+        tr2 = self._trainer(loader2, tmp_path / "ckpt", journal)
+        start = tr2.resume()
+        assert start == 1
+        # the loader was re-armed at the checkpointed position
+        assert loader2._epoch == 1 and loader2._resume is not None
+        tr2.close()
+        journal.close()
+        events = [json.loads(ln) for ln in open(jpath) if ln.strip()]
+        resumes = [e for e in events if e["event"] == "data_resume"]
+        assert len(resumes) == 1
+        assert resumes[0]["verdict"] == "restored"
+        assert resumes[0]["epoch"] == 1 and resumes[0]["batches"] == 0
+
+    def test_resume_without_data_state_is_fresh(self, tmp_path):
+        from deep_vision_tpu.obs import RunJournal
+
+        pattern = _write_shards(tmp_path, side=32)
+        jpath = str(tmp_path / "run.jsonl")
+        journal = RunJournal(jpath)
+        loader = _loader(pattern)
+        # checkpoint written WITHOUT a data_loader attached (pre-PR12 run)
+        tr = self._trainer(None, tmp_path / "ckpt", journal)
+        tr.fit(lambda: loader, None, epochs=1)
+        tr.close()
+        loader2 = _loader(pattern)
+        tr2 = self._trainer(loader2, tmp_path / "ckpt", journal)
+        tr2.resume()
+        tr2.close()
+        journal.close()
+        events = [json.loads(ln) for ln in open(jpath) if ln.strip()]
+        resumes = [e for e in events if e["event"] == "data_resume"]
+        assert len(resumes) == 1 and resumes[0]["verdict"] == "fresh"
+
+
+# -- service: framing + codec -------------------------------------------------
+
+class TestServiceCodec:
+    def test_batch_roundtrip(self):
+        from deep_vision_tpu.data.service import decode_batch, encode_batch
+
+        batch = {"image": np.random.RandomState(0).rand(4, 8, 8, 3)
+                 .astype(np.float32),
+                 "label": np.arange(4, dtype=np.int32)}
+        out = decode_batch(encode_batch(batch))
+        assert set(out) == set(batch)
+        for k in batch:
+            assert out[k].dtype == batch[k].dtype
+            assert np.array_equal(out[k], batch[k])
+
+    def test_frame_roundtrip_and_corruption(self):
+        from deep_vision_tpu.data.service import recv_frame, send_frame
+
+        a, b = socket.socketpair()
+        try:
+            send_frame(a, b"hello world")
+            assert recv_frame(b) == b"hello world"
+            # corrupt payload: flip a byte behind a valid header
+            payload = b"x" * 32
+            header = struct.pack("<Q", len(payload))
+            from deep_vision_tpu.data.records import _masked_crc
+
+            a.sendall(header + struct.pack("<I", _masked_crc(header))
+                      + b"y" + payload[1:]
+                      + struct.pack("<I", _masked_crc(payload)))
+            with pytest.raises(IOError):
+                recv_frame(b)
+        finally:
+            a.close()
+            b.close()
+
+    def test_clean_eof_is_none(self):
+        from deep_vision_tpu.data.service import recv_frame
+
+        a, b = socket.socketpair()
+        a.close()
+        try:
+            assert recv_frame(b) is None
+        finally:
+            b.close()
+
+
+# -- service: per-host shard assignment ---------------------------------------
+
+class TestShardForHost:
+    def test_disjoint_and_covering(self):
+        from deep_vision_tpu.data.service import shard_for_host
+
+        files = [f"s{i:03d}" for i in range(17)]
+        for num_hosts in (1, 2, 4, 5):
+            parts = [shard_for_host(h, num_hosts, files)
+                     for h in range(num_hosts)]
+            flat = [f for p in parts for f in p]
+            assert sorted(flat) == sorted(files)  # covering
+            assert len(flat) == len(set(flat))    # disjoint
+
+    def test_index_form_feeds_record_dataset(self, tmp_path):
+        from deep_vision_tpu.data.datasets import RecordDataset
+        from deep_vision_tpu.data.service import shard_for_host
+
+        pattern = _write_shards(tmp_path, n_shards=4)
+        full = RecordDataset(pattern, _smoke_schema)
+        seen = []
+        for h in range(2):
+            si, ns = shard_for_host(h, 2)
+            part = RecordDataset(pattern, _smoke_schema,
+                                 shard_index=si, num_shards=ns)
+            seen.extend(part.files)
+        assert sorted(seen) == sorted(full.files)
+
+    def test_rejects_bad_ids(self):
+        from deep_vision_tpu.data.service import shard_for_host
+
+        with pytest.raises(ValueError):
+            shard_for_host(2, 2)
+        with pytest.raises(ValueError):
+            shard_for_host(0, 0)
+
+
+# -- service: live client/server ----------------------------------------------
+
+class TestServiceLive:
+    def _service(self, pattern, journal=None, registry=None, **kw):
+        from deep_vision_tpu.data.datasets import RecordDataset
+        from deep_vision_tpu.data.service import DataService
+
+        ds = RecordDataset(pattern, _smoke_schema, shuffle_shards=True,
+                           seed=3)
+        args = dict(batch_size=8, num_workers=1, shuffle_buffer=16,
+                    seed=7, queue_depth=8, worker_poll_s=0.3,
+                    journal=journal, registry=registry)
+        args.update(kw)
+        return DataService(ds, **args)
+
+    def test_round_trip_two_clients_fixed_shapes(self, tmp_path):
+        from deep_vision_tpu.data.service import DataServiceClient
+        from deep_vision_tpu.obs.registry import Registry
+
+        pattern = _write_shards(tmp_path)
+        reg = Registry()
+        svc = self._service(pattern, registry=reg).start()
+        try:
+            c1 = DataServiceClient(svc.address, name="c1", registry=reg)
+            c2 = DataServiceClient(svc.address, name="c2", registry=reg)
+            got1, got2 = [], []
+            t = threading.Thread(
+                target=lambda: got2.extend(c2.batches(3)), daemon=True)
+            t.start()
+            got1.extend(c1.batches(3))
+            t.join(timeout=60)
+            assert not t.is_alive()
+            for b in got1 + got2:
+                assert b["image"].shape == (8, 4, 4, 1)
+                assert b["label"].shape == (8,)
+            # one shared stream: the two consumers' batches are disjoint
+            assert not (set(_hashes(got1)) & set(_hashes(got2)))
+            c1.close()
+            c2.close()
+        finally:
+            svc.close()
+
+    def test_worker_death_absorbed_and_journaled(self, tmp_path):
+        from deep_vision_tpu.data.service import DataServiceClient
+        from deep_vision_tpu.obs import RunJournal
+        from deep_vision_tpu.obs.registry import Registry
+        from deep_vision_tpu.resilience import faults
+
+        pattern = _write_shards(tmp_path)
+        jpath = str(tmp_path / "svc.jsonl")
+        journal = RunJournal(jpath)
+        journal.manifest()
+        os.environ[faults.ENV_SPEC] = "data.service:crash@4"
+        os.environ[faults.ENV_SEED] = "0"
+        try:
+            svc = self._service(pattern, journal=journal,
+                                registry=Registry()).start()
+            c = DataServiceClient(svc.address, name="c", journal=journal,
+                                  registry=Registry())
+            got = list(c.batches(6))  # 48 samples: well past the crash
+            assert len(got) == 6
+            assert c.reconnects == 0  # absorbed server-side
+            c.close()
+            svc.close()
+        finally:
+            os.environ.pop(faults.ENV_SPEC, None)
+            os.environ.pop(faults.ENV_SEED, None)
+        journal.close()
+        events = [json.loads(ln) for ln in open(jpath) if ln.strip()]
+        lost = [e for e in events if e["event"] == "data_worker_lost"]
+        rec = [e for e in events if e["event"] == "data_worker_recovered"]
+        assert len(lost) >= 1 and len(rec) >= 1
+        assert lost[0]["worker"] == rec[0]["worker"] == 0
+        # strict schema validation accepts the whole journal
+        sys_path_root = os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))
+        import subprocess
+        import sys as _sys
+
+        rc = subprocess.run(
+            [_sys.executable,
+             os.path.join(sys_path_root, "tools", "check_journal.py"),
+             jpath, "--strict"],
+            env=dict(os.environ, PYTHONPATH=sys_path_root)).returncode
+        assert rc == 0
+
+    def test_client_reconnects_on_frame_fault(self, tmp_path):
+        from deep_vision_tpu.data.service import DataServiceClient
+        from deep_vision_tpu.obs.registry import Registry
+        from deep_vision_tpu.resilience import install_spec
+
+        pattern = _write_shards(tmp_path)
+        svc = self._service(pattern, registry=Registry()).start()
+        try:
+            c = DataServiceClient(svc.address, name="c",
+                                  registry=Registry())
+            assert c.get() is not None  # healthy first batch
+            install_spec("data.service:io_error@2", export_env=False)
+            try:
+                got = [c.get() for _ in range(3)]
+            finally:
+                install_spec(None)
+            assert len(got) == 3
+            assert c.reconnects >= 1
+            c.close()
+        finally:
+            svc.close()
+
+
+# -- schemas ------------------------------------------------------------------
+
+class TestJournalSchemas:
+    def _check(self, rows):
+        import subprocess
+        import sys as _sys
+        import tempfile
+
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        with tempfile.NamedTemporaryFile("w", suffix=".jsonl",
+                                         delete=False) as f:
+            base = {"ts": 0.0, "run_id": "t"}
+            f.write(json.dumps({"event": "run_manifest", "kind": "train",
+                                "argv": [], **base}) + "\n")
+            for r in rows:
+                f.write(json.dumps({**base, **r}) + "\n")
+            f.write(json.dumps({"event": "exit", "status": "clean_exit",
+                                **base}) + "\n")
+            path = f.name
+        try:
+            return subprocess.run(
+                [_sys.executable,
+                 os.path.join(root, "tools", "check_journal.py"),
+                 path, "--strict"],
+                env=dict(os.environ, PYTHONPATH=root),
+                stdout=subprocess.DEVNULL,
+                stderr=subprocess.DEVNULL).returncode
+        finally:
+            os.unlink(path)
+
+    def test_valid_data_plane_events_pass_strict(self):
+        assert self._check([
+            {"event": "data_resume", "verdict": "restored", "epoch": 2,
+             "batches": 3, "shard": "train-0", "record": 17},
+            {"event": "data_resume", "verdict": "fresh", "epoch": 0,
+             "batches": 0},
+            {"event": "data_worker_lost", "worker": 1, "attempt": 1,
+             "error": "died"},
+            {"event": "data_worker_recovered", "worker": 1, "attempt": 1},
+            {"event": "data_service", "role": "server", "batches": 10},
+            {"event": "data_service", "role": "client", "batches": 10,
+             "reconnects": 1},
+        ]) == 0
+
+    def test_invalid_data_plane_events_fail_strict(self):
+        assert self._check([{"event": "data_resume", "verdict": "maybe",
+                             "epoch": 0, "batches": 0}]) != 0
+        assert self._check([{"event": "data_resume", "verdict": "restored",
+                             "epoch": "two", "batches": 0}]) != 0
+        assert self._check([{"event": "data_worker_lost", "worker": "w0",
+                             "attempt": 1}]) != 0
+        assert self._check([{"event": "data_service", "role": "pump",
+                             "batches": 1}]) != 0
+        assert self._check([{"event": "data_service", "role": "server",
+                             "batches": "many"}]) != 0
+
+    def test_obs_report_renders_data_plane(self, tmp_path):
+        import subprocess
+        import sys as _sys
+
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        path = str(tmp_path / "j.jsonl")
+        base = {"ts": 0.0, "run_id": "t"}
+        with open(path, "w") as f:
+            for r in [
+                {"event": "run_manifest", "kind": "train", "argv": []},
+                {"event": "data_service", "role": "server", "batches": 42,
+                 "workers_lost": 1, "workers_recovered": 1},
+                {"event": "data_service", "role": "client", "batches": 42,
+                 "reconnects": 2},
+                {"event": "data_resume", "verdict": "restored", "epoch": 1,
+                 "batches": 4, "shard": "/x/train-0"},
+                {"event": "exit", "status": "clean_exit"},
+            ]:
+                f.write(json.dumps({**base, **r}) + "\n")
+        out = subprocess.run(
+            [_sys.executable, os.path.join(root, "tools", "obs_report.py"),
+             path],
+            env=dict(os.environ, PYTHONPATH=root),
+            stdout=subprocess.PIPE).stdout.decode()
+        assert "data service [server]" in out
+        assert "data service [client]" in out and "2 reconnect" in out
+        assert "data resume" in out and "restored" in out

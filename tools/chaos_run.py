@@ -54,6 +54,16 @@ obs/merge.py):
                   counter CONTINUING from the preempt step — losses
                   resume, they do not restart — and both journals
                   passing `check_journal --strict`.
+  8. data-resume the data plane's determinism contract
+                  (tools/data_smoke.py phase_resume_determinism, shared
+                  with `make data-smoke`): a record-backed train is
+                  SIGKILLed mid-epoch by an injected data.read crash,
+                  resumes from the crc32c sidecar's DataLoaderState, and
+                  the post-resume batch sequence must be byte-identical
+                  (content hashes) to an uninterrupted run's from the
+                  same offset, with a strict-valid typed `data_resume`
+                  event — PR 10's exact-step resume extended to the
+                  input stream.
 
 Plus overhead probes: with no spec installed an injection point is one
 module-global load + None check, flight recording (one tap call per
@@ -697,6 +707,22 @@ def main(argv: Optional[List[str]] = None) -> int:
     print("phase 7: SIGTERM under live 4-device training -> preempt "
           "checkpoint -> resume on a 2-device mesh")
     phase7_shrink_mesh(work, data_dir, f)
+
+    # -- phase 8: deterministic data resume -----------------------------
+    print("phase 8: SIGKILL mid-epoch -> sidecar resume -> byte-identical "
+          "batch stream (data/snapshot.py)")
+    import importlib
+
+    data_smoke = importlib.import_module("tools.data_smoke")
+    ds_work = os.path.join(work, "data_resume")
+    os.makedirs(ds_work, exist_ok=True)
+    ds_f = data_smoke.Failures()
+    data_smoke.phase_resume_determinism(ds_work, ds_f)
+    for err in ds_f.errors:
+        f.errors.append(f"data-resume: {err}")
+    f.check(not ds_f.errors,
+            f"deterministic-resume phase held "
+            f"({len(ds_f.errors)} broken contract(s))")
 
     # -- disabled-injection overhead ------------------------------------
     ns = probe_disabled_overhead()

@@ -68,6 +68,10 @@ EVENT_FIELDS = {
     "replica_recovered": ("replica", "attempt"),
     "lock_order_violation": ("lock_a", "lock_b", "thread"),
     "lock_contention": ("lock", "kind", "ms"),
+    "data_resume": ("verdict", "epoch", "batches"),
+    "data_worker_lost": ("worker", "attempt"),
+    "data_worker_recovered": ("worker", "attempt"),
+    "data_service": ("role", "batches"),
     "note": (),
     "exit": ("status",),
     "crash": ("reason",),
@@ -96,6 +100,11 @@ LOCK_CONTENTION_KINDS = {"hold", "wait"}
 # tests/test_elastic.py): the classifier's verdict on a lost backend
 BACKEND_LOST_KINDS = {"connection_lost", "timeout", "version_skew",
                       "unknown"}
+# data plane (data/snapshot.py + data/service.py; kept in sync by
+# tests/test_data_service.py): 'restored' = the loader replays its exact
+# checkpointed position, 'fresh' = the checkpoint carried no loader state
+DATA_RESUME_VERDICTS = {"restored", "fresh"}
+DATA_SERVICE_ROLES = {"server", "client"}
 
 
 def check_journal(path: str, require_exit: bool = False,
@@ -217,6 +226,26 @@ def check_journal(path: str, require_exit: bool = False,
                     errors.append(f"{path}:{i}: lock_order_violation {k} "
                                   f"must be a lock name, got "
                                   f"{row.get(k)!r}")
+        if ev == "data_resume":
+            if row.get("verdict") not in DATA_RESUME_VERDICTS:
+                errors.append(f"{path}:{i}: unknown data_resume verdict "
+                              f"{row.get('verdict')!r}")
+            for k in ("epoch", "batches"):
+                if not isinstance(row.get(k), int):
+                    errors.append(f"{path}:{i}: data_resume {k} must be "
+                                  f"an int, got {row.get(k)!r}")
+        if ev in ("data_worker_lost", "data_worker_recovered"):
+            for k in ("worker", "attempt"):
+                if not isinstance(row.get(k), int):
+                    errors.append(f"{path}:{i}: {ev} {k} must be an int, "
+                                  f"got {row.get(k)!r}")
+        if ev == "data_service":
+            if row.get("role") not in DATA_SERVICE_ROLES:
+                errors.append(f"{path}:{i}: unknown data_service role "
+                              f"{row.get('role')!r}")
+            if not isinstance(row.get("batches"), int):
+                errors.append(f"{path}:{i}: data_service batches must be "
+                              f"an int, got {row.get('batches')!r}")
         if ev == "backend_lost" and row.get("kind") not in BACKEND_LOST_KINDS:
             errors.append(f"{path}:{i}: unknown backend_lost kind "
                           f"{row.get('kind')!r}")

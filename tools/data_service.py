@@ -1,0 +1,113 @@
+"""Run a standalone shared dataset service (data/service.py) from the CLI.
+
+    PYTHONPATH=. python tools/data_service.py --pattern 'shards/train-*' \
+        --schema imagenet --batch-size 64 [--port 5757] [--workers 4] \
+        [--host-id 0 --num-hosts 4] [--journal svc.jsonl]
+
+Serves pre-decoded, pre-collated, fixed-shape batches over a local
+socket until SIGTERM/SIGINT, at which point it drains cleanly (typed
+`data_service` summary event in the journal). Trainers and evals attach
+with `train.py --data-service HOST:PORT` or
+`data.service.DataServiceClient`.
+
+`--host-id/--num-hosts` apply `shard_for_host` so a multi-host fleet
+runs one service per host over a disjoint, covering shard slice (the
+per-host sharded input feed for parallel/multihost.py).
+
+Prints `ready ADDRESS` on stdout once the socket is bound — the line a
+launcher (or the data smoke) waits for.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import sys
+import threading
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--pattern", required=True,
+                   help="record shard glob (records.expand_shards)")
+    p.add_argument("--schema", default="imagenet",
+                   help="Example schema name (data/datasets.py SCHEMAS)")
+    p.add_argument("--batch-size", type=int, required=True)
+    p.add_argument("--resize", type=int, default=None, metavar="SIZE",
+                   help="resize every sample to SIZExSIZE and scale to "
+                        "float32 [0,1] before collating — REQUIRED for "
+                        "variable-size schemas (imagenet JPEGs): batches "
+                        "must be fixed-shape to collate and to keep "
+                        "consumers at one compiled executable. Richer "
+                        "augmentation chains belong to in-process "
+                        "DataService construction (data/service.py)")
+    p.add_argument("--workers", type=int, default=2,
+                   help="decode worker processes")
+    p.add_argument("--shuffle-buffer", type=int, default=512)
+    p.add_argument("--no-shuffle", action="store_true")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--queue-depth", type=int, default=16,
+                   help="encoded batches buffered ahead of the clients")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=0,
+                   help="0 binds an ephemeral port (printed on stdout)")
+    p.add_argument("--host-id", type=int, default=0,
+                   help="this host's index for per-host shard assignment")
+    p.add_argument("--num-hosts", type=int, default=1)
+    p.add_argument("--name", default="default")
+    p.add_argument("--journal", default=None, metavar="PATH",
+                   help="typed data_worker_lost/recovered + data_service "
+                        "events (tools/check_journal.py --strict validates)")
+    p.add_argument("--worker-restarts", type=int, default=2)
+    args = p.parse_args(argv)
+
+    from deep_vision_tpu.data.datasets import RecordDataset
+    from deep_vision_tpu.data.service import DataService, shard_for_host
+
+    shard_index, num_shards = shard_for_host(args.host_id, args.num_hosts)
+    dataset = RecordDataset(
+        args.pattern, args.schema, shuffle_shards=not args.no_shuffle,
+        seed=args.seed, shard_index=shard_index, num_shards=num_shards,
+    )
+    transform = None
+    if args.resize:
+        from deep_vision_tpu.data import transforms as T
+        from deep_vision_tpu.data.pipeline import Compose
+
+        transform = Compose([T.Resize(args.resize), T.ToFloat()])
+    journal = None
+    if args.journal:
+        from deep_vision_tpu.obs import RunJournal
+
+        journal = RunJournal(args.journal, kind="data_service")
+        journal.manifest(service=args.name, pattern=args.pattern,
+                         host_id=args.host_id, num_hosts=args.num_hosts)
+    svc = DataService(
+        dataset, batch_size=args.batch_size, transform=transform,
+        num_workers=args.workers,
+        shuffle=not args.no_shuffle, shuffle_buffer=args.shuffle_buffer,
+        seed=args.seed, queue_depth=args.queue_depth, host=args.host,
+        port=args.port, name=args.name, journal=journal,
+        worker_restarts=args.worker_restarts,
+    ).start()
+    print(f"ready {svc.address}", flush=True)
+
+    stop = threading.Event()
+
+    def _on_signal(signum, frame):
+        stop.set()  # flag only; teardown runs outside signal context
+
+    signal.signal(signal.SIGTERM, _on_signal)
+    signal.signal(signal.SIGINT, _on_signal)
+    stop.wait()
+    print("data_service: draining", flush=True)
+    svc.close()
+    if journal is not None:
+        journal.close()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

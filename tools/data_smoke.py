@@ -1,0 +1,523 @@
+"""Data-plane smoke: deterministic kill/resume + the shared dataset service.
+
+    PYTHONPATH=. JAX_PLATFORMS=cpu python tools/data_smoke.py \
+        [--workdir artifacts/data_smoke]
+
+The CI teeth behind the production data plane (`make data-smoke`), the
+way chaos-smoke is the teeth behind resilience/ and serve-smoke behind
+serve/. Two phase groups:
+
+  1. deterministic resume (data/snapshot.py e2e): three record-backed
+     LeNet CPU trains through the REAL Trainer + CheckpointManager +
+     crc32c sidecar, each batch content-hashed to a file as it is
+     consumed:
+       A  uninterrupted reference (3 epochs);
+       B1 the same run SIGKILLed mid-epoch-2 by an injected
+          `data.read:crash` fault (a real kill -9, no atexit);
+       B2 resume from the sidecar (`-c`-style restore through
+          Trainer.resume + DataLoader.load_state_dict).
+     Contracts: B1's hash prefix is byte-identical to A's (the stream
+     is deterministic), B2 journals a strict-valid `data_resume`
+     {verdict=restored} event, and B2's post-resume hash sequence is
+     byte-identical to A's from the same offset — a kill/resume
+     produces the batch stream the uninterrupted run would have, no
+     silent re-visits, with the bad-record-budget spend carried over.
+
+  2. shared service (data/service.py): one DataService worker pool,
+     TWO concurrent consumers — a jitted-SGD "trainer" client and a
+     jitted-forward "eval" client — sharing the stream with ZERO
+     recompiles after each consumer's first step and ZERO starvation;
+     an env-inherited `data.service:crash` kills a real worker process
+     mid-stream (absorbed: typed data_worker_lost/recovered, stream
+     uninterrupted, no client errors); an injected `data.service`
+     io_error at the frame boundary drops one connection (absorbed:
+     client reconnects under the retry policy, counted + journaled);
+     journals pass `check_journal --strict`; `obs_report` renders the
+     data-plane section.
+
+chaos_run.py imports `phase_resume_determinism` as its
+deterministic-resume phase, so the chaos gate carries these contracts
+too.
+
+Exit 0 = every contract held; 1 = broken.
+"""
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import threading
+from typing import List, Optional
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+SCHEMA = "data_smoke"
+EPOCHS = 3
+RECORDS_PER_SHARD = 80
+SHARDS = 2
+BATCH = 16
+BPE = (RECORDS_PER_SHARD * SHARDS) // BATCH  # drop_remainder batches/epoch
+# land the kill deep in epoch 2's reads: the read frontier runs ~100
+# records ahead of training (prefetch + shuffle buffer + in-flight
+# transforms), so a kill here interrupts TRAINING mid-epoch-2, well
+# clear of epoch 1's async checkpoint commit
+CRASH_AT_READ = RECORDS_PER_SHARD * SHARDS * 2 + 120
+
+
+def _smoke_schema(feats):
+    import numpy as np
+
+    img = np.frombuffer(feats["image/raw"][0], np.uint8).reshape(32, 32, 1)
+    return {"image": img, "label": np.int32(feats["image/class/label"][0])}
+
+
+def _to_float(sample, rng):
+    import numpy as np
+
+    return {"image": sample["image"].astype(np.float32) / 255.0,
+            "label": sample["label"]}
+
+
+def register_schema() -> None:
+    from deep_vision_tpu.data import datasets
+
+    datasets.SCHEMAS.setdefault(SCHEMA, _smoke_schema)
+
+
+def write_shards(data_dir: str) -> None:
+    import numpy as np
+
+    from deep_vision_tpu.data.example_codec import encode_example
+    from deep_vision_tpu.data.records import write_records
+
+    os.makedirs(data_dir, exist_ok=True)
+    rng = np.random.RandomState(0)
+    for s in range(SHARDS):
+        write_records(
+            os.path.join(data_dir, f"train-{s:05d}"),
+            [encode_example({
+                "image/raw": [rng.randint(0, 256, size=(32, 32, 1),
+                                          dtype=np.uint8).tobytes()],
+                "image/class/label": [i % 10],
+            }) for i in range(RECORDS_PER_SHARD)],
+        )
+
+
+def _build_loader(data_dir: str, dead_letter: Optional[str] = None):
+    from deep_vision_tpu.data.datasets import RecordDataset
+    from deep_vision_tpu.data.pipeline import DataLoader
+    from deep_vision_tpu.data.records import BadRecordBudget
+
+    register_schema()
+    # the budget routes reads through the tolerant reader (where the
+    # data.read fault point fires per record — the kill mechanism) and
+    # proves spend carryover across the resume
+    budget = BadRecordBudget(max_count=50, dead_letter_path=dead_letter)
+    ds = RecordDataset(os.path.join(data_dir, "train-*"), SCHEMA,
+                       shuffle_shards=True, seed=3,
+                       bad_record_budget=budget)
+    return DataLoader(ds, BATCH, transform=_to_float, shuffle=True,
+                      shuffle_buffer=64, num_workers=2, drop_remainder=True,
+                      seed=5, prefetch=2, name="train")
+
+
+def _hash_batch(batch) -> str:
+    import numpy as np
+
+    h = hashlib.sha1()
+    for k in sorted(batch):
+        h.update(np.ascontiguousarray(batch[k]).tobytes())
+    return h.hexdigest()
+
+
+# -- child: one real train run, batch stream hashed ---------------------------
+
+def child_train(argv: List[str]) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--data-dir", required=True)
+    p.add_argument("--ckpt-dir", required=True)
+    p.add_argument("--journal", required=True)
+    p.add_argument("--hashes", required=True)
+    p.add_argument("--epochs", type=int, default=EPOCHS)
+    p.add_argument("--resume", action="store_true")
+    args = p.parse_args(argv)
+
+    import jax.numpy as jnp
+
+    from deep_vision_tpu.core import CheckpointManager
+    from deep_vision_tpu.losses import classification_loss_fn
+    from deep_vision_tpu.models import get_model
+    from deep_vision_tpu.obs import RunJournal
+    from deep_vision_tpu.train import Trainer, build_optimizer
+
+    from deep_vision_tpu.obs import locksmith
+
+    journal = RunJournal(args.journal, kind="train")
+    locksmith.arm_from_env(journal=journal)  # DVT_LOCKSMITH=1 children
+    journal.manifest()
+    loader = _build_loader(args.data_dir)
+    ckpt = CheckpointManager(args.ckpt_dir, journal=journal)
+    trainer = Trainer(
+        get_model("lenet5", num_classes=10),
+        build_optimizer("sgd", 0.05, momentum=0.9),
+        classification_loss_fn,
+        sample_input=jnp.zeros((BATCH, 32, 32, 1)),
+        checkpoint_manager=ckpt, journal=journal, data_loader=loader,
+    )
+    journal.add_closer(trainer.close)
+
+    def hashed_batches():
+        # append+fsync per line: a SIGKILL keeps the consumed prefix,
+        # which the parent compares byte-for-byte against the reference
+        with open(args.hashes, "a") as fh:
+            for b in loader:
+                fh.write(_hash_batch(b) + "\n")
+                fh.flush()
+                os.fsync(fh.fileno())
+                yield b
+
+    start_epoch = trainer.resume() if args.resume else 0
+    trainer.fit(hashed_batches, None, epochs=args.epochs,
+                start_epoch=start_epoch)
+    trainer.close()
+    journal.close()
+    return 0
+
+
+# -- parent helpers -----------------------------------------------------------
+
+class Failures:
+    def __init__(self):
+        self.errors: List[str] = []
+
+    def check(self, ok: bool, what: str) -> bool:
+        print(("  ok  " if ok else "  FAIL") + f"  {what}", flush=True)
+        if not ok:
+            self.errors.append(what)
+        return ok
+
+
+def _run_child(args: List[str], log_path: str, extra_env=None,
+               timeout: float = 600.0) -> int:
+    env = dict(os.environ, PYTHONPATH=ROOT, JAX_PLATFORMS="cpu",
+               DVT_LOCKSMITH="1")
+    env.pop("DVT_FAULT_SPEC", None)
+    env.pop("DVT_FAULT_SEED", None)
+    if extra_env:
+        env.update(extra_env)
+    with open(log_path, "w") as log:
+        return subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--child"] + args,
+            cwd=ROOT, env=env, stdout=log, stderr=subprocess.STDOUT,
+            timeout=timeout,
+        ).returncode
+
+
+def _read_lines(path: str) -> List[str]:
+    if not os.path.exists(path):
+        return []
+    with open(path) as f:
+        return [ln.strip() for ln in f if ln.strip()]
+
+
+def _read_jsonl(path: str) -> List[dict]:
+    out = []
+    for ln in _read_lines(path):
+        try:
+            out.append(json.loads(ln))
+        except json.JSONDecodeError:
+            pass  # torn final line: the SIGKILL signature
+    return out
+
+
+def _strict_ok(path: str) -> bool:
+    return subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "check_journal.py"),
+         path, "--strict"],
+        cwd=ROOT, env=dict(os.environ, PYTHONPATH=ROOT),
+    ).returncode == 0
+
+
+# -- phase group 1: deterministic kill/resume ---------------------------------
+
+def phase_resume_determinism(work: str, f: Failures) -> None:
+    """SIGKILL mid-epoch, resume from the sidecar, byte-identical batch
+    stream (chaos_run.py runs this as its deterministic-resume phase)."""
+    data_dir = os.path.join(work, "data")
+    if not os.path.isdir(data_dir):
+        write_shards(data_dir)
+
+    print("resume-determinism: reference run (uninterrupted)", flush=True)
+    ha = os.path.join(work, "hashes_a.txt")
+    rc = _run_child(
+        ["--data-dir", data_dir, "--ckpt-dir", os.path.join(work, "ckpt_a"),
+         "--journal", os.path.join(work, "journal_a.jsonl"),
+         "--hashes", ha, "--epochs", str(EPOCHS)],
+        os.path.join(work, "run_a.log"))
+    f.check(rc == 0, f"reference run completed (rc={rc})")
+    A = _read_lines(ha)
+    f.check(len(A) == EPOCHS * BPE,
+            f"reference consumed {len(A)} == {EPOCHS}x{BPE} batches")
+
+    print("resume-determinism: SIGKILL mid-epoch-2 via injected "
+          "data.read:crash", flush=True)
+    hb = os.path.join(work, "hashes_b.txt")
+    jb1 = os.path.join(work, "journal_b1.jsonl")
+    ckpt_b = os.path.join(work, "ckpt_b")
+    rc = _run_child(
+        ["--data-dir", data_dir, "--ckpt-dir", ckpt_b,
+         "--journal", jb1, "--hashes", hb, "--epochs", str(EPOCHS)],
+        os.path.join(work, "run_b1.log"),
+        extra_env={"DVT_FAULT_SPEC": f"data.read:crash@{CRASH_AT_READ}",
+                   "DVT_FAULT_SEED": "0"})
+    f.check(rc == -signal.SIGKILL,
+            f"run died by the injected SIGKILL mid-epoch (rc={rc})")
+    B1 = _read_lines(hb)
+    f.check(2 * BPE <= len(B1) < EPOCHS * BPE,
+            f"kill landed mid-epoch-2 ({len(B1)} batches consumed)")
+    f.check(B1 == A[:len(B1)],
+            "interrupted run's batch stream is byte-identical to the "
+            "reference prefix (content hashes)")
+
+    print("resume-determinism: resume from the sidecar", flush=True)
+    jb2 = os.path.join(work, "journal_b2.jsonl")
+    hb2 = os.path.join(work, "hashes_b2.txt")
+    rc = _run_child(
+        ["--data-dir", data_dir, "--ckpt-dir", ckpt_b,
+         "--journal", jb2, "--hashes", hb2, "--epochs", str(EPOCHS),
+         "--resume"],
+        os.path.join(work, "run_b2.log"))
+    f.check(rc == 0, f"resumed run completed (rc={rc})")
+    ev = _read_jsonl(jb2)
+    resumes = [e for e in ev if e.get("event") == "data_resume"]
+    f.check(len(resumes) == 1
+            and resumes[0].get("verdict") == "restored",
+            f"typed data_resume event with verdict=restored "
+            f"({resumes and resumes[0].get('verdict')})")
+    f.check(_strict_ok(jb2),
+            "check_journal --strict accepts the resumed journal "
+            "(data_resume included)")
+    if not resumes:
+        return
+    offset = int(resumes[0]["epoch"]) * BPE + int(resumes[0]["batches"])
+    B2 = _read_lines(hb2)
+    f.check(B2 == A[offset:],
+            f"post-resume batch sequence is byte-identical to the "
+            f"uninterrupted run from offset {offset} "
+            f"({len(B2)} vs {len(A) - offset} batches)")
+
+
+# -- phase group 2: the shared service ----------------------------------------
+
+def phase_service(work: str, f: Failures) -> None:
+    import time
+
+    import jax
+    import jax.numpy as jnp
+
+    from deep_vision_tpu.data.datasets import RecordDataset
+    from deep_vision_tpu.data.service import DataService, DataServiceClient
+    from deep_vision_tpu.obs import RunJournal, locksmith
+    from deep_vision_tpu.obs.registry import Registry
+    from deep_vision_tpu.obs.stepclock import recompile_count
+    from deep_vision_tpu.resilience import faults, install_spec
+
+    data_dir = os.path.join(work, "data")
+    if not os.path.isdir(data_dir):
+        write_shards(data_dir)
+    register_schema()
+    jpath = os.path.join(work, "journal_service.jsonl")
+    journal = RunJournal(jpath, kind="data_service")
+    journal.manifest()
+    registry = Registry()
+    san = locksmith.arm(journal=journal, registry=registry)
+    base_compiles = recompile_count()  # installs the listener BEFORE the
+    #                                    first jit so warmup is observed
+
+    def make_service(name: str) -> DataService:
+        ds = RecordDataset(os.path.join(data_dir, "train-*"), SCHEMA,
+                           shuffle_shards=True, seed=3)
+        return DataService(ds, batch_size=BATCH, num_workers=2,
+                           shuffle_buffer=64, seed=7, queue_depth=16,
+                           worker_poll_s=0.6, name=name, journal=journal,
+                           registry=registry).start()
+
+    def warm(svc: DataService, depth: int = 8, deadline: float = 60.0):
+        t0 = time.monotonic()
+        while (svc._batches.qsize() < depth
+               and time.monotonic() - t0 < deadline):
+            time.sleep(0.05)
+
+    # the "trainer": a jitted SGD step over the service batches; the
+    # "eval": a jitted forward pass — both must compile exactly once
+    @jax.jit
+    def sgd(w, batch):
+        x = batch["image"].reshape(BATCH, -1)
+        logits = x @ w
+        onehot = jax.nn.one_hot(batch["label"], 10)
+        g = x.T @ (jax.nn.softmax(logits) - onehot) / BATCH
+        return w - 0.1 * g
+
+    @jax.jit
+    def fwd(w, batch):
+        return jnp.argmax(batch["image"].reshape(BATCH, -1) @ w, -1)
+
+    # -- 2a: clean shared stream — zero recompiles, zero starvation ------
+    print("service: 2 concurrent consumers share one clean stream",
+          flush=True)
+    svc = make_service("shared")
+    warm(svc)
+    trainer_c = DataServiceClient(svc.address, name="trainer",
+                                  journal=journal, registry=registry)
+    eval_c = DataServiceClient(svc.address, name="eval",
+                               journal=journal, registry=registry)
+    n_each = 10
+    eval_err: List[BaseException] = []
+    eval_compiles = 0
+
+    def eval_consumer():
+        nonlocal eval_compiles
+        try:
+            we = jnp.zeros((32 * 32, 10))
+            for i, b in enumerate(eval_c.batches(n_each)):
+                batch = {k: jnp.asarray(v) for k, v in b.items()}
+                fwd(we, batch).block_until_ready()
+                if i == 0:
+                    eval_compiles = recompile_count()
+                time.sleep(0.02)  # a realistic consumer computes between gets
+        except BaseException as e:  # surfaced to the parent check
+            eval_err.append(e)
+
+    w = jnp.zeros((32 * 32, 10))
+    t = threading.Thread(target=eval_consumer, daemon=True)
+    t.start()
+    first_train = 0
+    for i, b in enumerate(trainer_c.batches(n_each)):
+        batch = {k: jnp.asarray(v) for k, v in b.items()}
+        w = sgd(w, batch)
+        w.block_until_ready()
+        if i == 0:
+            first_train = recompile_count()
+        time.sleep(0.02)
+    t.join(timeout=120)
+    f.check(not t.is_alive() and not eval_err,
+            f"both consumers streamed {n_each} batches concurrently "
+            + (f"(eval error: {eval_err[0]!r})" if eval_err else ""))
+    warmup = max(first_train, eval_compiles)
+    total = recompile_count()
+    f.check(warmup > base_compiles and total <= warmup,
+            f"ZERO recompiles after each consumer's first step "
+            f"({total} total vs warmup {warmup}, base {base_compiles}): "
+            f"every batch keeps the one compiled shape")
+    starved = registry.counter("data_service_starved_total",
+                               labels={"service": "shared"}).value
+    f.check(starved == 0,
+            f"no starvation: both consumers always found a batch ready "
+            f"({int(starved)} starved gets)")
+    trainer_c.close()
+    eval_c.close()
+    svc.close()
+
+    # -- 2b: env-inherited worker crash — absorbed, request-scoped -------
+    print("service: injected data.service worker crash -> supervised "
+          "respawn", flush=True)
+    os.environ[faults.ENV_SPEC] = "data.service:crash@40"
+    os.environ[faults.ENV_SEED] = "0"
+    try:
+        svc2 = make_service("crashy")
+        c2 = DataServiceClient(svc2.address, name="crash-client",
+                               journal=journal, registry=registry)
+        got = list(c2.batches(15))  # 240 samples: well past the crash
+        f.check(len(got) == 15,
+                f"stream continued across the worker death "
+                f"({len(got)}/15 batches, no client error)")
+        f.check(c2.reconnects == 0,
+                "worker crash absorbed SERVER-side: the client never "
+                "even reconnected")
+        c2.close()
+        svc2.close()
+    finally:
+        os.environ.pop(faults.ENV_SPEC, None)
+        os.environ.pop(faults.ENV_SEED, None)
+
+    # -- 2c: io_error at the frame boundary — client reconnects ----------
+    print("service: injected io_error at the frame boundary -> "
+          "reconnect", flush=True)
+    svc3 = make_service("flaky")
+    warm(svc3, depth=4)
+    c3 = DataServiceClient(svc3.address, name="flaky-client",
+                           journal=journal, registry=registry)
+    install_spec("data.service:io_error@3", export_env=False)
+    try:
+        got = list(c3.batches(4))
+    finally:
+        install_spec(None)
+    f.check(len(got) == 4 and c3.reconnects >= 1,
+            f"dropped connection absorbed by reconnect "
+            f"({c3.reconnects} reconnect(s), {len(got)}/4 batches)")
+    c3.close()
+    svc3.close()
+
+    f.check(not san.violations(),
+            "locksmith: zero lock-order violations across the service "
+            "lifecycle")
+    locksmith.disarm()
+    journal.close()
+
+    ev = _read_jsonl(jpath)
+    lost = [e for e in ev if e.get("event") == "data_worker_lost"]
+    rec = [e for e in ev if e.get("event") == "data_worker_recovered"]
+    f.check(len(lost) >= 1 and len(rec) >= 1,
+            f"worker death journaled as typed lost/recovered pair(s) "
+            f"({len(lost)}/{len(rec)})")
+    summaries = [e for e in ev if e.get("event") == "data_service"]
+    f.check({s.get("role") for s in summaries} == {"server", "client"},
+            "server + client data_service summaries journaled")
+    f.check(_strict_ok(jpath),
+            "check_journal --strict accepts the service journal")
+    rc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "obs_report.py"),
+         jpath],
+        cwd=ROOT, env=dict(os.environ, PYTHONPATH=ROOT),
+        stdout=subprocess.PIPE).returncode
+    f.check(rc == 0, f"obs_report renders the data-plane section (rc={rc})")
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if argv and argv[0] == "--child":
+        return child_train(argv[1:])
+
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--workdir", default="artifacts/data_smoke")
+    args = p.parse_args(argv)
+    work = os.path.abspath(args.workdir)
+    shutil.rmtree(work, ignore_errors=True)
+    os.makedirs(work)
+    f = Failures()
+
+    print("== phase 1: deterministic kill/resume (byte-identical batch "
+          "stream) ==", flush=True)
+    phase_resume_determinism(work, f)
+
+    print("== phase 2: shared dataset service (2 consumers, worker "
+          "crash, reconnect) ==", flush=True)
+    phase_service(work, f)
+
+    if f.errors:
+        print(f"\ndata-smoke: {len(f.errors)} contract(s) BROKEN "
+              f"(artifacts in {work})")
+        return 1
+    print(f"\ndata-smoke: all data-plane contracts held "
+          f"(artifacts in {work})")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

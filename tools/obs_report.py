@@ -94,6 +94,9 @@ def summarize_run(events: List[dict]) -> dict:
     serving = summarize_serving(events)
     if serving:
         out["serving"] = serving
+    data_plane = summarize_data_plane(events)
+    if data_plane:
+        out["data_plane"] = data_plane
     terminal = next(
         (e for e in reversed(events) if e.get("event") in ("exit", "crash")),
         None)
@@ -186,6 +189,45 @@ def summarize_serving(events: List[dict]) -> Optional[dict]:
     fleet = summarize_fleet(requests, sheds, swaps, lost, recovered)
     if fleet:
         out["fleet"] = fleet
+    return out
+
+
+def summarize_data_plane(events: List[dict]) -> Optional[dict]:
+    """The data-plane view (data/snapshot.py + data/service.py events):
+    service throughput and reconnects from the `data_service` role
+    summaries, worker lost/recovered history, and the `data_resume`
+    verdict — the "did the input pipeline resume where the model did"
+    answer. None when the journal carries no data-plane events, so
+    every existing report renders unchanged."""
+    resumes = [e for e in events if e.get("event") == "data_resume"]
+    lost = [e for e in events if e.get("event") == "data_worker_lost"]
+    recovered = [e for e in events
+                 if e.get("event") == "data_worker_recovered"]
+    summaries = [e for e in events if e.get("event") == "data_service"]
+    if not (resumes or lost or recovered or summaries):
+        return None
+    out: dict = {}
+    if resumes:
+        out["resumes"] = [
+            {k: e.get(k) for k in
+             ("verdict", "epoch", "batches", "shard", "record")
+             if e.get(k) is not None}
+            for e in resumes]
+    roles: Dict[str, dict] = {}
+    for e in summaries:
+        role = str(e.get("role", "?"))
+        row = roles.setdefault(role, {"batches": 0, "reconnects": 0,
+                                      "workers_lost": 0,
+                                      "workers_recovered": 0, "n": 0})
+        row["n"] += 1
+        for k in ("batches", "reconnects", "workers_lost",
+                  "workers_recovered"):
+            if isinstance(e.get(k), int):
+                row[k] += e[k]
+    if roles:
+        out["service"] = roles
+    if lost or recovered:
+        out["workers"] = {"lost": len(lost), "recovered": len(recovered)}
     return out
 
 
@@ -373,6 +415,30 @@ def render(summary: dict) -> str:
             rows.append(("serve drain",
                          f"{drain.get('reason')} -> {drain.get('outcome')} "
                          f"({parts} pending={drain.get('pending')})"))
+    # data plane (data/snapshot.py + data/service.py): service
+    # throughput/reconnects, worker death history, and the resume
+    # verdict — whether the input stream continued where the model did
+    data_plane = summary.get("data_plane")
+    if data_plane:
+        for role, r in sorted(data_plane.get("service", {}).items()):
+            parts = f"{r['batches']} batches"
+            if role == "client" and r.get("reconnects"):
+                parts += f", {r['reconnects']} reconnect(s)"
+            if role == "server" and (r.get("workers_lost")
+                                     or r.get("workers_recovered")):
+                parts += (f", workers lost x{r['workers_lost']}"
+                          f" recovered x{r['workers_recovered']}")
+            rows.append((f"data service [{role}]", parts))
+        w = data_plane.get("workers")
+        if w and "service" not in data_plane:
+            rows.append(("data workers",
+                         f"lost x{w['lost']} recovered x{w['recovered']}"))
+        for e in data_plane.get("resumes", []):
+            detail = (f"epoch {e.get('epoch')} batch {e.get('batches')}"
+                      if e.get("verdict") == "restored" else "from scratch")
+            if e.get("shard"):
+                detail += f" (shard {os.path.basename(str(e['shard']))})"
+            rows.append(("data resume", f"{e.get('verdict')} ({detail})"))
     # profiler captures: every decision the autoprof policy made, so the
     # table answers "why does this run have three trace dirs" directly
     for e in summary.get("captures", []):
