@@ -51,7 +51,7 @@ lint-baseline:
 # obs-smoke and chaos-smoke — the telemetry artifacts must validate and
 # the resilience contracts must hold before the tests count
 verify: SHELL := /bin/bash
-verify: lint preflight perf-smoke obs-smoke chaos-smoke data-smoke serve-smoke fleet-smoke
+verify: lint preflight perf-smoke obs-smoke chaos-smoke data-smoke host-smoke serve-smoke fleet-smoke
 	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
 
 # environment preflight: backend liveness + libtpu/client version
@@ -120,6 +120,20 @@ fleet-smoke:
 chaos-smoke:
 	JAX_PLATFORMS=cpu python tools/chaos_run.py --workdir artifacts/chaos_smoke
 
+# host-churn smoke: the multi-host half of the elastic arc
+# (tools/host_smoke.py) — three REAL processes (forced 2-device CPU
+# worlds) rendezvous, train a checkpointed run at world 3, and one is
+# SIGKILLed mid-epoch: the survivors must detect within the heartbeat
+# deadline (typed host_lost, no collective hang), re-rendezvous at
+# generation 1 / world 2, rebuild the mesh, resume at the EXACT
+# checkpointed step via the cross-mesh restore, and re-derive a
+# disjoint+covering host-shard assignment (typed data_reshard).
+# Locksmith armed throughout (zero violations); surviving journals
+# pass check_journal --strict; obs_report renders the membership
+# timeline
+host-smoke:
+	JAX_PLATFORMS=cpu python tools/host_smoke.py --workdir artifacts/host_smoke
+
 # data-plane smoke: the production data plane's contracts
 # (tools/data_smoke.py) — a record-backed CPU train SIGKILLed mid-epoch
 # resumes from the crc32c sidecar with a byte-identical batch stream
@@ -186,4 +200,4 @@ ps:
 native:
 	$(MAKE) -C native
 
-.PHONY: train resume train-fg test lint lint-baseline verify preflight obs-smoke chaos-smoke data-smoke serve-smoke fleet-smoke perf-smoke bench bench-evidence roofline demo demo-gan demo-real dryrun tb ps native
+.PHONY: train resume train-fg test lint lint-baseline verify preflight obs-smoke chaos-smoke data-smoke host-smoke serve-smoke fleet-smoke perf-smoke bench bench-evidence roofline demo demo-gan demo-real dryrun tb ps native

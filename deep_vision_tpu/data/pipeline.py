@@ -140,6 +140,7 @@ class DataLoader:
         name: str = "default",
         worker_restarts: int = 1,
         worker_poll_s: float = 10.0,
+        host_shard: Optional[tuple] = None,
     ):
         self.dataset = dataset
         self.name = name  # labels this loader's obs metrics (train vs val)
@@ -159,6 +160,14 @@ class DataLoader:
         # quiet (tests shrink it — a liveness probe, not a correctness knob)
         self.worker_restarts = worker_restarts
         self.worker_poll_s = worker_poll_s
+        # which host's slice of a multi-host world this loader feeds
+        # ((shard_index, num_shards), the multihost.host_shard() value at
+        # construction). Pure snapshot identity: it pins the stream's
+        # fingerprint so a DataLoaderState taken at world N refuses
+        # restore at world M after an elastic resize — the re-derived
+        # slice is a different stream. None (single-host) changes nothing.
+        self.host_shard = (tuple(int(v) for v in host_shard)
+                           if host_shard is not None else None)
         if num_procs > 0 and not hasattr(dataset, "split"):
             raise TypeError(
                 f"num_procs={num_procs} needs a dataset with .split(i, n); "
@@ -538,7 +547,8 @@ class DataLoader:
             self._fp = _snapshot.fingerprint(
                 self.dataset, self.batch_size, self.seed,
                 shuffle=self.shuffle, shuffle_buffer=self.shuffle_buffer,
-                drop_remainder=self.drop_remainder)
+                drop_remainder=self.drop_remainder,
+                host_shard=self.host_shard)
         return self._fp
 
     def _record_snapshot(self, epoch: int, bi: int, epoch_seed: int,
@@ -581,6 +591,23 @@ class DataLoader:
 
     def _mark_consumed(self, epoch: int, batches: int) -> None:
         self._consumed_key = (epoch, batches)
+
+    def pin_host_shard(self, shard) -> None:
+        """Stamp the host-shard identity (shard_index, num_shards) into
+        this loader's snapshot fingerprint after construction — the
+        Trainer does this for elastic multi-host runs when the loader
+        was built without one, so a DataLoaderState taken at world N
+        actually REFUSES restore at world M instead of silently
+        matching. Must happen before the fingerprint is first computed
+        (i.e. before any state is recorded): re-stamping a live stream
+        would be the very identity shift the fingerprint exists to
+        catch."""
+        shard = tuple(int(v) for v in shard)
+        if self._fp is not None and self.host_shard != shard:
+            raise _snapshot.SnapshotError(
+                "pin_host_shard after the fingerprint was computed: the "
+                "stream's identity is already fixed")
+        self.host_shard = shard
 
     def snapshot_supported(self) -> bool:
         """num_procs workers interleave nondeterministically — no
@@ -646,9 +673,10 @@ class DataLoader:
         st = _snapshot.validate_state(state)
         if st.fingerprint and st.fingerprint != self._fingerprint():
             raise _snapshot.SnapshotMismatch(
-                "data_state fingerprint mismatch: the dataset shard list "
-                "or loader shape (batch size, seed, shuffle/buffer, "
-                "drop_remainder) changed since the snapshot — resuming "
+                "data_state fingerprint mismatch: the dataset shard list, "
+                "loader shape (batch size, seed, shuffle/buffer, "
+                "drop_remainder), or host-shard slice (an elastic N->M "
+                "world resize) changed since the snapshot — resuming "
                 "would silently shift the stream")
         self._epoch = st.epoch
         self._resume = st

@@ -184,6 +184,15 @@ def shard_for_host(host_id: int, num_hosts: int,
     exactly one host (tests/test_data_service.py proves disjointness +
     coverage), which is what keeps a multi-host epoch from double-
     visiting data.
+
+    Elastic worlds re-call this per generation: after an N→M resize the
+    surviving hosts pass their NEW (rank, world_size) from
+    `multihost.host_shard()` and the assignment re-derives — disjoint
+    and covering at every world size (tests/test_rendezvous.py proves
+    the property across arbitrary N→M), journaled by the trainer as a
+    typed `data_reshard` event. No state carries over: the slice is a
+    pure function of the generation, which is what makes the reshard
+    safe to recompute.
     """
     if num_hosts < 1:
         raise ValueError(f"num_hosts must be >= 1, got {num_hosts}")

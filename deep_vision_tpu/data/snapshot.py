@@ -131,16 +131,25 @@ def validate_state(d: dict) -> DataLoaderState:
 
 def fingerprint(dataset, batch_size: int, seed: int,
                 shuffle: bool = False, shuffle_buffer: int = 0,
-                drop_remainder: bool = False) -> str:
+                drop_remainder: bool = False,
+                host_shard: Optional[tuple] = None) -> str:
     """Identity of the stream a state belongs to: the shard list for
     record-backed datasets, the length for map-style ones, plus EVERY
     loader knob that changes the sample order or batch boundaries —
     shuffle/shuffle_buffer permute the post-shuffle order `skip` counts
-    in, drop_remainder moves the epoch boundary. Saved into every
-    state; a mismatch at restore is a changed-stream signal."""
+    in, drop_remainder moves the epoch boundary, and `host_shard`
+    (shard_index, num_shards) pins WHICH host's slice of a multi-host
+    world this stream is: a snapshot taken at world N must refuse
+    restore at world M (the elastic-resize contract — the re-derived
+    slice is a different stream, and replaying the old position on it
+    would silently re-visit/skip data). Saved into every state; a
+    mismatch at restore is a changed-stream signal."""
     h = hashlib.sha1()
     h.update(f"bs={batch_size};seed={seed};sh={int(shuffle)};"
              f"buf={shuffle_buffer};dr={int(drop_remainder)};".encode())
+    if host_shard is not None:
+        idx, n = host_shard
+        h.update(f"hs={int(idx)}/{int(n)};".encode())
     files = getattr(dataset, "files", None)
     if files is not None:
         import os

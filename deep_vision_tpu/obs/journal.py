@@ -72,10 +72,15 @@ class RunJournal:
     """Append-only JSONL journal for one run (or one bench session)."""
 
     def __init__(self, path: str, run_id: Optional[str] = None,
-                 kind: str = "train", per_process: bool = True):
+                 kind: str = "train", per_process: bool = True,
+                 writer: Optional[bool] = None):
         # multi-process runs: every host owns a suffixed file (`.pN`) so a
         # follower's telemetry outlives the follower; per_process=False
-        # keeps the legacy process-0-only single shared path
+        # keeps the legacy process-0-only single shared path. writer=True
+        # forces THIS process to write regardless of rank: elastic runs
+        # name per-host files themselves (journal_<host>.jsonl) because a
+        # rank-derived suffix would change across generations and strand
+        # the pre-resize history in a terminal-less file
         sfx = process_suffix() if per_process else ""
         self.path = path + sfx
         self.kind = kind
@@ -83,7 +88,8 @@ class RunJournal:
         self._closed = False
         self._closers: List[Callable[[], None]] = []
         self._taps: List[Callable[[dict], None]] = []
-        self._primary = is_primary_host() or bool(sfx)
+        self._primary = (bool(writer) if writer is not None
+                         else (is_primary_host() or bool(sfx)))
         # writes come from the train loop AND side threads (the health
         # watchdog, data prefetch errors): one lock keeps lines whole.
         # locksmith-named: the runtime sanitizer checks nothing ever holds

@@ -26,6 +26,12 @@ CLI: `tools/obs_merge.py`. In-run: `parallel/multihost.aggregate_obs`
 runs this on the primary host after an end-of-run barrier (shared
 filesystem — the standard Cloud TPU pod setup where every host mounts
 the same GCS/NFS run directory).
+
+Partial journals are expected input, not failure: a host SIGKILLed
+mid-run leaves a torn final line (tolerated line-wise) or no readable
+file at all (recorded as `unreadable_sources` in the merge header) —
+the merge is precisely the postmortem that must still assemble from
+whatever the survivors wrote.
 """
 from __future__ import annotations
 
@@ -154,9 +160,18 @@ def merge_journal_files(
     a single-host journal.
     """
     per_host: Dict[int, List[dict]] = {}
+    unreadable: List[str] = []
     for i, path in enumerate(paths):
-        events = [e for e in read_journal(path)
-                  if e.get("event") != "_torn_line"]
+        try:
+            events = [e for e in read_journal(path)
+                      if e.get("event") != "_torn_line"]
+        except OSError:
+            # a host that died mid-run may leave a missing/unreadable
+            # journal (SIGKILL before the first flush, a vanished local
+            # volume): the merge is exactly the postmortem that must
+            # still assemble — record the gap, keep the survivors
+            unreadable.append(path)
+            continue
         host = host_index(path, events, fallback=i)
         per_host.setdefault(host, []).extend(events)
     merged, stragglers = merge_events(per_host, gap_ms=gap_ms, rel=rel)
@@ -167,10 +182,13 @@ def merge_journal_files(
         "note": "obs_merge", "hosts": sorted(per_host),
         "sources": list(paths), "stragglers": len(stragglers),
     }
+    if unreadable:
+        header["unreadable_sources"] = unreadable
     summary = {
         "hosts": sorted(per_host),
         "events": len(merged),
         "stragglers": stragglers,
+        "unreadable": unreadable,
         "out": out_path,
     }
     if out_path is not None:

@@ -8,16 +8,61 @@ simpler: every host runs the SAME SPMD program, `jax.distributed.initialize`
 wires the cluster, the mesh spans all hosts' devices, and XLA routes
 collectives over ICI within a slice and DCN across slices. There is no
 NCCL/MPI code to write — the comm backend IS the mesh + partitioner.
+
+Elastic overlay (resilience/rendezvous.py): with a generation-numbered
+world view installed (`install_world`), every topology read here —
+`process_count` / `process_index` / `host_shard` / `per_host_batch_size`
+— routes through the CURRENT generation instead of a `jax.process_count()`
+frozen at init, and every barrier/agree (`sync_hosts` / `agree_flag` /
+`PreemptionGuard.agreed`) becomes deadline-bounded and lease-checked: a
+dead peer yields a typed `HostLostError` within the heartbeat deadline
+instead of an indefinite collective hang. Without a rendezvous, the raw
+jax collectives still get a deadline (`DVT_COLLECTIVE_DEADLINE_S`,
+default 600s) via a worker-thread join — no barrier path in this module
+can block unboundedly.
 """
 from __future__ import annotations
 
 import os
-from typing import Optional
+import threading
+from typing import Optional, Tuple
 
 import jax
 import numpy as np
 
 from deep_vision_tpu.parallel.mesh import MeshSpec, create_mesh
+from deep_vision_tpu.resilience.rendezvous import HostLostError, WorldView
+
+#: ceiling for the raw-jax-collective fallback path (no rendezvous
+#: installed): a barrier blocked past this is declared a lost peer. The
+#: rendezvous path detects in ~a lease (seconds); this is the backstop.
+DEFAULT_COLLECTIVE_DEADLINE_S = float(
+    os.environ.get("DVT_COLLECTIVE_DEADLINE_S", "600"))
+
+# -- the installable world view (resilience/rendezvous.py) --------------------
+
+_WORLD: Optional[WorldView] = None
+_RDZV = None  # the Rendezvous backing barriers/agree, when elastic
+
+
+def install_world(view: WorldView, rendezvous=None) -> None:
+    """Adopt a rendezvous generation as THE topology: reads route through
+    it and, when `rendezvous` is given, barriers/agree run over its
+    lease-checked file protocol instead of jax collectives (which cannot
+    name a dead peer, only hang on it)."""
+    global _WORLD, _RDZV
+    _WORLD = view
+    _RDZV = rendezvous
+
+
+def installed_world() -> Optional[WorldView]:
+    return _WORLD
+
+
+def clear_world() -> None:
+    global _WORLD, _RDZV
+    _WORLD = None
+    _RDZV = None
 
 
 def initialize_distributed(
@@ -41,10 +86,55 @@ def initialize_distributed(
         process_id = int(pid) if pid is not None else None
     if coordinator_address is None and num_processes in (None, 1):
         return  # single host, nothing to wire
+    _enable_cpu_collectives()
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes,
         process_id=process_id,
+    )
+
+
+def _enable_cpu_collectives() -> None:
+    """Multi-process collectives on the CPU backend need the gloo
+    transport (newer jax: a config flag; without it every cross-process
+    psum dies with 'Multiprocess computations aren't implemented on the
+    CPU backend'). Must run before the backend initializes; harmless
+    no-op on TPU and on jax builds without the flag."""
+    if os.environ.get("JAX_PLATFORMS", "") != "cpu":
+        return
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:
+        pass
+    try:
+        # gloo shares one context across a process's in-flight
+        # computations: async CPU dispatch can overlap two executions
+        # and interleave their collectives on the same TCP pair, which
+        # gloo answers with a fatal preamble-size EnforceNotMet
+        # (observed flakily in the host smoke). Serialize dispatch —
+        # this is the CPU test/simulation path, not a perf surface.
+        jax.config.update("jax_cpu_enable_async_dispatch", False)
+    except Exception:
+        pass
+
+
+def initialize_from_world(view: WorldView) -> None:
+    """`jax.distributed.initialize` parameterized by a rendezvous
+    generation: the view's coordinator address, world size, and this
+    host's dense rank. The re-entry half of an elastic resize — a
+    re-exec'd survivor calls this with the g+1 view and lands in a
+    fresh, correctly-sized distributed world."""
+    if view.world_size == 1:
+        return  # a world of one needs no coordinator
+    if view.coordinator is None:
+        raise ValueError(
+            f"generation {view.generation} record carries no coordinator "
+            "address — cannot initialize jax.distributed")
+    _enable_cpu_collectives()
+    jax.distributed.initialize(
+        coordinator_address=view.coordinator,
+        num_processes=view.world_size,
+        process_id=view.rank,
     )
 
 
@@ -60,34 +150,93 @@ def global_mesh(data: int = -1, model: int = 1):
 
 
 def process_count() -> int:
+    """World size: the installed rendezvous generation's when elastic,
+    else jax's (frozen at init — the fixed-world assumption the elastic
+    overlay exists to remove)."""
+    if _WORLD is not None:
+        return _WORLD.world_size
     return jax.process_count()
 
 
 def process_index() -> int:
+    """This host's dense rank in the current generation (elastic) or
+    jax's process index (static)."""
+    if _WORLD is not None:
+        return _WORLD.rank
     return jax.process_index()
 
 
 def is_primary() -> bool:
-    """True on the host that should write checkpoints/logs (process 0)."""
-    return jax.process_index() == 0
+    """True on the host that should write checkpoints/logs (rank 0 of
+    the current generation)."""
+    return process_index() == 0
 
 
 def host_shard() -> tuple[int, int]:
     """(shard_index, num_shards) for host-sharded input pipelines: each host
-    reads files[shard_index::num_shards] (records.record_iterator contract)."""
-    return jax.process_index(), jax.process_count()
+    reads files[shard_index::num_shards] (records.record_iterator contract).
+    Generation-aware: after an N→M resize the assignment re-derives over
+    the new host set — disjoint and covering at every world size
+    (tests/test_rendezvous.py proves the property)."""
+    return process_index(), process_count()
 
 
-def sync_hosts(name: str = "barrier") -> None:
-    """Cross-host barrier (a real one: all-device collective rendezvous)."""
-    if jax.process_count() == 1:
+def _bounded_collective(fn, name: str, deadline_s: Optional[float]):
+    """Run a jax collective with a deadline: the op blocks in C++ when a
+    peer is dead (BENCH_r04's failure shape, at the host layer), so the
+    only honest bound is a worker-thread join — on timeout the orphaned
+    thread stays wedged and the caller gets the typed `HostLostError`
+    the supervision layer turns into a re-rendezvous."""
+    deadline_s = (DEFAULT_COLLECTIVE_DEADLINE_S
+                  if deadline_s is None else float(deadline_s))
+    out: dict = {}
+
+    def run():
+        try:
+            out["value"] = fn()
+        except BaseException as e:
+            out["exc"] = e
+
+    t = threading.Thread(target=run, daemon=True,
+                         name=f"collective-{name}")
+    t.start()
+    t.join(deadline_s)
+    if t.is_alive():
+        raise HostLostError(
+            None, _WORLD.generation if _WORLD is not None else -1,
+            detail=f"collective {name!r} blocked past its "
+                   f"{deadline_s:.0f}s deadline (dead peer?)")
+    if "exc" in out:
+        raise out["exc"]
+    return out.get("value")
+
+
+def sync_hosts(name: str = "barrier",
+               deadline_s: Optional[float] = None) -> None:
+    """Cross-host barrier, deadline-bounded.
+
+    Elastic (rendezvous installed): a lease-checked file barrier — a
+    dead peer raises `HostLostError` within the heartbeat deadline, and
+    no jax collective (which could wedge in C++) is involved at all.
+    Static: the real all-device collective rendezvous, bounded by
+    `deadline_s` (default `DVT_COLLECTIVE_DEADLINE_S`)."""
+    if process_count() == 1:
         return
-    from jax.experimental import multihost_utils
+    if _RDZV is not None:
+        _RDZV.barrier(name, timeout_s=(deadline_s if deadline_s is not None
+                                       else DEFAULT_COLLECTIVE_DEADLINE_S))
+        return
 
-    multihost_utils.sync_global_devices(name)
+    def op():
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(name)
+
+    _bounded_collective(op, name, deadline_s)
 
 
-def agree_flag(local_flag: bool) -> bool:
+def agree_flag(local_flag: bool,
+               deadline_s: Optional[float] = None) -> bool:
     """Global OR of a per-host boolean (True if ANY host raised it).
 
     The preemption-consensus primitive (train/trainer.py): SIGTERM lands on
@@ -95,15 +244,25 @@ def agree_flag(local_flag: bool) -> bool:
     boundary, the allgather rendezvouses them, and all act on the same
     answer — no host enters a checkpoint collective while another enters
     the next step's all-reduce. Single-process: returns the flag as-is.
-    """
-    if jax.process_count() == 1:
+    Deadline-bounded like `sync_hosts`: a dead peer is a typed
+    `HostLostError`, never an indefinite hang."""
+    if process_count() == 1:
         return bool(local_flag)
-    from jax.experimental import multihost_utils
+    if _RDZV is not None:
+        return _RDZV.agree(
+            "agree_flag", bool(local_flag),
+            timeout_s=(deadline_s if deadline_s is not None
+                       else DEFAULT_COLLECTIVE_DEADLINE_S))
 
-    flags = multihost_utils.process_allgather(
-        np.asarray([bool(local_flag)])
-    )
-    return bool(np.any(flags))
+    def op():
+        from jax.experimental import multihost_utils
+
+        flags = multihost_utils.process_allgather(
+            np.asarray([bool(local_flag)])
+        )
+        return bool(np.any(flags))
+
+    return bool(_bounded_collective(op, "agree_flag", deadline_s))
 
 
 class PreemptionGuard:
@@ -194,7 +353,8 @@ class PreemptionGuard:
     def agreed(self, *, step: Optional[int] = None, force: bool = False) -> bool:
         if self._agreed:
             return True
-        if jax.process_count() == 1:
+        if process_count() == 1:  # generation-aware (a 2-host world that
+            # shrank to 1 must stop holding consensus with a ghost)
             self._agreed = self.requested
             return self._agreed
         if step is not None:
@@ -218,7 +378,7 @@ def aggregate_obs(journal_path: str, out_path: Optional[str] = None,
     cross-host straggler detection (obs/merge.py). Returns the merged
     path on the primary, None elsewhere and in single-process runs.
     """
-    if jax.process_count() == 1:
+    if process_count() == 1:
         return None
     sync_hosts("obs_merge")
     if not is_primary():
@@ -238,8 +398,10 @@ def aggregate_obs(journal_path: str, out_path: Optional[str] = None,
 def per_host_batch_size(global_batch_size: int) -> int:
     """Rows this host must feed per step (global batch / host count); the
     global-batch contract mirrors `batch * num_replicas` at
-    YOLO/tensorflow/train.py:282 but spans hosts."""
-    n = jax.process_count()
+    YOLO/tensorflow/train.py:282 but spans hosts. Generation-aware: a
+    3→2 resize re-derives this from the new world (the global batch is
+    the training contract; the per-host share is topology weather)."""
+    n = process_count()
     if global_batch_size % n:
         raise ValueError(f"global batch {global_batch_size} not divisible by {n} hosts")
     return global_batch_size // n

@@ -16,6 +16,12 @@ clean lab run):
   checkpoint sharding metadata (restore a run saved on N devices onto
   M), and the threaded `backend_alive` liveness probe shared by bench
   and `tools/preflight.py`.
+- `rendezvous`: the multi-HOST half of the elastic arc — file-backed
+  generation-numbered membership (heartbeat leases, deadline-bounded
+  barriers/consensus, join-time version handshake), `HostSupervisor`
+  journaling typed `host_lost`/`host_joined`/`world_resized` events,
+  and the bounded device fence that turns a peer SIGKILLed
+  mid-collective into a typed error instead of an indefinite hang.
 - `faults`: `FaultInjector` — seeded, deterministic faults driven by a
   `--fault-spec` string, with named injection points at every I/O
   boundary that cost one None-check when disabled. The mechanism behind
@@ -48,9 +54,27 @@ from deep_vision_tpu.resilience.faults import (
     installed,
     transform,
 )
+from deep_vision_tpu.resilience.rendezvous import (
+    HostLostError,
+    HostSupervisor,
+    Rendezvous,
+    RendezvousError,
+    RendezvousRefused,
+    RendezvousTimeout,
+    WorldResized,
+    WorldView,
+)
 from deep_vision_tpu.resilience.retry import DEFAULT_RETRY_ON, RetryPolicy
 
 __all__ = [
+    "HostLostError",
+    "HostSupervisor",
+    "Rendezvous",
+    "RendezvousError",
+    "RendezvousRefused",
+    "RendezvousTimeout",
+    "WorldResized",
+    "WorldView",
     "BACKEND_LOST_KINDS",
     "BackendSupervisor",
     "DEFAULT_RETRY_ON",

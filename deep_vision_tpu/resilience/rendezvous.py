@@ -1,0 +1,1090 @@
+"""Elastic multi-host rendezvous: survive host churn, not just device loss.
+
+PR 10 made a single process preemption-native; this module is its
+multi-HOST half (ROADMAP item 1's declared leftover). Today a dead host
+makes every `multihost.sync_hosts` / `agree_flag` collective hang until
+a watchdog dumps stacks — the run dies by timeout, not by policy. The
+real fleet failures in the repo's own history are host-MEMBERSHIP
+events: MULTICHIP_r01 was a version-skewed host admitted into the world
+(fatal 4 minutes in), r04/r05 were dead tunnels every surviving host
+then hung on. The standard answer (torchelastic-style generation-
+numbered rendezvous) is a coordinator that treats an N→M world-size
+change as an *expected input*:
+
+- membership is a set of leases: every host heartbeats a member record;
+  a missed heartbeat past the lease deadline IS the `host_lost` signal,
+  typed and bounded, never an indefinite collective hang;
+- the world is versioned by a **generation** number: host death (or a
+  new host joining) moves the survivors to generation g+1 with a fresh
+  dense rank assignment and a fresh jax coordinator address;
+- every barrier/agree is deadline-bounded and lease-checked, so a dead
+  peer yields `HostLostError` within the heartbeat deadline;
+- joiners exchange client/platform versions through the coordinator at
+  join time: a skewed host (the MULTICHIP_r01 failure) is refused in
+  seconds with kind `version_skew`, never admitted into a generation.
+
+The backing store is a directory on a shared filesystem (the same
+GCS/NFS run-dir assumption `obs/merge.py` already makes for multi-host
+journals) — file-backed so it runs on CPU in tests and needs no extra
+service. Records are written atomically (tmp+rename; generation records
+with O_EXCL so exactly one leader wins a generation).
+
+Why re-exec instead of in-process re-init (`HostSupervisor.reexec`):
+a rank whose peer SIGKILLed mid-collective is *wedged in C++* — the
+gloo/ICI op never returns, `jax.distributed.shutdown()` blocks on a
+shutdown barrier the dead host can never join, and the coordination-
+service client terminates the whole process when it polls the peer's
+death (xla client.h:80 — measured, not theorized). torchelastic reaches
+the same verdict: you cannot rescue a rank from a dead collective; you
+restart it. Here the *host agent keeps its process slot*: detection and
+the g+1 rendezvous happen in-process (seconds, deadline-bounded), the
+typed events are journaled, and then the survivor replaces its own
+process image (`os.execv`) into the new generation — same PID, same
+journal file (append mode), fresh jax world — and resumes from the
+last checkpoint via the PR 10 cross-mesh restore.
+
+jax-free at import (the resilience/ contract): the member/heartbeat/
+barrier machinery is pure stdlib, so a re-exec'd host can re-arm its
+lease *before* paying the jax import.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import socket
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+#: journal event kinds this layer emits (tools/check_journal.py --strict
+#: enforces the schemas; obs/README.md documents them)
+EVENT_HOST_LOST = "host_lost"
+EVENT_HOST_JOINED = "host_joined"
+EVENT_WORLD_RESIZED = "world_resized"
+EVENT_DATA_RESHARD = "data_reshard"
+
+#: refusal kinds carried by RendezvousRefused (preflight reports them)
+REFUSAL_VERSION_SKEW = "version_skew"
+REFUSAL_EVICTED = "evicted"
+
+#: env var a re-exec'd host agent reads to know which generation to
+#: attach to instead of joining from scratch
+ENV_GENERATION = "DVT_RDZV_GENERATION"
+
+
+class RendezvousError(RuntimeError):
+    """Base for rendezvous-layer failures."""
+
+
+class HostLostError(RendezvousError):
+    """A member's lease expired (or a collective deadline passed): the
+    typed form of what used to be an indefinite hang. `host` is the dead
+    member's id (None when only the deadline fired — a peer is
+    unresponsive but the lease ledger cannot name it, e.g. the raw jax
+    collective fallback path)."""
+
+    def __init__(self, host: Optional[str], generation: int,
+                 detail: str = "", lease_gap_s: Optional[float] = None):
+        self.host = host
+        self.generation = int(generation)
+        self.lease_gap_s = lease_gap_s
+        msg = (f"host {host!r} lost at generation {generation}"
+               if host is not None else
+               f"peer unresponsive at generation {generation}")
+        super().__init__(msg + (f": {detail}" if detail else ""))
+
+
+class RendezvousTimeout(RendezvousError):
+    """A join/resize/barrier deadline passed with every known member
+    still alive — the world never assembled (wrong --expect-hosts, a
+    host that never launched)."""
+
+
+class RendezvousRefused(RendezvousError):
+    """This host was refused admission (kind `version_skew`: its
+    client/platform versions disagree with the incumbent world's —
+    the MULTICHIP_r01 failure, caught at join in seconds instead of
+    minutes into the first compile)."""
+
+    def __init__(self, kind: str, detail: str = ""):
+        self.kind = kind
+        super().__init__(f"rendezvous refused [{kind}]"
+                         + (f": {detail}" if detail else ""))
+
+
+class WorldResized(RendezvousError):
+    """Control-flow signal, not a failure: the world moved to a new
+    generation and this process must re-enter it (tear down jax, rebuild
+    the mesh, resume from checkpoint). `Trainer.fit` raises it after
+    journaling `host_lost`/`world_resized`; the host agent catches it
+    and calls `HostSupervisor.reexec(view)` (or rebuilds in place when
+    no jax world was ever initialized)."""
+
+    def __init__(self, view: "WorldView", resume_step: Optional[int] = None):
+        self.view = view
+        self.resume_step = resume_step
+        super().__init__(
+            f"world resized to generation {view.generation} "
+            f"({view.world_size} host(s)); resume_step={resume_step}")
+
+
+@dataclasses.dataclass(frozen=True)
+class WorldView:
+    """One generation's membership, as seen by one host.
+
+    `hosts` is the generation record's member-id tuple IN RECORD ORDER:
+    the generation leader first (rank 0 must be the host that allocated
+    — and can actually bind — the coordinator address in the record),
+    then the rest sorted. A host's rank is its index — dense,
+    deterministic, and re-derived per generation, which is what lets
+    `multihost.host_shard`/`per_host_batch_size` re-derive a
+    disjoint+covering assignment after an N→M resize instead of reading
+    a process_count() frozen at init time.
+    """
+
+    generation: int
+    hosts: Tuple[str, ...]
+    host: str
+    coordinator: Optional[str] = None  # "host:port" for jax.distributed
+
+    @property
+    def world_size(self) -> int:
+        return len(self.hosts)
+
+    @property
+    def rank(self) -> int:
+        return self.hosts.index(self.host)
+
+    def shard(self) -> Tuple[int, int]:
+        """(shard_index, num_shards) for host-sharded input pipelines —
+        the generation-aware value behind `multihost.host_shard`."""
+        return self.rank, self.world_size
+
+    def to_dict(self) -> dict:
+        return {"generation": self.generation, "hosts": list(self.hosts),
+                "host": self.host, "coordinator": self.coordinator}
+
+
+def _atomic_write(path: str, payload: dict) -> None:
+    tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
+    with open(tmp, "w") as f:
+        json.dump(payload, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def _read_json(path: str) -> Optional[dict]:
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        # mid-rename read or a torn writer: treat as absent, the poll
+        # loop re-reads
+        return None
+
+
+def free_port(host: str = "127.0.0.1") -> int:
+    """A free TCP port on `host` — the generation leader allocates the
+    jax coordinator's port here (the leader IS rank 0, so the port is
+    allocated on the machine that will bind it)."""
+    with socket.socket() as s:
+        s.bind((host, 0))
+        return s.getsockname()[1]
+
+
+def versions_compatible(mine: Dict[str, str],
+                        theirs: Dict[str, str]) -> Tuple[bool, str]:
+    """The join-time version handshake, as a pure function.
+
+    Compares `client_version` (jax/jaxlib pair) and `platform_version`
+    (the libtpu build string — the terminal half of the MULTICHIP_r01
+    skew) field by field; a field one side did not report is not a
+    mismatch (heterogeneous probes must not fail closed on missing
+    introspection). Returns (ok, detail)."""
+    for key in ("client_version", "platform_version"):
+        a, b = mine.get(key), theirs.get(key)
+        if a and b and a != b:
+            return False, f"{key} skew: joiner has {a!r}, world has {b!r}"
+    return True, ""
+
+
+class Rendezvous:
+    """File-backed, generation-numbered membership for one host.
+
+    Layout under `root` (a shared directory):
+
+        members/<host>.json            lease record, rewritten per heartbeat
+        refused/<host>.json            admission refusals (version_skew)
+        gen/<g>.json                   generation record (hosts, coordinator),
+                                       O_EXCL-created by the generation leader
+        barriers/<g>/<name>#<seq>/<host>.json   barrier/agree ballots
+
+    Leadership per generation = the lexicographically lowest live,
+    version-compatible member id; the version REFERENCE is the earliest
+    joiner still alive (the incumbent world refuses the skewed joiner,
+    not the other way around). Barrier names carry a per-name sequence
+    counter so the same name may be used repeatedly (every host calls
+    the same barriers in the same order — the SPMD discipline jax
+    collectives already require).
+    """
+
+    def __init__(self, root: str, host: str,
+                 heartbeat_s: float = 2.0, lease_s: Optional[float] = None,
+                 poll_s: float = 0.05,
+                 coordinator_host: str = "127.0.0.1",
+                 client_version: Optional[str] = None,
+                 platform_version: Optional[str] = None):
+        if not host or "/" in host:
+            raise ValueError(f"host id must be a non-empty path-safe "
+                             f"string, got {host!r}")
+        self.root = root
+        self.host = host
+        self.heartbeat_s = float(heartbeat_s)
+        #: a member is dead when its record is older than this (3 beats
+        #: by default: one lost write is jitter, three is a corpse)
+        self.lease_s = float(lease_s) if lease_s is not None \
+            else 3.0 * self.heartbeat_s
+        self.poll_s = float(poll_s)
+        self.coordinator_host = coordinator_host
+        self.versions = {}
+        if client_version:
+            self.versions["client_version"] = str(client_version)
+        if platform_version:
+            self.versions["platform_version"] = str(platform_version)
+        self.generation = -1  # no world yet
+        self.view: Optional[WorldView] = None
+        self._joined_ts = time.time()  # join() restamps at the real join
+        self._seq: Dict[str, int] = {}
+        self._hb_stop = threading.Event()
+        self._hb_thread: Optional[threading.Thread] = None
+        for sub in ("members", "refused", "gen", "barriers"):
+            os.makedirs(os.path.join(root, sub), exist_ok=True)
+
+    # -- member records ----------------------------------------------------
+
+    def _member_path(self, host: str) -> str:
+        return os.path.join(self.root, "members", f"{host}.json")
+
+    def _write_member(self) -> None:
+        _atomic_write(self._member_path(self.host), {
+            "host": self.host, "pid": os.getpid(), "ts": time.time(),
+            "joined_ts": self._joined_ts, **self.versions,
+        })
+
+    def members(self) -> Dict[str, dict]:
+        """Every member record on disk (alive or stale)."""
+        out: Dict[str, dict] = {}
+        mdir = os.path.join(self.root, "members")
+        for name in sorted(os.listdir(mdir)):
+            if not name.endswith(".json") or name.startswith("."):
+                continue
+            rec = _read_json(os.path.join(mdir, name))
+            if rec and rec.get("host"):
+                out[str(rec["host"])] = rec
+        return out
+
+    def alive(self, now: Optional[float] = None) -> Dict[str, dict]:
+        now = time.time() if now is None else now
+        return {h: r for h, r in self.members().items()
+                if now - float(r.get("ts", 0)) <= self.lease_s}
+
+    def lease_gap(self, host: str) -> Optional[float]:
+        rec = self.members().get(host)
+        if rec is None:
+            return None
+        return time.time() - float(rec.get("ts", 0))
+
+    # -- heartbeats --------------------------------------------------------
+
+    def start_heartbeat(self) -> None:
+        """Arm the lease: write the member record now (synchronously, so
+        the lease exists before this call returns — a re-exec'd host
+        re-arms BEFORE importing jax) and keep rewriting it from a
+        daemon thread."""
+        self._write_member()
+        if self._hb_thread is not None and self._hb_thread.is_alive():
+            return
+
+        def beat():
+            while not self._hb_stop.wait(self.heartbeat_s):
+                try:
+                    self._write_member()
+                except OSError:
+                    pass  # a shared-FS hiccup; the next beat retries
+
+        self._hb_stop.clear()
+        self._hb_thread = threading.Thread(
+            target=beat, name=f"rendezvous-heartbeat-{self.host}",
+            daemon=True)
+        self._hb_thread.start()
+
+    def touch(self) -> None:
+        """One synchronous lease renewal (callers about to exec renew
+        right before, shrinking the re-entry gap to the exec itself)."""
+        self._write_member()
+
+    def leave(self) -> None:
+        """Clean departure: stop heartbeating and drop the member record
+        so survivors see an empty slot, not an expiring lease."""
+        self._hb_stop.set()
+        if self._hb_thread is not None:
+            self._hb_thread.join(timeout=2 * self.heartbeat_s)
+            self._hb_thread = None
+        try:
+            os.remove(self._member_path(self.host))
+        except OSError:
+            pass
+
+    # -- admission (the version handshake) ---------------------------------
+
+    def _refusal_path(self, host: str) -> str:
+        return os.path.join(self.root, "refused", f"{host}.json")
+
+    @staticmethod
+    def _reference_member(members: Dict[str, dict]) -> Optional[dict]:
+        """The version reference: the member compatible with the MOST
+        members (majority wins — a skewed host that happens to write its
+        record first must not poison the whole fleet into self-refusing),
+        ties broken toward the earliest joiner (the incumbent rule, which
+        is all a 1-vs-1 disagreement has to go on)."""
+        if not members:
+            return None
+
+        def score(rec):
+            return sum(1 for other in members.values()
+                       if versions_compatible(rec, other)[0])
+
+        return min(members.values(),
+                   key=lambda r: (-score(r), float(r.get("joined_ts", 0)),
+                                  str(r.get("host"))))
+
+    def _check_admission(self, alive: Optional[Dict[str, dict]] = None
+                         ) -> None:
+        """Raise RendezvousRefused if the majority world's versions
+        disagree with ours, or if a still-applicable refusal marker
+        stands against us. `alive`: a LIVE-members snapshot from this
+        poll iteration (the join loop reads the member directory once
+        per pass and shares it) — corpses must not vote: a dead fleet's
+        stale records outnumbering the fresh one would otherwise elect
+        a corpse as the version reference and make every healthy host
+        self-refuse."""
+        refusal = _read_json(self._refusal_path(self.host))
+        if refusal:
+            # a refusal is pinned to the VERSIONS it judged: a host the
+            # operator has since upgraded to match the fleet must be
+            # able to rejoin under the same id — the stale marker is
+            # retired, not honored forever
+            if refusal.get("versions", None) in (None, self.versions):
+                raise RendezvousRefused(
+                    str(refusal.get("kind", "refused")),
+                    str(refusal.get("detail", "")))
+            try:
+                os.remove(self._refusal_path(self.host))
+            except OSError:
+                pass
+        ref = self._reference_member(alive if alive is not None
+                                     else self.alive())
+        if ref is None or str(ref.get("host")) == self.host:
+            return
+        ok, detail = versions_compatible(self.versions, ref)
+        if not ok:
+            # self-refusal is the fast path; also leave the marker so
+            # the ledger shows WHY this host never made a generation
+            _atomic_write(self._refusal_path(self.host), {
+                "host": self.host, "kind": REFUSAL_VERSION_SKEW,
+                "detail": detail, "versions": self.versions,
+                "ts": time.time()})
+            self.leave()
+            raise RendezvousRefused(REFUSAL_VERSION_SKEW, detail)
+
+    def _compatible(self, members: Dict[str, dict]) -> Dict[str, dict]:
+        """Members whose versions agree with the majority reference (the
+        leader forms generations from these only; a skewed member that
+        skipped its self-check still never makes a world)."""
+        ref = self._reference_member(members)
+        if ref is None:
+            return {}
+        out = {}
+        for h, r in members.items():
+            ok, detail = versions_compatible(r, ref)
+            if ok:
+                out[h] = r
+            elif not os.path.exists(self._refusal_path(h)):
+                _atomic_write(self._refusal_path(h), {
+                    "host": h, "kind": REFUSAL_VERSION_SKEW,
+                    "detail": detail,
+                    "versions": {k: r[k] for k in
+                                 ("client_version", "platform_version")
+                                 if k in r},
+                    "ts": time.time()})
+        return out
+
+    # -- generation records ------------------------------------------------
+
+    def _gen_path(self, g: int) -> str:
+        return os.path.join(self.root, "gen", f"{g}.json")
+
+    def _write_generation(self, g: int, hosts: Sequence[str]) -> bool:
+        """O_EXCL create: exactly one leader wins generation `g`; a loser
+        reads the winner's record. Returns True when we wrote it.
+
+        Host order in the record IS the rank order, writer (= leader)
+        first: rank 0 of a jax world must bind the coordinator address,
+        and the port below is allocated on THIS machine — a
+        lexicographically-lower member (a freshly-admitted joiner, say)
+        must not inherit rank 0 and with it an address it cannot bind."""
+        hosts = [self.host] + sorted(h for h in hosts if h != self.host)
+        rec = {
+            "generation": g, "hosts": hosts,
+            "coordinator": f"{self.coordinator_host}:"
+                           f"{free_port(self.coordinator_host)}",
+            "leader": self.host, "ts": time.time(),
+        }
+        try:
+            fd = os.open(self._gen_path(g),
+                         os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+        except FileExistsError:
+            return False
+        with os.fdopen(fd, "w") as f:
+            json.dump(rec, f)
+            f.flush()
+            os.fsync(f.fileno())
+        return True
+
+    def read_generation(self, g: int) -> Optional[dict]:
+        return _read_json(self._gen_path(g))
+
+    def latest_generation(self) -> Optional[dict]:
+        gdir = os.path.join(self.root, "gen")
+        best = None
+        for name in os.listdir(gdir):
+            if name.endswith(".json"):
+                try:
+                    g = int(name[:-5])
+                except ValueError:
+                    continue
+                if best is None or g > best:
+                    best = g
+        return self.read_generation(best) if best is not None else None
+
+    def _adopt(self, rec: dict) -> WorldView:
+        hosts = tuple(str(h) for h in rec["hosts"])  # record order IS
+        # rank order (leader/coordinator-binder first)
+        if self.host not in hosts:
+            raise RendezvousRefused(
+                REFUSAL_EVICTED,
+                f"generation {rec['generation']} formed without this host "
+                f"(hosts={list(hosts)}) — its lease must have lapsed")
+        self.generation = int(rec["generation"])
+        # this host's membership incarnation began no later than the
+        # record that lists it: clamp joined_ts so a post-reexec
+        # attach's member file still PREDATES the record and
+        # _world_running keeps reading the world as live (a replacement
+        # joiner must wait for a resize, not squat the next generation)
+        rts = float(rec.get("ts", self._joined_ts))
+        if rts < self._joined_ts:
+            self._joined_ts = rts
+            self.touch()
+        # barrier sequence numbering is per generation (the dirs are):
+        # members enter a generation along different histories — join,
+        # in-place resize, post-exec attach — and carried-over counters
+        # would split the SAME logical barrier across #k dirs
+        self._seq = {}
+        self.view = WorldView(generation=self.generation, hosts=hosts,
+                              host=self.host,
+                              coordinator=rec.get("coordinator"))
+        return self.view
+
+    # -- join / attach / resize --------------------------------------------
+
+    def _world_running(self, rec: Optional[dict],
+                       alive: Dict[str, dict]) -> bool:
+        """Is the latest generation record a LIVE world (vs leftovers)?
+
+        A member of `rec` counts as still running that world only when
+        its lease is fresh AND its joined_ts predates the record (the
+        same incarnation that formed it). A fleet re-joining over a
+        stale directory re-stamps every joined_ts, so yesterday's
+        record reads as dead and the new world forms at generation
+        latest+1 — which is also how a preflight probe's leftover
+        record never squats the directory the real run is about to
+        claim."""
+        if rec is None:
+            return False
+        rts = float(rec.get("ts", 0))
+        for h in rec.get("hosts", ()):
+            m = alive.get(str(h))
+            if m is not None and float(m.get("joined_ts", rts + 1)) <= rts:
+                return True
+        return False
+
+    def join(self, expect_hosts: int, timeout_s: float = 120.0) -> WorldView:
+        """Enter a world of exactly `expect_hosts` version-compatible
+        members. Deadline-bounded; the version handshake runs on every
+        poll so a skewed joiner is refused in seconds, not at the
+        deadline.
+
+        Generations need not start at 0: a fresh fleet over a stale
+        directory (a previous run's records, a preflight probe's
+        leftovers) forms at latest+1. Joining while a world is RUNNING
+        never overwrites it — the joiner heartbeats and waits to be
+        adopted by the running world's next `resize()` (which includes
+        every live compatible member: that is the host_joined/grow
+        path)."""
+        self._joined_ts = time.time()
+        self.start_heartbeat()
+        deadline = time.time() + timeout_s
+        while True:
+            rec = self.latest_generation()
+            fresh = (rec is not None
+                     and float(rec.get("ts", 0))
+                     >= self._joined_ts - self.lease_s)
+            if fresh and self.host in {str(h) for h in rec["hosts"]}:
+                view = self._adopt(rec)
+                self._ack_generation(view, deadline)
+                return view
+            members = self.members()  # ONE directory sweep per pass,
+            now = time.time()         # shared by every sub-check below
+            alive = {h: r for h, r in members.items()
+                     if now - float(r.get("ts", 0)) <= self.lease_s}
+            self._check_admission(alive)  # live members only: a dead
+            # fleet's stale records must not out-vote the fresh ones
+            compat = self._compatible(alive)
+            if (len(compat) >= expect_hosts
+                    and not self._world_running(rec, alive)):
+                leader = sorted(compat)[0]
+                if leader == self.host:
+                    g = 0 if rec is None else int(rec["generation"]) + 1
+                    self._write_generation(g, sorted(compat)[:expect_hosts])
+                    continue  # adopt what we (or a racer) wrote
+            if time.time() > deadline:
+                self.leave()
+                raise RendezvousTimeout(
+                    f"world of {expect_hosts} never assembled within "
+                    f"{timeout_s:.0f}s (alive+compatible: "
+                    f"{sorted(compat)})")
+            time.sleep(self.poll_s)
+
+    def attach(self, generation: Optional[int] = None,
+               timeout_s: float = 300.0) -> WorldView:
+        """Re-enter an existing generation (the re-exec'd host agent's
+        path: `ENV_GENERATION` names it). Re-arms the lease first, then
+        blocks — deadline-bounded — on the attach barrier so every
+        member of the generation is live before anyone touches
+        `jax.distributed.initialize` (which would otherwise hang on a
+        member still paying its jax import)."""
+        self._joined_ts = getattr(self, "_joined_ts", time.time())
+        self.start_heartbeat()
+        if generation is None:
+            env = os.environ.get(ENV_GENERATION)
+            generation = int(env) if env else None
+        rec = (self.read_generation(generation) if generation is not None
+               else self.latest_generation())
+        if rec is None:
+            raise RendezvousError(
+                f"no generation record to attach to "
+                f"(generation={generation!r}) under {self.root}")
+        view = self._adopt(rec)
+        self._ack_generation(view, time.time() + timeout_s)
+        return view
+
+    def _ack_generation(self, view: WorldView, deadline: float) -> None:
+        """Everyone listed in the generation must ack before any member
+        proceeds to jax init — a listed-but-dead host would otherwise
+        hang the distributed handshake. Lease checks are ON: a member
+        dying between the record and its ack triggers re-resize, not a
+        hang. Generous deadline: an ack may be a whole process re-exec
+        (python start + stdlib imports) away. seq=False: members reach
+        a generation's ack along DIFFERENT call paths (join vs resize
+        vs post-exec attach), so a per-name sequence counter would
+        split them across barrier dirs; one fixed dir per generation is
+        the meeting point. A stale pre-exec ballot can at worst let a
+        member proceed to jax.distributed.initialize early, which has
+        its own bounded init timeout."""
+        self.barrier("gen-ack", timeout_s=max(0.0, deadline - time.time()),
+                     scope=view, seq=False)
+
+    def check(self) -> None:
+        """Lease sweep over the current generation; raises HostLostError
+        for the first expired member. The cheap poll the bounded device
+        fences run between waits."""
+        if self.view is None:
+            return
+        alive = self.alive()
+        for h in self.view.hosts:
+            if h != self.host and h not in alive:
+                raise HostLostError(h, self.generation,
+                                    lease_gap_s=self.lease_gap(h))
+
+    def _resize_leader(self, survivors: List[str]) -> str:
+        """Who writes the next generation: the lowest survivor that was
+        IN the current generation (a waiting joiner — alive, compatible,
+        but not yet a member — must not lead a world it has never been
+        part of: it is busy inside join(), not resize(), and electing it
+        would leave the record forever unwritten). Falls back to the
+        lowest survivor when no current member survived."""
+        current = set(self.view.hosts) if self.view is not None else set()
+        incumbents = [h for h in survivors if h in current]
+        return (incumbents or survivors)[0]
+
+    def resize(self, max_attempts: int = 5,
+               settle_s: Optional[float] = None,
+               timeout_s: float = 60.0) -> WorldView:
+        """Move to the next generation with every live, compatible
+        member (losses shrink the world; a waiting joiner grows it).
+
+        Convergent under churn: the new leader (lowest live member)
+        creates gen g+1 with O_EXCL after a settle delay (one heartbeat,
+        so a dying member's lease has a chance to lapse before the
+        membership is frozen); everyone adopts the record and acks.
+        If a *listed* member dies before acking, the ack barrier raises
+        HostLostError and the loop tries g+2 — bounded by
+        `max_attempts`."""
+        settle = self.heartbeat_s if settle_s is None else settle_s
+        for _ in range(max_attempts):
+            g = self.generation + 1
+            rec = self.read_generation(g)
+            if rec is None:
+                time.sleep(settle)
+                survivors = sorted(self._compatible(self.alive()))
+                if not survivors:
+                    raise RendezvousError("no live members to resize with")
+                if self._resize_leader(survivors) == self.host:
+                    self._write_generation(g, survivors)
+                rec = self.read_generation(g)
+            if rec is None:
+                # another host is the leader and has not written yet
+                deadline = time.time() + timeout_s
+                while rec is None and time.time() < deadline:
+                    time.sleep(self.poll_s)
+                    rec = self.read_generation(g)
+                    if rec is None:
+                        survivors = sorted(self._compatible(self.alive()))
+                        if survivors and \
+                                self._resize_leader(survivors) == self.host:
+                            self._write_generation(g, survivors)
+                if rec is None:
+                    raise RendezvousTimeout(
+                        f"generation {g} record never appeared "
+                        f"within {timeout_s:.0f}s")
+            view = self._adopt(rec)
+            try:
+                self._ack_generation(view, time.time() + timeout_s)
+            except HostLostError:
+                # a listed member died mid-resize: bump the generation
+                # counter past the failed record and go again
+                self.generation = int(rec["generation"])
+                continue
+            return view
+        raise RendezvousError(
+            f"membership would not settle after {max_attempts} resize "
+            f"attempts (generation {self.generation})")
+
+    # -- barriers + consensus ----------------------------------------------
+
+    def _barrier_dir(self, name: str, scope: WorldView,
+                     seq: bool = True) -> str:
+        if not seq:
+            return os.path.join(self.root, "barriers",
+                                str(scope.generation), name)
+        n = self._seq.get(name, 0)
+        self._seq[name] = n + 1
+        return os.path.join(self.root, "barriers",
+                            str(scope.generation), f"{name}#{n}")
+
+    def barrier(self, name: str, timeout_s: float = 60.0,
+                payload: Optional[dict] = None,
+                scope: Optional[WorldView] = None,
+                seq: bool = True) -> Dict[str, dict]:
+        """Deadline-bounded, lease-checked barrier over the generation's
+        members. Returns every member's payload. Raises HostLostError
+        the moment a straggler's lease expires (detection within the
+        heartbeat deadline — the property the old jax-collective
+        barriers could not have) and RendezvousTimeout if the deadline
+        passes with everyone still alive (a logic bug — same-name
+        barriers out of step — not a death)."""
+        scope = scope or self.view
+        if scope is None:
+            raise RendezvousError("no world view: join() or attach() first")
+        if scope.world_size == 1:
+            return {self.host: dict(payload or {})}
+        bdir = self._barrier_dir(name, scope, seq=seq)
+        os.makedirs(bdir, exist_ok=True)
+        _atomic_write(os.path.join(bdir, f"{self.host}.json"),
+                      {"host": self.host, "ts": time.time(),
+                       **(payload or {})})
+        deadline = time.time() + timeout_s
+        while True:
+            ballots: Dict[str, dict] = {}
+            for h in scope.hosts:
+                rec = _read_json(os.path.join(bdir, f"{h}.json"))
+                if rec is not None:
+                    ballots[h] = rec
+            if len(ballots) == len(scope.hosts):
+                return ballots
+            alive = self.alive()
+            for h in scope.hosts:
+                if h != self.host and h not in ballots and h not in alive:
+                    # TOCTOU guard: a peer that acked AFTER our ballot
+                    # sweep and then cleanly leave()d (the preflight
+                    # probe's join-then-leave shape) has no lease but
+                    # DID pass the barrier — re-read its ballot before
+                    # declaring a corpse
+                    if _read_json(os.path.join(bdir, f"{h}.json")) \
+                            is not None:
+                        continue  # re-sweep picks it up
+                    raise HostLostError(h, scope.generation,
+                                        detail=f"missed barrier {name!r}",
+                                        lease_gap_s=self.lease_gap(h))
+            if time.time() > deadline:
+                missing = sorted(set(scope.hosts) - set(ballots))
+                raise RendezvousTimeout(
+                    f"barrier {name!r} deadline ({timeout_s:.0f}s) passed "
+                    f"with live stragglers {missing} — barrier callsites "
+                    "are out of step")
+            time.sleep(self.poll_s)
+
+    def agree(self, name: str, flag: bool, timeout_s: float = 60.0) -> bool:
+        """Global OR of a per-host boolean — the preemption-consensus
+        primitive, deadline-bounded. Same discipline as barrier()."""
+        ballots = self.barrier(name, timeout_s=timeout_s,
+                               payload={"flag": bool(flag)})
+        return any(bool(b.get("flag")) for b in ballots.values())
+
+
+class HostSupervisor:
+    """`BackendSupervisor`'s fleet-layer sibling: rendezvous + telemetry.
+
+    Owns the journaling/metrics/flight-breadcrumb side of membership
+    events so the Trainer's control flow stays readable:
+
+        host_lost{host, generation, lease_gap_s}
+        host_joined{host, generation}
+        world_resized{from, to, generation, resume_step}
+
+    plus `rendezvous_generation` / `rendezvous_hosts` gauges and
+    `rendezvous_host_lost_total` / `rendezvous_resizes_total` counters.
+    `bounded_fetch` is the deadline-bounded device fence the train loop
+    uses in place of a bare blocking fetch: a peer SIGKILLed
+    mid-collective leaves this host's fetch wedged in C++ forever, and
+    only a side-channel lease sweep can name the culprit.
+    """
+
+    def __init__(self, rendezvous: Rendezvous, journal=None, registry=None,
+                 fence_poll_s: float = 0.25, resume_step_fn=None,
+                 reshardable: bool = True):
+        self.rdzv = rendezvous
+        self.journal = journal
+        self._registry = registry
+        self.fence_poll_s = float(fence_poll_s)
+        #: () -> Optional[int]: the step a post-resize resume will land on
+        #: (the Trainer wires its CheckpointManager.latest_step here)
+        self.resume_step_fn = resume_step_fn
+        #: input pipeline is a pure function of the generation (host_shard-
+        #: keyed streams, per-host services): a resize journals a typed
+        #: `data_reshard`. The Trainer clears this when an armed snapshot
+        #: loader is attached — byte-identical replay cannot survive a
+        #: resize, and the loader's fingerprint refuses at restore instead.
+        self.reshardable = bool(reshardable)
+        #: the resume_step handle_loss journaled into world_resized —
+        #: callers re-raising WorldResized read THIS instead of
+        #: recomputing (a directory whose latest step changed in between
+        #: would make the journal disagree with the actual resume)
+        self.last_resume_step: Optional[int] = None
+        # exactly-once loss handling: the membership watchdog, an in-band
+        # bounded fence, and fit's confirm_loss path can all detect the
+        # same death within milliseconds of each other — one resize, one
+        # event trail, one re-entry
+        self._claim_lock = threading.Lock()
+        self._claimed = False
+        self._watch_stop = threading.Event()
+        self._watch: Optional[threading.Thread] = None
+        # one persistent fence worker serves the train loop's serial
+        # fetches (two per step — per-call thread spawn would churn
+        # ~20 threads/s); a fetch wedged in a dead collective leaves it
+        # busy, and the rare overlapping call falls back to a one-shot
+        self._fence_lock = threading.Lock()
+        self._fence_q = None
+        self._fence_thread: Optional[threading.Thread] = None
+        self._fence_idle = threading.Event()
+        self._fence_idle.set()
+
+    # -- telemetry plumbing ------------------------------------------------
+
+    def _metric(self, kind: str, name: str, help: str):
+        reg = self._registry
+        if reg is None:
+            from deep_vision_tpu.obs.registry import get_registry
+
+            reg = get_registry()
+        return getattr(reg, kind)(name, help)
+
+    def _write(self, event: str, **fields) -> None:
+        if self.journal is not None:
+            try:
+                self.journal.write(event, **fields)
+            except Exception:
+                pass
+        try:
+            from deep_vision_tpu.obs import flight as _flight
+
+            _flight.note(event, **{k: v for k, v in fields.items()
+                                   if isinstance(v, (str, int, float, bool))})
+        except Exception:
+            pass
+
+    # -- membership events -------------------------------------------------
+
+    def on_host_lost(self, err: HostLostError) -> None:
+        try:
+            self._metric("counter", "rendezvous_host_lost_total",
+                         "member leases expired").inc()
+        except Exception:
+            pass
+        row = {"host": err.host if err.host is not None else "?",
+               "generation": err.generation}
+        if err.lease_gap_s is not None:
+            row["lease_gap_s"] = round(float(err.lease_gap_s), 3)
+        self._write(EVENT_HOST_LOST, **row)
+
+    def on_host_joined(self, host: str, generation: int) -> None:
+        self._write(EVENT_HOST_JOINED, host=host, generation=int(generation))
+
+    def resize(self, resume_step: Optional[int] = None) -> WorldView:
+        """Re-rendezvous at g+1 and journal the membership delta +
+        the typed `world_resized` event. Returns the new view; the
+        caller decides how to re-enter it (reexec, or rebuild in place
+        when no jax distributed world exists)."""
+        old = self.rdzv.view
+        view = self.rdzv.resize()
+        old_hosts = set(old.hosts) if old is not None else set()
+        for h in sorted(set(view.hosts) - old_hosts):
+            if h != view.host:
+                self.on_host_joined(h, view.generation)
+        try:
+            self._metric("counter", "rendezvous_resizes_total",
+                         "generation changes survived").inc()
+            self._metric("gauge", "rendezvous_generation",
+                         "current rendezvous generation").set(view.generation)
+            self._metric("gauge", "rendezvous_hosts",
+                         "live hosts in the current generation").set(
+                             view.world_size)
+        except Exception:
+            pass
+        self._write(
+            EVENT_WORLD_RESIZED,
+            **{"from": len(old_hosts) if old_hosts else 0,
+               "to": view.world_size, "generation": view.generation,
+               "resume_step": int(resume_step)
+               if resume_step is not None else -1})
+        return view
+
+    def journal_data_reshard(self, view: WorldView, from_hosts: int) -> None:
+        """The input-pipeline half of a resize where PR 12's re-derivable
+        sharding CAN follow the world (host_shard()-keyed streams, one
+        data service per host): record the new disjoint+covering slice.
+        Where it cannot (an armed snapshot loader), the restore path
+        refuses with SnapshotMismatch instead — journaled by its own
+        data_resume machinery."""
+        idx, n = view.shard()
+        self._write(EVENT_DATA_RESHARD,
+                    **{"generation": view.generation,
+                       "from": int(from_hosts), "to": view.world_size,
+                       "shard_index": idx, "num_shards": n})
+
+    # -- the bounded device fence ------------------------------------------
+
+    def _fence_body(self):
+        while True:
+            fn, out, done = self._fence_q.get()
+            try:
+                out["value"] = fn()
+            except BaseException as e:  # re-raised on the caller thread
+                out["exc"] = e
+            finally:
+                done.set()
+                self._fence_idle.set()
+
+    def bounded_fetch(self, fn, deadline_s: Optional[float] = None):
+        """Run a blocking device fetch off-thread; between join slices,
+        sweep the lease ledger. A dead peer surfaces as HostLostError
+        within the heartbeat deadline; a merely slow step keeps waiting
+        (compiles are slow, deaths are named) unless `deadline_s` is
+        given. The fetch runs on ONE persistent worker (the train
+        loop's fetches are serial; spawning per call would churn
+        threads every step). A worker left wedged in a dead collective
+        stays wedged — acceptable, because the only exits from there
+        are a resize-and-reexec or a crash — and any overlapping call
+        meanwhile falls back to a one-shot thread."""
+        out: dict = {}
+        done = threading.Event()
+        with self._fence_lock:
+            if self._fence_q is None:
+                import queue as _queue
+
+                self._fence_q = _queue.Queue()
+            if (self._fence_thread is None
+                    or not self._fence_thread.is_alive()):
+                self._fence_thread = threading.Thread(
+                    target=self._fence_body, daemon=True,
+                    name="host-fence-worker")
+                self._fence_thread.start()
+            if self._fence_idle.is_set():
+                self._fence_idle.clear()
+                self._fence_q.put((fn, out, done))
+            else:
+                threading.Thread(
+                    target=lambda: (self._run_oneshot(fn, out, done)),
+                    daemon=True, name="host-bounded-fetch").start()
+        deadline = (time.time() + deadline_s) if deadline_s is not None \
+            else None
+        while not done.wait(self.fence_poll_s):
+            self.rdzv.check()  # raises HostLostError on an expired lease
+            if deadline is not None and time.time() > deadline:
+                raise HostLostError(
+                    None, self.rdzv.generation,
+                    detail=f"device fetch exceeded {deadline_s:.0f}s with "
+                           "every lease fresh")
+        if "exc" in out:
+            raise out["exc"]
+        return out["value"]
+
+    @staticmethod
+    def _run_oneshot(fn, out, done):
+        try:
+            out["value"] = fn()
+        except BaseException as e:
+            out["exc"] = e
+        finally:
+            done.set()
+
+    def confirm_loss(self, exc: Exception,
+                     wait_s: Optional[float] = None) -> Optional[HostLostError]:
+        """Was this exception really a peer dying? A SIGKILLed host's
+        surviving peers see transport errors within milliseconds — often
+        BEFORE the lease expires — so a step failure polls the ledger
+        for up to one lease period before handing the exception to the
+        backend-supervisor path. Returns the typed loss or None."""
+        wait = self.rdzv.lease_s * 1.5 if wait_s is None else wait_s
+        deadline = time.time() + wait
+        while True:
+            try:
+                self.rdzv.check()
+            except HostLostError as lost:
+                return lost
+            if time.time() > deadline:
+                return None
+            time.sleep(self.rdzv.poll_s * 4)
+
+    # -- exactly-once loss handling ----------------------------------------
+
+    def _claim(self) -> bool:
+        with self._claim_lock:
+            if self._claimed:
+                return False
+            self._claimed = True
+            return True
+
+    def handle_loss(self, err: HostLostError) -> WorldView:
+        """The one funnel every detector feeds: journal the typed
+        `host_lost`, re-rendezvous at g+1, journal `world_resized` (and
+        `data_reshard` when the input pipeline re-derives), return the
+        new view. A second detector arriving while the first is mid-
+        resize parks forever — the winner is about to replace this
+        process image, and a duplicate resize/event trail would be
+        worse than a parked thread."""
+        if not self._claim():
+            while True:  # the winning detector's reexec ends this process
+                time.sleep(1.0)
+        try:
+            self.on_host_lost(err)
+            resume_step = None
+            if self.resume_step_fn is not None:
+                try:
+                    resume_step = self.resume_step_fn()
+                except Exception:
+                    resume_step = None
+            self.last_resume_step = resume_step
+            old_n = (self.rdzv.view.world_size
+                     if self.rdzv.view is not None else 0)
+            view = self.resize(resume_step=resume_step)
+        except BaseException:
+            # a FAILED resize must release the claim: the next detector
+            # (watchdog sweep, in-band fence) gets to retry — a held
+            # claim with no winner would park every detector and
+            # re-create the very indefinite hang this module removes
+            with self._claim_lock:
+                self._claimed = False
+            raise
+        if self.reshardable:
+            self.journal_data_reshard(view, from_hosts=old_n)
+        return view
+
+    # -- the membership watchdog -------------------------------------------
+
+    def arm_watchdog(self, poll_s: Optional[float] = None) -> None:
+        """Detection that does not care where the main thread is: a
+        daemon thread sweeps the lease ledger and, on an expired lease,
+        runs the full handle_loss funnel and re-execs the process into
+        the new generation.
+
+        This is not belt-and-braces — it is the PRIMARY detector. A
+        peer SIGKILLed mid-step leaves this host's next jit dispatch
+        blocked in C++ *before* any Python-level fence runs (donated
+        buffers chain each dispatch to the previous step's completion;
+        measured via stack dumps in the host smoke), so no in-band
+        check can be guaranteed to execute again. The watchdog needs
+        only the GIL, which C++ blocks release. The in-band paths
+        (bounded fences, rendezvous barriers) still exist because when
+        the main thread IS healthy they hand fit a clean typed
+        WorldResized instead of an exec mid-epoch."""
+        if self._watch is not None and self._watch.is_alive():
+            return
+        poll = self.fence_poll_s if poll_s is None else float(poll_s)
+        self._watch_stop.clear()
+
+        def body():
+            while not self._watch_stop.wait(poll):
+                try:
+                    self.rdzv.check()
+                except HostLostError as e:
+                    try:
+                        view = self.handle_loss(e)
+                    except Exception:
+                        continue  # resize failed and the claim was
+                        # released: keep sweeping — the next pass (or an
+                        # in-band detector) retries, so a transient
+                        # resize failure never strands the run
+                    self.reexec(view)
+
+        self._watch = threading.Thread(target=body, daemon=True,
+                                       name="rendezvous-watchdog")
+        self._watch.start()
+
+    def disarm_watchdog(self) -> None:
+        """Stop the watchdog (clean shutdown: a completing run must not
+        be exec'd out from under its own teardown)."""
+        self._watch_stop.set()
+        if self._watch is not None:
+            self._watch.join(timeout=5.0)
+            self._watch = None
+
+    # -- re-entry ----------------------------------------------------------
+
+    def reexec(self, view: WorldView, argv: Optional[List[str]] = None):
+        """Replace this process image with itself, parameterized to
+        attach to `view`'s generation (see module docstring for why a
+        wedged rank cannot re-init in place). Renews the lease right
+        before the exec so the re-entry gap is only the exec + python
+        startup; the journal (append mode, flush per line) and the
+        checkpoint (already durable) carry the run across. Never
+        returns."""
+        self.rdzv.touch()
+        env = dict(os.environ)
+        env[ENV_GENERATION] = str(view.generation)
+        import sys
+
+        argv = list(argv) if argv is not None else [sys.executable] + sys.argv
+        os.execve(argv[0], argv, env)

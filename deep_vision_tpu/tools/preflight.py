@@ -2,6 +2,7 @@
 
     python -m deep_vision_tpu.tools.preflight [--ckpt-dir DIR]
         [--mesh-data N] [--mesh-model M] [--expect-devices N]
+        [--expect-hosts N --rendezvous-dir DIR [--host-id ID]]
         [--budget SECONDS] [--json]
 
 Every accelerator-layer failure in the repo's own run history burned
@@ -30,6 +31,17 @@ fired at rc=124. This preflight front-loads those verdicts:
                     same tmp+fsync+rename shape the crc32c sidecar uses:
                     a read-only or mis-mounted volume fails before the
                     first epoch trains into an unsaveable run.
+  rendezvous        with --expect-hosts: join the elastic rendezvous
+                    (resilience/rendezvous.py) and run the join-time
+                    client-version/platform-version exchange through
+                    the coordinator. A version-skewed joiner — the
+                    MULTICHIP_r01 failure, where a stale host burned 4
+                    minutes of everyone's compile before dying — is
+                    refused HERE, in seconds, with kind `version_skew`,
+                    never admitted into a generation; a world that
+                    cannot assemble --expect-hosts compatible members
+                    within the budget fails as `timeout` naming who
+                    showed up.
 
 Runnable standalone (`make preflight`; exit 0 pass / 1 fail, one line
 per check) and as the first act of `train_cli` (--skip-preflight opts
@@ -168,6 +180,76 @@ def check_ckpt_dir(path: str) -> CheckResult:
     return CheckResult("ckpt_dir", True, f"{path} writable (tmp+fsync+rename)")
 
 
+def host_versions() -> dict:
+    """This host's side of the join-time version exchange: the jax/jaxlib
+    client pair plus the backend's platform_version string (on TPU, the
+    libtpu build the MULTICHIP_r01 skew error quoted). Pure dict so the
+    handshake comparison (`rendezvous.versions_compatible`) is
+    unit-testable with fabricated values."""
+    out = {}
+    try:
+        import jax
+        import jaxlib
+
+        out["client_version"] = f"jax {jax.__version__}, " \
+                                f"jaxlib {jaxlib.__version__}"
+        devs = jax.devices()
+        pv = str(getattr(getattr(devs[0], "client", None),
+                         "platform_version", "") or "")
+        if pv:
+            out["platform_version"] = pv.splitlines()[0]
+    except Exception:
+        pass  # version-less members compare compatible (fail open on
+        # missing introspection, closed on an actual mismatch)
+    return out
+
+
+def check_rendezvous(expect_hosts: int, rendezvous_dir: str,
+                     host_id: Optional[str] = None,
+                     budget_s: float = DEFAULT_BUDGET_S,
+                     versions: Optional[dict] = None) -> CheckResult:
+    """Join the elastic rendezvous and run the version handshake.
+
+    The joiner writes its member record (client + platform versions
+    embedded), and the incumbent world's reference versions are compared
+    on every poll: a skew is refused in seconds as `version_skew` — the
+    preflight teeth for the one backend failure `BackendSupervisor`
+    correctly refuses to retry. On success the probe LEAVES again (drops
+    its member record): preflight must not squat a membership slot the
+    real run is about to claim."""
+    from deep_vision_tpu.resilience.rendezvous import (
+        HostLostError,
+        Rendezvous,
+        RendezvousError,
+        RendezvousRefused,
+        RendezvousTimeout,
+    )
+
+    versions = host_versions() if versions is None else versions
+    host_id = host_id or f"preflight-{os.uname().nodename}-{os.getpid()}"
+    r = Rendezvous(rendezvous_dir, host_id,
+                   client_version=versions.get("client_version"),
+                   platform_version=versions.get("platform_version"))
+    try:
+        view = r.join(expect_hosts=expect_hosts, timeout_s=budget_s)
+    except RendezvousRefused as e:
+        return CheckResult("rendezvous", False, str(e), kind=e.kind)
+    except RendezvousTimeout as e:
+        return CheckResult("rendezvous", False, str(e), kind="timeout")
+    except RendezvousError as e:
+        # e.g. HostLostError: a probe peer died mid-assembly — still a
+        # one-line failed check, never an unhandled traceback breaking
+        # preflight's exit-0/1 contract
+        kind = "host_lost" if isinstance(e, HostLostError) else ""
+        return CheckResult("rendezvous", False, str(e), kind=kind)
+    finally:
+        r.leave()
+    return CheckResult(
+        "rendezvous", True,
+        f"world of {view.world_size} assembled at generation "
+        f"{view.generation} (rank {view.rank}, versions agree)")
+
+
 # -- the runner ----------------------------------------------------------------
 
 def run_preflight(data: int = -1, model: int = 1,
@@ -175,6 +257,9 @@ def run_preflight(data: int = -1, model: int = 1,
                   ckpt_dir: Optional[str] = None,
                   budget_s: float = DEFAULT_BUDGET_S,
                   probe: Optional[Callable] = None,
+                  expect_hosts: Optional[int] = None,
+                  rendezvous_dir: Optional[str] = None,
+                  host_id: Optional[str] = None,
                   journal=None) -> Tuple[bool, List[CheckResult]]:
     """Run every applicable check; returns (all_ok, results).
 
@@ -199,6 +284,18 @@ def run_preflight(data: int = -1, model: int = 1,
             expect_devices=expect_devices)
     if ckpt_dir:
         run(check_ckpt_dir, ckpt_dir)
+    if expect_hosts is not None:
+        if not rendezvous_dir:
+            results.append(CheckResult(
+                "rendezvous", False,
+                "--expect-hosts needs --rendezvous-dir (the shared "
+                "coordination directory every host mounts)"))
+        elif backend.ok:
+            # version exchange needs the backend's platform_version (the
+            # terminal half of the handshake); a dead backend already
+            # failed above and would only cascade here
+            run(check_rendezvous, expect_hosts, rendezvous_dir,
+                host_id=host_id, budget_s=budget_s)
     ok = all(r.ok for r in results)
     if journal is not None:
         try:
@@ -227,6 +324,15 @@ def main(argv: Optional[List[str]] = None) -> int:
                    help="requested model-axis size")
     p.add_argument("--expect-devices", type=int, default=None,
                    help="fail unless exactly this many devices are live")
+    p.add_argument("--expect-hosts", type=int, default=None,
+                   help="join the elastic rendezvous and fail unless this "
+                        "many version-compatible hosts assemble (a skewed "
+                        "joiner is refused as version_skew in seconds)")
+    p.add_argument("--rendezvous-dir", default=None,
+                   help="shared rendezvous directory (with --expect-hosts)")
+    p.add_argument("--host-id", default=None,
+                   help="this host's rendezvous member id (default: a "
+                        "probe-scoped id that leaves after the check)")
     p.add_argument("--budget", type=float, default=DEFAULT_BUDGET_S,
                    help="seconds the backend probe may take before the "
                         "tunnel is declared dead")
@@ -236,7 +342,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     ok, results = run_preflight(
         data=args.mesh_data, model=args.mesh_model,
         expect_devices=args.expect_devices, ckpt_dir=args.ckpt_dir,
-        budget_s=args.budget,
+        budget_s=args.budget, expect_hosts=args.expect_hosts,
+        rendezvous_dir=args.rendezvous_dir, host_id=args.host_id,
     )
     render(results)
     if args.json:

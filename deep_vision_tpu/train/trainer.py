@@ -39,6 +39,7 @@ from deep_vision_tpu.parallel.mesh import (
     shard_batch,
     stacked_data_sharding,
 )
+from deep_vision_tpu.resilience.rendezvous import HostLostError, WorldResized
 
 # one shared jitted sum: evaluate() calls it per masked multi-host batch,
 # and a fresh jax.jit wrapper there would retrace every time
@@ -95,6 +96,7 @@ class Trainer:
         device_prefetch: int = 0,  # device-resident batch buffer depth
         backend_supervisor=None,  # resilience.BackendSupervisor or None
         data_loader=None,  # snapshot-capable DataLoader (data/snapshot.py)
+        host_supervisor=None,  # resilience.rendezvous.HostSupervisor or None
     ):
         self.mesh = mesh if mesh is not None else create_mesh()
         self.model = model  # single source of truth for summaries/export
@@ -171,6 +173,39 @@ class Trainer:
             self.backend.journal = journal
             if self.backend.policy.journal is None:
                 self.backend.policy.journal = journal
+        # host-membership supervision (resilience/rendezvous.py): with a
+        # HostSupervisor installed, a peer host dying mid-run is an
+        # EXPECTED input — the blocking device fetches below become
+        # lease-checked bounded fences (a SIGKILLed peer leaves this
+        # host's fetch wedged in C++ forever; only a side-channel lease
+        # sweep can name it), and fit() turns the typed HostLostError
+        # into host_lost/world_resized journal events + a re-rendezvous
+        # at generation g+1, raised to the host agent as WorldResized.
+        self.hosts = host_supervisor
+        if self.hosts is not None:
+            if self.hosts.journal is None:
+                self.hosts.journal = journal
+            if self.hosts.resume_step_fn is None and checkpoint_manager \
+                    is not None:
+                # what a post-resize resume will land on: the last step
+                # the checkpoint layer holds (a directory read — safe
+                # from the supervisor's watchdog thread)
+                self.hosts.resume_step_fn = checkpoint_manager.latest_step
+            if data_loader is not None:
+                # an armed snapshot loader pins the OLD host-shard slice
+                # in its fingerprint: the restore refuses the resize
+                # (SnapshotMismatch) instead of journaling data_reshard.
+                # A loader built WITHOUT a host_shard gets this world's
+                # slice stamped here — otherwise the fingerprints match
+                # across a resize and the refusal can never fire.
+                self.hosts.reshardable = False
+                view = getattr(self.hosts.rdzv, "view", None)
+                if view is not None and \
+                        getattr(data_loader, "host_shard", 0) is None:
+                    try:
+                        data_loader.pin_host_shard(view.shard())
+                    except Exception:
+                        pass  # already fingerprinted: identity is fixed
         self._tx = tx
         self._sample_input = sample_input
         self._init_rng = rng
@@ -612,6 +647,8 @@ class Trainer:
         )
         self._closed = False  # fit may be re-entered after a close()
         self.preempted = False  # re-armed per fit: the latch reports THIS run
+        self._resizing = False  # latched by _handle_host_loss: gates the
+        # finally-block device waits below
         if self.health is not None:
             self.health.start_watchdog()  # no-op without a timeout
         import contextlib
@@ -641,7 +678,25 @@ class Trainer:
                             attempt = 0
                     except (KeyboardInterrupt, SystemExit):
                         raise
+                    except HostLostError as e:
+                        # a peer HOST died (lease expired at a bounded
+                        # fence / rendezvous barrier): journal, re-
+                        # rendezvous at g+1, hand the new world to the
+                        # host agent — never the backend path, which
+                        # would rebuild-and-replay into the same dead
+                        # collective
+                        self._handle_host_loss(e)
                     except Exception as e:
+                        # a SIGKILLed peer often surfaces as a transport
+                        # error (gloo/ICI 'connection closed') MILLI-
+                        # seconds before its lease expires: give the
+                        # lease ledger one period to name a corpse
+                        # before treating this as a backend/program
+                        # failure
+                        if self.hosts is not None:
+                            lost = self.hosts.confirm_loss(e)
+                            if lost is not None:
+                                self._handle_host_loss(lost)
                         # backend-loss detection + rebuild-replay (the
                         # choreography bench.py prototyped, lifted here):
                         # only failures the supervisor classifies as a
@@ -658,10 +713,15 @@ class Trainer:
         finally:
             self._pguard = None
             self._stop_trace()  # stop gate never reached (short run)
-            if self.ckpt is not None:
-                self.ckpt.wait()
-            if self._ema_ckpt is not None:
-                self._ema_ckpt.wait()
+            # NOT while a world resize is propagating: an async save's
+            # device fetch may be wedged in the very collective that
+            # just died, and wait() has no deadline — the re-exec'd
+            # process re-reads whatever the last COMPLETED save left
+            if not self._resizing:
+                if self.ckpt is not None:
+                    self.ckpt.wait()
+                if self._ema_ckpt is not None:
+                    self._ema_ckpt.wait()
         return self.state
 
     def _save_checkpoint(self, epoch: int, val_summary=None) -> bool:
@@ -726,6 +786,48 @@ class Trainer:
                              "replaying from scratch",
                 epoch=int(fallback_epoch))
         return fallback_epoch
+
+    def _host_fetch(self, fn):
+        """Blocking device fetch, lease-checked when a HostSupervisor is
+        installed: a peer SIGKILLed mid-collective wedges this host's
+        fetch in C++ with no exception — the bounded fence polls the
+        rendezvous lease ledger between waits and raises the typed
+        HostLostError the fit loop supervises. Without a supervisor,
+        the plain fetch (single-host runs pay nothing)."""
+        if self.hosts is None:
+            return fn()
+        return self.hosts.bounded_fetch(fn)
+
+    def _handle_host_loss(self, err: HostLostError):
+        """The elastic ladder for host churn: typed `host_lost` event →
+        re-rendezvous at generation g+1 with the survivors → typed
+        `world_resized{from,to,generation,resume_step}` → hand the new
+        world to the host agent as WorldResized.
+
+        Why raise instead of rebuilding in place: this rank may be (and
+        after a mid-collective SIGKILL, IS) wedged inside a dead gloo/
+        ICI op; `jax.distributed` cannot re-initialize in-process and
+        its coordination client terminates the process when it notices
+        the corpse (rendezvous.py module docstring). The host agent
+        re-execs into the new generation — same process slot, same
+        append-mode journal — and `resume()` continues from
+        `resume_step` via the PR 10 cross-mesh restore. No new
+        checkpoint is attempted here: a save would fetch device state
+        through the very collective that just died.
+        """
+        if self.hosts is None:
+            raise err
+        self._resizing = True  # fit's finally must not block on device
+        # waits that may ride the dead collective
+        self._stop_trace()
+        # the exactly-once funnel: journals host_lost + world_resized
+        # (+ data_reshard when the input re-derives), resizes at g+1. If
+        # the supervisor's watchdog won the race, this parks until its
+        # reexec replaces the process.
+        view = self.hosts.handle_loss(err)
+        # the step handle_loss journaled, not a fresh latest_step() read:
+        # the postmortem timeline and the actual resume must agree
+        raise WorldResized(view, resume_step=self.hosts.last_resume_step)
 
     def _preempt_save(self, epoch: int) -> None:
         """The SIGTERM escalation ladder's final rung: checkpoint-now-and-
@@ -817,12 +919,14 @@ class Trainer:
         with span("train/step", epoch=epoch) as sp:
             with self.clock.step(batch_size=n, auto_commit=False) as rec:
                 metrics = self.train_step(batch)
-                rec.fence_on(metrics)
+                self._host_fetch(lambda: rec.fence_on(metrics))
             # these fetches block on the in-flight state — outside the
             # with-block so dispatch_ms stays enqueue-only (the
             # starvation signal compares data_wait against it);
-            # commit() folds their cost into step_time_ms
-            opt_step = int(self.state.step)
+            # commit() folds their cost into step_time_ms. Lease-checked
+            # (_host_fetch): in a multi-host world a dead peer wedges
+            # them forever otherwise.
+            opt_step = self._host_fetch(lambda: int(self.state.step))
             lr = self.lr_at(opt_step)
             sp.set(step=opt_step)
             rec.commit(step=opt_step,
@@ -879,8 +983,8 @@ class Trainer:
             with self.clock.step(batch_size=n_total,
                                  auto_commit=False) as rec:
                 metrics_k = self.train_superstep(item)
-                rec.fence_on(metrics_k)
-            opt_step = int(self.state.step)
+                self._host_fetch(lambda: rec.fence_on(metrics_k))
+            opt_step = self._host_fetch(lambda: int(self.state.step))
             lr = self.lr_at(opt_step)
             sp.set(step=opt_step, multistep=k)
             last = metrics_k[-1]

@@ -1,0 +1,928 @@
+"""Elastic multi-host rendezvous (resilience/rendezvous.py + the
+multihost.py overlay): membership leases, deadline-bounded collectives,
+generation resize, version-skew refusal at join, and the world-routed
+topology reads — host churn as an expected input, proven at thread
+scale (the process-scale proof is `make host-smoke`)."""
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from deep_vision_tpu.resilience.rendezvous import (
+    ENV_GENERATION,
+    HostLostError,
+    HostSupervisor,
+    Rendezvous,
+    RendezvousRefused,
+    RendezvousTimeout,
+    WorldResized,
+    WorldView,
+    versions_compatible,
+)
+
+FAST = dict(heartbeat_s=0.1, poll_s=0.01)
+
+
+def join_world(root, hosts, expect=None, timeout_s=20.0, **kw):
+    """Join `hosts` concurrently (threads); returns {host: (rdzv, view)}."""
+    expect = expect if expect is not None else len(hosts)
+    out, errs = {}, {}
+
+    def run(h):
+        r = Rendezvous(root, h, **FAST, **kw)
+        try:
+            out[h] = (r, r.join(expect_hosts=expect, timeout_s=timeout_s))
+        except Exception as e:
+            errs[h] = e
+
+    ts = [threading.Thread(target=run, args=(h,)) for h in hosts]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout_s + 10)
+    return out, errs
+
+
+class FakeJournal:
+    def __init__(self):
+        self.rows = []
+
+    def write(self, event, **fields):
+        self.rows.append({"event": event, **fields})
+
+    def of(self, event):
+        return [r for r in self.rows if r["event"] == event]
+
+
+# -- WorldView + version handshake (pure) --------------------------------------
+
+class TestWorldView:
+    def test_dense_ranks_and_shard(self):
+        v = WorldView(generation=2, hosts=("a", "b", "c"), host="b")
+        assert (v.rank, v.world_size) == (1, 3)
+        assert v.shard() == (1, 3)
+        assert WorldView(2, ("a", "c"), "c").shard() == (1, 2)
+
+    def test_versions_compatible(self):
+        ok, _ = versions_compatible({"client_version": "x"},
+                                    {"client_version": "x"})
+        assert ok
+        ok, detail = versions_compatible({"client_version": "x"},
+                                         {"client_version": "y"})
+        assert not ok and "client_version" in detail
+        # a side that reports nothing is not a mismatch (fail open on
+        # missing introspection, closed on a real disagreement)
+        ok, _ = versions_compatible({}, {"client_version": "x"})
+        assert ok
+        assert versions_compatible({"platform_version": "a"},
+                                   {"platform_version": "b"})[0] is False
+
+
+# -- join / membership / barriers ----------------------------------------------
+
+class TestJoin:
+    def test_three_hosts_form_generation_zero(self, tmp_path):
+        out, errs = join_world(str(tmp_path), ["h0", "h1", "h2"])
+        assert not errs
+        views = {h: v for h, (_, v) in out.items()}
+        assert all(v.generation == 0 for v in views.values())
+        assert all(v.hosts == ("h0", "h1", "h2") for v in views.values())
+        assert [views[f"h{i}"].rank for i in range(3)] == [0, 1, 2]
+        assert all(v.coordinator for v in views.values())
+        for r, _ in out.values():
+            r.leave()
+
+    def test_join_timeout_names_who_showed_up(self, tmp_path):
+        r = Rendezvous(str(tmp_path), "only", **FAST)
+        with pytest.raises(RendezvousTimeout) as ei:
+            r.join(expect_hosts=2, timeout_s=0.5)
+        assert "only" in str(ei.value)
+
+    def test_version_skewed_joiner_refused_in_seconds(self, tmp_path):
+        incumbent = Rendezvous(str(tmp_path), "good", **FAST,
+                               client_version="jax 0.4.37")
+        incumbent.start_heartbeat()
+        skewed = Rendezvous(str(tmp_path), "stale", **FAST,
+                            client_version="jax 0.3.25")
+        t0 = time.time()
+        with pytest.raises(RendezvousRefused) as ei:
+            skewed.join(expect_hosts=2, timeout_s=30.0)
+        assert ei.value.kind == "version_skew"
+        # refused by the handshake, not by burning the join deadline
+        assert time.time() - t0 < 5.0
+        # the refusal ledger records why this host never made a world
+        refusal = json.load(open(tmp_path / "refused" / "stale.json"))
+        assert refusal["kind"] == "version_skew"
+        incumbent.leave()
+
+    def test_skewed_host_joining_first_does_not_poison_the_world(
+            self, tmp_path):
+        """The version reference is the MAJORITY, not merely the
+        earliest joiner: a stale host that happens to write its member
+        record first must be the one refused — not trick every correct
+        host into self-refusing."""
+        stale = Rendezvous(str(tmp_path), "aa-stale-but-first", **FAST,
+                           client_version="jax 0.3")
+        stale.start_heartbeat()
+        time.sleep(2 * FAST["heartbeat_s"])  # it is unambiguously first
+        out, errs = join_world(str(tmp_path), ["m", "n"], expect=2,
+                               client_version="jax 0.4")
+        assert not errs, errs
+        for _, v in out.values():
+            assert v.hosts == ("m", "n")
+        refusal = json.load(
+            open(tmp_path / "refused" / "aa-stale-but-first.json"))
+        assert refusal["kind"] == "version_skew"
+        for r, _ in out.values():
+            r.leave()
+        stale.leave()
+
+    def test_fresh_fleet_over_stale_records_forms_next_generation(
+            self, tmp_path):
+        # yesterday's run left gen/0.json + dead member records: a
+        # re-joining fleet (same host ids!) must form generation 1, not
+        # adopt the stale record with its dead coordinator
+        out, errs = join_world(str(tmp_path), ["a", "b"])
+        assert not errs
+        gen0_coord = out["a"][1].coordinator
+        for r, _ in out.values():
+            r._hb_stop.set()  # the whole world dies (leases lapse,
+            # member files remain — the SIGKILL shape)
+        time.sleep(4 * FAST["heartbeat_s"])
+        out2, errs2 = join_world(str(tmp_path), ["a", "b"])
+        assert not errs2, errs2
+        for _, v in out2.values():
+            assert v.generation == 1
+            assert v.coordinator != gen0_coord
+        for r, _ in out2.values():
+            r.leave()
+
+    def test_joiner_grows_a_running_world_at_the_next_resize(
+            self, tmp_path):
+        # the host_joined path: a new host's join() waits (never
+        # overwrites the running world); the incumbents' next resize()
+        # adopts every live compatible member, joiner included
+        out, errs = join_world(str(tmp_path), ["b", "c"])
+        assert not errs
+        joined = {}
+
+        def late_join():
+            r = Rendezvous(str(tmp_path), "a", **FAST)  # sorts FIRST:
+            # a waiting joiner must also never be elected resize leader
+            joined["a"] = (r, r.join(expect_hosts=3, timeout_s=20))
+
+        tj = threading.Thread(target=late_join)
+        tj.start()
+        time.sleep(3 * FAST["heartbeat_s"])  # joiner is waiting, world
+        assert "a" not in joined             # untouched
+        res = {}
+
+        def rs(h):
+            res[h] = out[h][0].resize()
+
+        ts = [threading.Thread(target=rs, args=(h,)) for h in ("b", "c")]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(30)
+        tj.join(30)
+        # record order is RANK order, leader first: rank 0 must be the
+        # incumbent that allocated (and can bind) the coordinator port,
+        # never the lexicographically-lower joiner
+        assert res["b"].hosts == ("b", "a", "c")
+        assert res["b"].rank == 0
+        assert joined["a"][1].hosts == ("b", "a", "c")
+        assert joined["a"][1].rank == 1
+        assert joined["a"][1].generation == res["b"].generation == 1
+        for h in ("b", "c"):
+            out[h][0].leave()
+        joined["a"][0].leave()
+
+    def test_attached_survivors_still_read_as_a_running_world(
+            self, tmp_path, monkeypatch):
+        # a post-reexec attach re-stamps the process's construction
+        # time, but _adopt clamps joined_ts back to the record: a
+        # replacement joiner must WAIT for a resize, not decide the
+        # world is dead and squat the next generation
+        out, errs = join_world(str(tmp_path), ["b", "c"])
+        assert not errs
+        monkeypatch.setenv(ENV_GENERATION, "0")
+        fresh = {}
+
+        def reattach(h):
+            r = Rendezvous(str(tmp_path), h, **FAST)  # joined_ts = now,
+            fresh[h] = r                              # AFTER the record
+            r.attach(timeout_s=10)
+
+        ts = [threading.Thread(target=reattach, args=(h,))
+              for h in ("b", "c")]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(15)
+        monkeypatch.delenv(ENV_GENERATION)
+        joiner = Rendezvous(str(tmp_path), "a", **FAST)
+        with pytest.raises(RendezvousTimeout):
+            joiner.join(expect_hosts=3, timeout_s=1.0)
+        assert joiner.read_generation(1) is None  # no squatted record
+        for r, _ in out.values():
+            r.leave()
+        for r in fresh.values():
+            r.leave()
+
+    def test_dead_fleets_stale_records_do_not_vote_on_versions(
+            self, tmp_path):
+        # a crashed 3-host run on old versions leaves stale member
+        # records; the fresh 2-host fleet on NEW versions must not let
+        # the corpses out-vote it into self-refusal
+        for i in range(3):
+            old = Rendezvous(str(tmp_path), f"dead{i}", **FAST,
+                             client_version="jax OLD")
+            old._joined_ts = time.time() - 100
+            old.touch()  # record on disk, lease long lapsed
+        time.sleep(4 * FAST["heartbeat_s"])
+        out, errs = join_world(str(tmp_path), ["x", "y"], expect=2,
+                               client_version="jax NEW")
+        assert not errs, errs
+        for r, _ in out.values():
+            r.leave()
+
+    def test_refusal_marker_retires_after_the_host_is_fixed(self, tmp_path):
+        # refused once for skew, upgraded, relaunched under the SAME id:
+        # the stale marker must retire, not ban the id forever
+        incumbent = Rendezvous(str(tmp_path), "good", **FAST,
+                               client_version="v2")
+        incumbent.start_heartbeat()
+        stale = Rendezvous(str(tmp_path), "flaky", **FAST,
+                           client_version="v1")
+        with pytest.raises(RendezvousRefused):
+            stale.join(expect_hosts=2, timeout_s=10)
+        fixed = {}
+
+        def rejoin():
+            r = Rendezvous(str(tmp_path), "flaky", **FAST,
+                           client_version="v2")
+            fixed["view"] = r.join(expect_hosts=2, timeout_s=20)
+            fixed["r"] = r
+
+        tw = threading.Thread(target=rejoin)
+        tw.start()
+        # the incumbent forms the world with the fixed host
+        inc = {}
+
+        def inc_join():
+            inc["view"] = incumbent.join(expect_hosts=2, timeout_s=20)
+
+        ti = threading.Thread(target=inc_join)
+        ti.start()
+        tw.join(30)
+        ti.join(30)
+        assert set(fixed["view"].hosts) == {"good", "flaky"}
+        incumbent.leave()
+        fixed["r"].leave()
+
+    def test_leader_excludes_skewed_member_that_skipped_self_check(
+            self, tmp_path):
+        # the skewed member's record is on disk but it never ran the
+        # self-check (a buggy/old joiner): the leader's compatible-set
+        # filter must exclude it AND leave the refusal marker. The
+        # version reference is the EARLIEST joiner (the incumbent
+        # world), so the late skewed record loses.
+        r = Rendezvous(str(tmp_path), "a", **FAST, client_version="v1")
+        members = {
+            "a": {"host": "a", "ts": time.time(), "joined_ts": 1.0,
+                  "client_version": "v1"},
+            "b": {"host": "b", "ts": time.time(), "joined_ts": 2.0,
+                  "client_version": "v1"},
+            "z": {"host": "z", "ts": time.time(), "joined_ts": 3.0,
+                  "client_version": "v2-skewed"},
+        }
+        compat = r._compatible(members)
+        assert sorted(compat) == ["a", "b"]
+        refusal = json.load(open(tmp_path / "refused" / "z.json"))
+        assert refusal["kind"] == "version_skew"
+
+
+class TestBarriers:
+    def test_agree_is_global_or_and_reusable(self, tmp_path):
+        out, errs = join_world(str(tmp_path), ["a", "b"])
+        assert not errs
+        for flags, want in [((True, False), True), ((False, False), False)]:
+            res = {}
+
+            def run(h, f):
+                res[h] = out[h][0].agree("stop", f, timeout_s=10)
+
+            ts = [threading.Thread(target=run, args=(h, f))
+                  for h, f in zip(("a", "b"), flags)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(15)
+            assert res == {"a": want, "b": want}
+        for r, _ in out.values():
+            r.leave()
+
+    def test_dead_peer_yields_host_lost_not_hang(self, tmp_path):
+        """THE acceptance property: a barrier with a dead peer raises a
+        typed HostLostError within the heartbeat deadline — never an
+        indefinite hang ended by a watchdog dump."""
+        out, errs = join_world(str(tmp_path), ["a", "b"])
+        assert not errs
+        ra, rb = out["a"][0], out["b"][0]
+        rb._hb_stop.set()  # the SIGKILL stand-in: heartbeats stop dead
+        t0 = time.time()
+        with pytest.raises(HostLostError) as ei:
+            ra.barrier("after-death", timeout_s=30.0)
+        elapsed = time.time() - t0
+        assert ei.value.host == "b"
+        assert ei.value.generation == 0
+        # within the lease deadline (0.3s) + poll slack, nowhere near
+        # the 30s barrier deadline
+        assert elapsed < 5.0
+        ra.leave()
+
+    def test_live_stragglers_hit_the_deadline_as_timeout(self, tmp_path):
+        # everyone alive but out of step = a logic bug, typed as timeout
+        out, errs = join_world(str(tmp_path), ["a", "b"])
+        assert not errs
+        with pytest.raises(RendezvousTimeout):
+            out["a"][0].barrier("nobody-else-comes", timeout_s=0.5)
+        for r, _ in out.values():
+            r.leave()
+
+    def test_check_names_the_corpse_with_lease_gap(self, tmp_path):
+        out, errs = join_world(str(tmp_path), ["a", "b"])
+        assert not errs
+        ra, rb = out["a"][0], out["b"][0]
+        ra.check()  # everyone alive: clean
+        rb._hb_stop.set()
+        time.sleep(4 * FAST["heartbeat_s"])
+        with pytest.raises(HostLostError) as ei:
+            ra.check()
+        assert ei.value.host == "b"
+        assert ei.value.lease_gap_s is not None
+        assert ei.value.lease_gap_s > 0
+        ra.leave()
+
+
+# -- resize: the N -> M contract -----------------------------------------------
+
+class TestResize:
+    def test_three_to_two_re_ranks_densely(self, tmp_path):
+        out, errs = join_world(str(tmp_path), ["h0", "h1", "h2"])
+        assert not errs
+        out["h1"][0]._hb_stop.set()  # kill the MIDDLE host: h2 must
+        time.sleep(4 * FAST["heartbeat_s"])  # re-rank 2 -> 1
+        res = {}
+
+        def run(h):
+            res[h] = out[h][0].resize()
+
+        ts = [threading.Thread(target=run, args=(h,)) for h in ("h0", "h2")]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(30)
+        assert res["h0"].generation == 1 and res["h2"].generation == 1
+        assert res["h0"].hosts == ("h0", "h2")
+        assert res["h0"].rank == 0 and res["h2"].rank == 1
+        # fresh coordinator per generation: the old leader's dead port
+        # must not be re-dialed
+        assert res["h0"].coordinator == res["h2"].coordinator
+        for h in ("h0", "h2"):
+            out[h][0].leave()
+
+    def test_resize_rederives_disjoint_covering_shards_and_batch(
+            self, tmp_path):
+        """Satellite regression: a 3->2 resize re-derives host_shard /
+        per_host_batch_size from the NEW world (the fixed-world
+        process_count() read is gone)."""
+        from deep_vision_tpu.parallel import multihost as mh
+
+        try:
+            shards_by_gen = {}
+            for gen, hosts in [(0, ("h0", "h1", "h2")), (1, ("h0", "h2"))]:
+                shards = []
+                for h in hosts:
+                    mh.install_world(WorldView(gen, hosts, h))
+                    assert mh.process_count() == len(hosts)
+                    shards.append(mh.host_shard())
+                    # global batch 12 redistributes exactly
+                    assert mh.per_host_batch_size(12) == 12 // len(hosts)
+                shards_by_gen[gen] = shards
+            assert shards_by_gen[0] == [(0, 3), (1, 3), (2, 3)]
+            assert shards_by_gen[1] == [(0, 2), (1, 2)]
+            # disjoint + covering at both worlds
+            for gen, shards in shards_by_gen.items():
+                assert sorted(s[0] for s in shards) == list(
+                    range(len(shards)))
+        finally:
+            mh.clear_world()
+
+    def test_indivisible_batch_after_resize_is_loud(self):
+        from deep_vision_tpu.parallel import multihost as mh
+
+        try:
+            mh.install_world(WorldView(1, ("a", "b"), "a"))
+            with pytest.raises(ValueError):
+                mh.per_host_batch_size(13)
+        finally:
+            mh.clear_world()
+
+
+# -- shard_for_host under world resize (property) ------------------------------
+
+class TestShardForHostResize:
+    def test_disjoint_and_covering_for_any_world_size(self):
+        from deep_vision_tpu.data.service import shard_for_host
+
+        files = [f"shard-{i:05d}" for i in range(23)]
+        for n in range(1, 8):
+            slices = [shard_for_host(h, n, files) for h in range(n)]
+            flat = [f for s in slices for f in s]
+            assert len(flat) == len(set(flat)) == len(files), n
+            assert set(flat) == set(files), n
+
+    def test_resize_keeps_the_invariant_at_every_m(self):
+        from deep_vision_tpu.data.service import shard_for_host
+
+        files = [f"shard-{i:05d}" for i in range(17)]
+        for n in (2, 3, 5):
+            for m in (1, 2, 3, 4, 6):
+                if m == n:
+                    continue
+                # world resized N -> M: the NEW assignment must stand on
+                # its own — disjoint and covering with no reference to
+                # the old generation's slices
+                new = [shard_for_host(h, m, files) for h in range(m)]
+                flat = [f for s in new for f in s]
+                assert sorted(flat) == sorted(files), (n, m)
+
+    def test_index_form_matches_multihost_contract(self):
+        from deep_vision_tpu.data.service import shard_for_host
+
+        assert shard_for_host(1, 2) == (1, 2)
+        with pytest.raises(ValueError):
+            shard_for_host(2, 2)
+        with pytest.raises(ValueError):
+            shard_for_host(0, 0)
+
+
+# -- DataLoaderState across a resize -------------------------------------------
+
+class TestSnapshotAcrossResize:
+    def _loader(self, host_shard):
+        from deep_vision_tpu.data.pipeline import DataLoader
+
+        data = [{"x": float(i)} for i in range(32)]
+        return DataLoader(data, batch_size=4, seed=7, host_shard=host_shard)
+
+    def test_snapshot_refuses_restore_at_different_world(self):
+        from deep_vision_tpu.data.snapshot import SnapshotMismatch
+
+        a = self._loader((0, 3))
+        a.enable_snapshots()
+        state = a.state_dict()
+        # same world restores; a resized world refuses LOUDLY
+        self._loader((0, 3)).load_state_dict(state)
+        with pytest.raises(SnapshotMismatch):
+            self._loader((0, 2)).load_state_dict(state)
+        with pytest.raises(SnapshotMismatch):
+            self._loader((1, 3)).load_state_dict(state)
+
+    def test_fingerprint_includes_host_shard_slice(self):
+        from deep_vision_tpu.data.snapshot import fingerprint
+
+        data = [{"x": 1.0}]
+        base = fingerprint(data, 4, 0)
+        assert fingerprint(data, 4, 0, host_shard=(0, 3)) != base
+        assert fingerprint(data, 4, 0, host_shard=(0, 3)) != \
+            fingerprint(data, 4, 0, host_shard=(0, 2))
+        assert fingerprint(data, 4, 0, host_shard=(0, 3)) == \
+            fingerprint(data, 4, 0, host_shard=(0, 3))
+
+
+# -- deadline-bounded collectives (the no-unbounded-block contract) ------------
+
+class TestBoundedCollectives:
+    def test_blocked_collective_raises_typed_host_lost(self):
+        from deep_vision_tpu.parallel.multihost import _bounded_collective
+
+        with pytest.raises(HostLostError) as ei:
+            _bounded_collective(lambda: time.sleep(60), "stuck",
+                                deadline_s=0.2)
+        assert "deadline" in str(ei.value)
+
+    def test_collective_errors_propagate_unwrapped(self):
+        from deep_vision_tpu.parallel.multihost import _bounded_collective
+
+        with pytest.raises(ValueError):
+            _bounded_collective(
+                lambda: (_ for _ in ()).throw(ValueError("x")).__next__(),
+                "err", deadline_s=5.0)
+
+    def test_sync_and_agree_route_through_rendezvous(self, tmp_path):
+        from deep_vision_tpu.parallel import multihost as mh
+
+        out, errs = join_world(str(tmp_path), ["a", "b"])
+        assert not errs
+        res = {}
+
+        def run(h, flag):
+            r, v = out[h]
+            mh_view = v  # each thread installs its own world: module
+            # state is per-process, so serialize via distinct names
+            res[h] = r.agree("preempt", flag, timeout_s=10)
+
+        ts = [threading.Thread(target=run, args=(h, h == "a"))
+              for h in ("a", "b")]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(15)
+        assert res == {"a": True, "b": True}
+        # the module-level overlay: install one side and verify the
+        # lease-checked path raises on a dead peer instead of hanging
+        ra, va = out["a"]
+        out["b"][0]._hb_stop.set()
+        time.sleep(4 * FAST["heartbeat_s"])
+        try:
+            mh.install_world(va, ra)
+            with pytest.raises(HostLostError):
+                mh.sync_hosts("post-death", deadline_s=10)
+            with pytest.raises(HostLostError):
+                mh.agree_flag(False, deadline_s=10)
+        finally:
+            mh.clear_world()
+        ra.leave()
+
+
+# -- HostSupervisor + Trainer.fit ----------------------------------------------
+
+class TestHostSupervisor:
+    def test_handle_loss_journals_the_full_trail_exactly_once(
+            self, tmp_path):
+        out, errs = join_world(str(tmp_path), ["a", "b"])
+        assert not errs
+        ra = out["a"][0]
+        out["b"][0]._hb_stop.set()
+        time.sleep(4 * FAST["heartbeat_s"])
+        j = FakeJournal()
+        sup = HostSupervisor(ra, journal=j, resume_step_fn=lambda: 42)
+        with pytest.raises(HostLostError) as ei:
+            ra.check()
+        view = sup.handle_loss(ei.value)
+        assert view.generation == 1 and view.hosts == ("a",)
+        lost = j.of("host_lost")
+        assert len(lost) == 1 and lost[0]["host"] == "b"
+        assert lost[0]["generation"] == 0
+        assert lost[0]["lease_gap_s"] > 0
+        resized = j.of("world_resized")
+        assert resized == [{"event": "world_resized", "from": 2, "to": 1,
+                            "generation": 1, "resume_step": 42}]
+        rs = j.of("data_reshard")
+        assert len(rs) == 1 and rs[0]["num_shards"] == 1
+        # second detector parks instead of double-resizing: claim is spent
+        assert sup._claim() is False
+        ra.leave()
+
+    def test_failed_resize_releases_the_claim_for_the_next_detector(
+            self, tmp_path, monkeypatch):
+        # the winner's resize failing must NOT leave the claim latched:
+        # a parked loser with no active winner would be the indefinite
+        # hang this module exists to remove
+        out, errs = join_world(str(tmp_path), ["a", "b"])
+        assert not errs
+        ra = out["a"][0]
+        out["b"][0]._hb_stop.set()
+        time.sleep(4 * FAST["heartbeat_s"])
+        sup = HostSupervisor(ra, journal=FakeJournal())
+        monkeypatch.setattr(sup, "resize",
+                            lambda **kw: (_ for _ in ()).throw(
+                                RendezvousTimeout("record never appeared")))
+        with pytest.raises(HostLostError) as ei:
+            ra.check()
+        with pytest.raises(RendezvousTimeout):
+            sup.handle_loss(ei.value)
+        assert sup._claim() is True  # released: the next detector retries
+        ra.leave()
+
+    def test_bounded_fetch_returns_value_and_raises_on_death(self, tmp_path):
+        out, errs = join_world(str(tmp_path), ["a", "b"])
+        assert not errs
+        ra = out["a"][0]
+        sup = HostSupervisor(ra, journal=FakeJournal(), fence_poll_s=0.05)
+        assert sup.bounded_fetch(lambda: 7) == 7
+        out["b"][0]._hb_stop.set()
+        time.sleep(4 * FAST["heartbeat_s"])
+        with pytest.raises(HostLostError):
+            sup.bounded_fetch(lambda: time.sleep(60))
+        ra.leave()
+
+    def test_trainer_fit_rides_host_loss_to_world_resized(self, tmp_path):
+        """fit() supervision end to end (single jax process, real
+        rendezvous, one ghost peer): the dead host surfaces through the
+        preemption-consensus barrier as HostLostError, fit journals
+        host_lost + world_resized + data_reshard and raises the typed
+        WorldResized carrying the g+1 view."""
+        import jax.numpy as jnp
+        import numpy as np
+
+        from deep_vision_tpu.losses import classification_loss_fn
+        from deep_vision_tpu.models import get_model
+        from deep_vision_tpu.parallel import multihost as mh
+        from deep_vision_tpu.train import Trainer, build_optimizer
+
+        out, errs = join_world(str(tmp_path / "rdzv"), ["a", "b"])
+        assert not errs
+        ra, va = out["a"]
+        out["b"][0]._hb_stop.set()  # the peer dies before the first poll
+        j = FakeJournal()
+        try:
+            mh.install_world(va, ra)
+            sup = HostSupervisor(ra, journal=j)
+            rng = np.random.RandomState(0)
+            images = rng.rand(32, 32, 32, 1).astype(np.float32)
+            labels = rng.randint(0, 4, size=32).astype(np.int32)
+            trainer = Trainer(
+                get_model("lenet5", num_classes=4),
+                build_optimizer("adam", 1e-3), classification_loss_fn,
+                sample_input=jnp.zeros((8, 32, 32, 1)),
+                journal=j, host_supervisor=sup,
+            )
+
+            def data():
+                for i in range(4):
+                    yield {"image": images[i * 8:(i + 1) * 8],
+                           "label": labels[i * 8:(i + 1) * 8]}
+
+            with pytest.raises(WorldResized) as ei:
+                trainer.fit(data, epochs=2, preemption_poll_every=2)
+            assert ei.value.view.generation == 1
+            assert ei.value.view.hosts == ("a",)
+            assert [r["host"] for r in j.of("host_lost")] == ["b"]
+            resized = j.of("world_resized")
+            assert len(resized) == 1
+            assert (resized[0]["from"], resized[0]["to"]) == (2, 1)
+            # no checkpoint manager: the honest resume_step is -1
+            assert resized[0]["resume_step"] == -1
+            assert len(j.of("data_reshard")) == 1
+        finally:
+            mh.clear_world()
+            ra.leave()
+
+    def test_trainer_pins_world_shard_into_unsharded_loader(self, tmp_path):
+        """A production loader built without host_shard would fingerprint
+        identically across a resize — the Trainer stamps the world's
+        slice at attach so the SnapshotMismatch refusal can actually
+        fire."""
+        import jax.numpy as jnp
+
+        from deep_vision_tpu.data.pipeline import DataLoader
+        from deep_vision_tpu.losses import classification_loss_fn
+        from deep_vision_tpu.models import get_model
+        from deep_vision_tpu.train import Trainer, build_optimizer
+
+        out, errs = join_world(str(tmp_path), ["a", "b"])
+        assert not errs
+        ra, va = out["a"]
+        loader = DataLoader([{"x": 1.0}] * 8, batch_size=4)
+        assert loader.host_shard is None
+        Trainer(
+            get_model("lenet5", num_classes=4),
+            build_optimizer("adam", 1e-3), classification_loss_fn,
+            sample_input=jnp.zeros((4, 32, 32, 1)),
+            host_supervisor=HostSupervisor(ra, journal=FakeJournal()),
+            data_loader=loader,
+        )
+        assert loader.host_shard == va.shard() == (0, 2)
+        for r, _ in out.values():
+            r.leave()
+
+    def test_attach_reenters_a_written_generation(self, tmp_path, monkeypatch):
+        out, errs = join_world(str(tmp_path), ["a", "b"])
+        assert not errs
+        # both resize after b... no: simulate the re-exec re-entry — a
+        # FRESH Rendezvous instance attaches to the generation the env
+        # names, as the exec'd process would
+        monkeypatch.setenv(ENV_GENERATION, "0")
+        fresh = {}
+
+        def run(h):
+            r = Rendezvous(str(tmp_path), h, **FAST)
+            fresh[h] = r.attach(timeout_s=10)
+
+        # the original members keep heartbeating (their leases are what
+        # the fresh instances' ack barrier sweeps)
+        ts = [threading.Thread(target=run, args=(h,)) for h in ("a", "b")]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(15)
+        assert fresh["a"].hosts == ("a", "b")
+        assert fresh["a"].generation == 0
+        assert fresh["b"].rank == 1
+        for r, _ in out.values():
+            r.leave()
+
+
+# -- journal schemas + obs surfaces --------------------------------------------
+
+class TestSchemas:
+    def _check(self, rows, strict=True):
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "check_journal", os.path.join(
+                os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                "tools", "check_journal.py"))
+        cj = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(cj)
+        import tempfile
+
+        base = {"ts": 1.0, "run_id": "t"}
+        with tempfile.NamedTemporaryFile("w", suffix=".jsonl",
+                                         delete=False) as f:
+            for r in rows:
+                f.write(json.dumps({**base, **r}) + "\n")
+            f.write(json.dumps({**base, "event": "exit",
+                                "status": "clean_exit"}) + "\n")
+            path = f.name
+        try:
+            return cj.check_journal(path, strict=strict)
+        finally:
+            os.unlink(path)
+
+    def test_membership_events_accepted(self):
+        assert self._check([
+            {"event": "host_lost", "host": "h1", "generation": 0,
+             "lease_gap_s": 2.5},
+            {"event": "host_joined", "host": "h3", "generation": 2},
+            {"event": "world_resized", "from": 3, "to": 2, "generation": 1,
+             "resume_step": 8},
+            {"event": "data_reshard", "generation": 1, "from": 3, "to": 2,
+             "shard_index": 0, "num_shards": 2},
+        ]) == []
+
+    def test_membership_events_rejected_on_bad_types(self):
+        assert self._check([{"event": "host_lost", "host": 1,
+                             "generation": 0}])
+        assert self._check([{"event": "host_lost", "host": "h1",
+                             "generation": "zero"}])
+        assert self._check([{"event": "world_resized", "from": 3, "to": 2,
+                             "generation": 1}])  # resume_step missing
+        assert self._check([{"event": "world_resized", "from": 3, "to": 0,
+                             "generation": 1, "resume_step": -1}])
+        assert self._check([{"event": "data_reshard", "generation": 1,
+                             "from": "three", "to": 2}])
+
+    def test_event_names_match_supervisor_emissions(self, tmp_path):
+        """The schema enum and the emitter cannot drift: every event the
+        HostSupervisor writes must validate --strict."""
+        out, errs = join_world(str(tmp_path), ["a", "b"])
+        assert not errs
+        ra = out["a"][0]
+        out["b"][0]._hb_stop.set()
+        time.sleep(4 * FAST["heartbeat_s"])
+        j = FakeJournal()
+        sup = HostSupervisor(ra, journal=j, resume_step_fn=lambda: 3)
+        with pytest.raises(HostLostError) as ei:
+            ra.check()
+        sup.handle_loss(ei.value)
+        sup.on_host_joined("c", 1)
+        assert self._check(j.rows) == []
+        ra.leave()
+
+
+class TestObsSurfaces:
+    def test_obs_report_membership_section(self):
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "obs_report", os.path.join(
+                os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                "tools", "obs_report.py"))
+        rep = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(rep)
+        base = {"ts": 1.0, "run_id": "r"}
+        events = [
+            {**base, "event": "host_lost", "host": "h1", "generation": 0,
+             "lease_gap_s": 2.1},
+            {**base, "event": "world_resized", "from": 3, "to": 2,
+             "generation": 1, "resume_step": 8},
+            {**base, "event": "data_reshard", "generation": 1, "from": 3,
+             "to": 2, "shard_index": 0, "num_shards": 2},
+            {**base, "event": "exit", "status": "clean_exit"},
+        ]
+        summary = rep.summarize_run(events)
+        assert summary["membership"]["generations"][0]["resume_step"] == 8
+        text = rep.render(summary)
+        assert "host_lost h1" in text
+        assert "world 3 -> 2" in text
+        assert "resume step 8" in text
+        assert "data_reshard" in text
+        # no membership events -> no section, report byte-unchanged
+        plain = rep.summarize_run([{**base, "event": "exit",
+                                    "status": "clean_exit"}])
+        assert "membership" not in plain
+
+    def test_merge_tolerates_a_dead_hosts_partial_journal(self, tmp_path):
+        from deep_vision_tpu.obs.merge import merge_journal_files
+
+        good = tmp_path / "run.jsonl.p0"
+        rows = [{"event": "step", "ts": 1.0, "run_id": "r", "step": 1,
+                 "step_time_ms": 10.0}]
+        good.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
+        torn = tmp_path / "run.jsonl.p1"
+        torn.write_text(json.dumps(
+            {"event": "step", "ts": 1.0, "run_id": "r", "step": 1,
+             "step_time_ms": 11.0}) + "\n" + '{"event": "ste')
+        missing = str(tmp_path / "run.jsonl.p2")  # SIGKILLed pre-flush
+        out = str(tmp_path / "merged.jsonl")
+        summary = merge_journal_files([str(good), str(torn), missing], out)
+        assert summary["unreadable"] == [missing]
+        assert summary["hosts"] == [0, 1]
+        header = json.loads(open(out).readline())
+        assert header["unreadable_sources"] == [missing]
+
+
+# -- preflight ------------------------------------------------------------------
+
+class TestPreflightRendezvous:
+    def test_skewed_joiner_fails_as_version_skew(self, tmp_path):
+        from deep_vision_tpu.tools.preflight import check_rendezvous
+
+        incumbent = Rendezvous(str(tmp_path), "fleet-0", **FAST,
+                               client_version="jax 0.4.37, jaxlib 0.4.36",
+                               platform_version="libtpu 2024.1")
+        incumbent.start_heartbeat()
+        r = check_rendezvous(
+            2, str(tmp_path), host_id="joiner", budget_s=20.0,
+            versions={"client_version": "jax 0.4.30, jaxlib 0.4.30",
+                      "platform_version": "libtpu 2023.9"})
+        assert not r.ok
+        assert r.kind == "version_skew"
+        incumbent.leave()
+
+    def test_compatible_world_assembles_and_probe_leaves(self, tmp_path):
+        from deep_vision_tpu.tools.preflight import check_rendezvous
+
+        versions = {"client_version": "v1", "platform_version": "p1"}
+        results = {}
+
+        def probe(name):
+            results[name] = check_rendezvous(
+                2, str(tmp_path), host_id=name, budget_s=20.0,
+                versions=versions)
+
+        ts = [threading.Thread(target=probe, args=(n,))
+              for n in ("pf-a", "pf-b")]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(30)
+        assert all(r.ok for r in results.values()), results
+        assert "world of 2" in results["pf-a"].detail
+        # probes left: no member records squat the slots the real run
+        # is about to claim
+        assert os.listdir(tmp_path / "members") == []
+
+    def test_probe_leftovers_never_squat_the_dir(self, tmp_path):
+        """A preflight round leaves a stale generation record; the REAL
+        run (same dir, fresh member ids or not) must still assemble —
+        at the next generation — instead of being refused as evicted."""
+        from deep_vision_tpu.tools.preflight import check_rendezvous
+
+        versions = {"client_version": "v1"}
+        results = {}
+
+        def probe(name):
+            results[name] = check_rendezvous(
+                2, str(tmp_path), host_id=name, budget_s=20.0,
+                versions=versions)
+
+        for round_no in (0, 1):  # second round = the rerun case
+            ts = [threading.Thread(target=probe, args=(f"r{round_no}-{i}",))
+                  for i in range(2)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(30)
+        assert all(r.ok for r in results.values()), results
+        # and the real run after both probe rounds:
+        out, errs = join_world(str(tmp_path), ["real-a", "real-b"],
+                               client_version="v1")
+        assert not errs, errs
+        assert all(v.generation == 2 for _, v in out.values())
+        for r, _ in out.values():
+            r.leave()
+
+    def test_never_assembles_fails_as_timeout(self, tmp_path):
+        from deep_vision_tpu.tools.preflight import check_rendezvous
+
+        r = check_rendezvous(3, str(tmp_path), host_id="alone",
+                             budget_s=0.5, versions={})
+        assert not r.ok and r.kind == "timeout"

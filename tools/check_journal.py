@@ -72,6 +72,10 @@ EVENT_FIELDS = {
     "data_worker_lost": ("worker", "attempt"),
     "data_worker_recovered": ("worker", "attempt"),
     "data_service": ("role", "batches"),
+    "host_lost": ("host", "generation"),
+    "host_joined": ("host", "generation"),
+    "world_resized": ("from", "to", "generation", "resume_step"),
+    "data_reshard": ("generation", "from", "to"),
     "note": (),
     "exit": ("status",),
     "crash": ("reason",),
@@ -246,6 +250,32 @@ def check_journal(path: str, require_exit: bool = False,
             if not isinstance(row.get("batches"), int):
                 errors.append(f"{path}:{i}: data_service batches must be "
                               f"an int, got {row.get('batches')!r}")
+        if ev in ("host_lost", "host_joined"):
+            # elastic membership events (resilience/rendezvous.py):
+            # host is a member ID string, generation the rendezvous
+            # generation the event happened at
+            if not isinstance(row.get("host"), str) or not row.get("host"):
+                errors.append(f"{path}:{i}: {ev} host must be a member id "
+                              f"string, got {row.get('host')!r}")
+            if not isinstance(row.get("generation"), int):
+                errors.append(f"{path}:{i}: {ev} generation must be an "
+                              f"int, got {row.get('generation')!r}")
+        if ev == "world_resized":
+            for k in ("from", "to", "generation", "resume_step"):
+                if not isinstance(row.get(k), int):
+                    errors.append(f"{path}:{i}: world_resized {k} must be "
+                                  f"an int, got {row.get(k)!r}")
+            frm, to = row.get("from"), row.get("to")
+            # same-SIZE resizes are legal (one host lost + one joined in
+            # the same generation); an empty new world is not
+            if isinstance(to, int) and to < 1:
+                errors.append(f"{path}:{i}: world_resized {frm} -> {to}: "
+                              "the new world must have >= 1 host")
+        if ev == "data_reshard":
+            for k in ("generation", "from", "to"):
+                if not isinstance(row.get(k), int):
+                    errors.append(f"{path}:{i}: data_reshard {k} must be "
+                                  f"an int, got {row.get(k)!r}")
         if ev == "backend_lost" and row.get("kind") not in BACKEND_LOST_KINDS:
             errors.append(f"{path}:{i}: unknown backend_lost kind "
                           f"{row.get('kind')!r}")

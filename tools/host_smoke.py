@@ -1,0 +1,427 @@
+"""Host-churn smoke: lose a host mid-epoch, keep the run (`make host-smoke`).
+
+The executable proof behind resilience/rendezvous.py — the multi-host
+half of the elastic arc, the way chaos-smoke phase 7 proved the
+single-host half. Three REAL processes (forced 2-device CPU worlds,
+like chaos phase 7) join a file-backed rendezvous, initialize
+jax.distributed at world 3 (6-device global mesh, gloo CPU
+collectives), and train a real Trainer with checkpoints. Then the
+parent SIGKILLs one host mid-epoch and asserts the contract ROADMAP
+item 1 demands:
+
+  1. the survivors DETECT the loss within the heartbeat deadline —
+     typed `host_lost` events, no indefinite collective hang, no
+     watchdog dump;
+  2. they re-rendezvous at generation 1 with world 2 (typed
+     `world_resized{from:3, to:2}`), re-enter jax.distributed at the
+     new size (process-image replacement — see the rendezvous module
+     docstring for why a rank wedged in a dead collective cannot
+     re-init in place), and rebuild the 4-device mesh;
+  3. training RESUMES at the exact checkpointed step (first post-resume
+     step event == resume_step + 1, losses continuing), riding the
+     PR 10 cross-mesh restore;
+  4. the input pipeline re-derives a disjoint+covering host-shard
+     assignment over the survivors (typed `data_reshard`);
+  5. every surviving host's journal passes `check_journal --strict`,
+     the locksmith is armed throughout with ZERO lock-order violations,
+     and `obs_report` renders the membership timeline.
+
+Worker mode (`--host N`) is the host agent: rendezvous first (pure
+stdlib, so a re-exec'd survivor re-arms its lease BEFORE paying the
+jax import), then jax, then Trainer.fit under HostSupervisor; a
+WorldResized from fit re-execs this same process into the next
+generation. Exit status 0 = every contract held; 1 = one is broken.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+HOSTS = 3
+DEVICES_PER_HOST = 2
+GLOBAL_BS = 12           # divisible by 3 hosts, 2 hosts, and both meshes
+STEPS_PER_EPOCH = 8
+EPOCHS = 8               # CPU steps are ~100ms: enough epochs that the
+#                          parent's kill window (post-checkpoint, mid-
+#                          epoch) is seconds wide, with real post-resume
+#                          training left to prove losses continue
+VICTIM = 1               # a MIDDLE host: the survivor behind it must
+#                          re-rank (h2: rank 2 -> 1), exercising the
+#                          dense re-assignment, not just a tail trim
+HEARTBEAT_S = 0.5
+LEASE_S = 3.0
+#: detection must beat this bound by construction (lease + one poll +
+#: slack); a hang would instead ride to the subprocess timeout
+DETECT_BOUND_S = 30.0
+
+
+# -- worker: the host agent ----------------------------------------------------
+
+def worker_main(args) -> int:
+    host = f"h{args.host}"
+    workdir = args.workdir
+    if os.environ.get("DVT_HOST_SMOKE_DEBUG"):
+        import faulthandler
+
+        faulthandler.dump_traceback_later(
+            20, repeat=True,
+            file=open(os.path.join(workdir, f"stacks_{host}.txt"), "w"))
+    # rendezvous BEFORE jax: stdlib-only, so the lease is armed within
+    # ~100ms of process start — a re-exec'd survivor's absence stays far
+    # inside the other survivors' lease deadline
+    from deep_vision_tpu.resilience.rendezvous import (
+        ENV_GENERATION,
+        HostSupervisor,
+        Rendezvous,
+        WorldResized,
+    )
+
+    rdzv = Rendezvous(
+        os.path.join(workdir, "rdzv"), host,
+        heartbeat_s=HEARTBEAT_S, lease_s=LEASE_S, poll_s=0.02,
+        client_version="host-smoke-1",  # identical fleet: handshake passes
+    )
+    attached = os.environ.get(ENV_GENERATION) is not None
+    if attached:
+        view = rdzv.attach(timeout_s=300)
+    else:
+        view = rdzv.join(expect_hosts=HOSTS, timeout_s=180)
+    print(f"[{host}] generation {view.generation} world {view.hosts} "
+          f"rank {view.rank}", flush=True)
+
+    # now the heavy half: jax at this generation's world size
+    import numpy as np  # noqa: E402
+
+    from deep_vision_tpu.core import CheckpointManager
+    from deep_vision_tpu.losses import classification_loss_fn
+    from deep_vision_tpu.models import get_model
+    from deep_vision_tpu.obs import locksmith
+    from deep_vision_tpu.obs.journal import RunJournal
+    from deep_vision_tpu.parallel import multihost as mh
+    from deep_vision_tpu.train import Trainer, build_optimizer
+
+    mh.install_world(view, rdzv)
+    mh.initialize_from_world(view)
+    import jax
+    import jax.numpy as jnp
+
+    mesh = mh.global_mesh()
+    # one journal file per HOST for its whole life: append mode carries
+    # it across the re-exec (per_process=False — the generation changes
+    # this host's rank, and a rank-suffixed path would strand the
+    # pre-resize history in a terminal-less file --strict rejects)
+    journal = RunJournal(os.path.join(workdir, f"journal_{host}.jsonl"),
+                         per_process=False, writer=True, kind="host-smoke")
+    locksmith.arm_from_env(journal=journal)
+    journal.write("note", note="mesh_shape", generation=view.generation,
+                  mesh_shape={str(k): int(v) for k, v in mesh.shape.items()},
+                  world=view.world_size, rank=view.rank)
+    sup = HostSupervisor(rdzv, journal=journal)
+
+    # identical deterministic dataset on every host; each host feeds its
+    # generation-derived slice of every global batch
+    rng = np.random.RandomState(0)
+    n = GLOBAL_BS * STEPS_PER_EPOCH
+    images = rng.rand(n, 32, 32, 1).astype(np.float32) * 0.1
+    labels = rng.randint(0, 4, size=n)
+    for i, lab in enumerate(labels):
+        r, c = divmod(int(lab), 2)
+        images[i, r * 16:(r + 1) * 16, c * 16:(c + 1) * 16, 0] += 0.9
+    labels = labels.astype(np.int32)
+
+    trainer = Trainer(
+        get_model("lenet5", num_classes=4),
+        build_optimizer("adam", 1e-3),
+        classification_loss_fn,
+        sample_input=jnp.zeros((GLOBAL_BS // view.world_size, 32, 32, 1)),
+        mesh=mesh,
+        checkpoint_manager=CheckpointManager(os.path.join(workdir, "ckpt"),
+                                             journal=journal),
+        journal=journal,
+        host_supervisor=sup,
+    )
+
+    def train_data():
+        rank, nh = mh.host_shard()  # generation-aware
+        per = mh.per_host_batch_size(GLOBAL_BS)
+        for i in range(STEPS_PER_EPOCH):
+            lo = i * GLOBAL_BS + rank * per
+            local = {"image": images[lo:lo + per],
+                     "label": labels[lo:lo + per]}
+            yield mh.form_global_array(local, mesh)
+
+    start_epoch = 0
+    if attached and trainer.ckpt.latest_step() is not None:
+        start_epoch = trainer.resume()
+        print(f"[{host}] resumed at step {int(trainer.state.step)}, "
+              f"epoch {start_epoch}", flush=True)
+    # the PRIMARY detector: a peer dying mid-step wedges this host's
+    # next jit dispatch in C++ before any in-band fence runs — the
+    # watchdog thread journals/resizes/re-execs regardless
+    sup.arm_watchdog()
+    try:
+        trainer.fit(train_data, epochs=EPOCHS, start_epoch=start_epoch,
+                    preemption_poll_every=4)
+    except WorldResized as wr:
+        # fit already journaled host_lost/world_resized/data_reshard;
+        # re-enter the new generation with a fresh process image (the
+        # wedged jax world dies with this one)
+        print(f"[{host}] world resized -> generation "
+              f"{wr.view.generation}, re-exec", flush=True)
+        trainer.close()
+        sup.reexec(wr.view)  # never returns
+    sup.disarm_watchdog()  # a completing run must not be exec'd out
+    # from under its own teardown
+    final_step = int(trainer.state.step)
+    trainer.close()
+    journal.write("note", note="final_step", step=final_step)
+    journal.close()
+    # rendezvous BEFORE leaving: a survivor finishing a beat earlier
+    # must not read its peer's clean departure as a lost host
+    rdzv.barrier("shutdown", timeout_s=120)
+    rdzv.leave()
+    print(f"[{host}] DONE step={final_step}", flush=True)
+    return 0
+
+
+# -- parent: orchestration + assertions ----------------------------------------
+
+def read_jsonl(path: str) -> List[dict]:
+    if not os.path.exists(path):
+        return []
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                try:
+                    out.append(json.loads(line))
+                except json.JSONDecodeError:
+                    pass  # the SIGKILLed host's torn final line
+    return out
+
+
+def check_journal_strict(path: str) -> bool:
+    rc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "check_journal.py"),
+         path, "--strict"],
+        cwd=ROOT, env=dict(os.environ, PYTHONPATH=ROOT),
+    ).returncode
+    return rc == 0
+
+
+class Failures:
+    def __init__(self):
+        self.errors: List[str] = []
+
+    def check(self, ok: bool, what: str) -> bool:
+        print(("  ok  " if ok else "  FAIL") + f"  {what}")
+        if not ok:
+            self.errors.append(what)
+        return ok
+
+
+def spawn_host(i: int, workdir: str):
+    env = dict(
+        os.environ, PYTHONPATH=ROOT, JAX_PLATFORMS="cpu",
+        XLA_FLAGS=f"--xla_force_host_platform_device_count"
+                  f"={DEVICES_PER_HOST}",
+        DVT_LOCKSMITH="1",
+    )
+    env.pop("DVT_RDZV_GENERATION", None)
+    log = open(os.path.join(workdir, f"host{i}.log"), "w")
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--host", str(i),
+         "--workdir", workdir],
+        cwd=ROOT, env=env, stdout=log, stderr=subprocess.STDOUT,
+    )
+    return proc, log
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--workdir", default="artifacts/host_smoke")
+    p.add_argument("--host", type=int, default=None,
+                   help=argparse.SUPPRESS)  # worker mode
+    args = p.parse_args(argv)
+    if args.host is not None:
+        return worker_main(args)
+
+    import shutil
+
+    workdir = os.path.abspath(args.workdir)
+    shutil.rmtree(workdir, ignore_errors=True)
+    os.makedirs(workdir, exist_ok=True)
+    f = Failures()
+    journals = {i: os.path.join(workdir, f"journal_h{i}.jsonl")
+                for i in range(HOSTS)}
+
+    print(f"host-smoke: world {HOSTS} x {DEVICES_PER_HOST} CPU devices, "
+          f"SIGKILL h{VICTIM} mid-epoch, survivors must re-rendezvous "
+          f"at world {HOSTS - 1}")
+    procs: Dict[int, tuple] = {}
+    try:
+        for i in range(HOSTS):
+            procs[i] = spawn_host(i, workdir)
+
+        # -- phase 1: reach live CHECKPOINTED training at world 3 -------
+        deadline = time.time() + 420
+        def ready() -> bool:
+            for i in range(HOSTS):
+                evs = read_jsonl(journals[i])
+                if not any(e.get("event") == "checkpoint" and e.get("saved")
+                           for e in evs):
+                    return False
+                steps = [e["step"] for e in evs if e.get("event") == "step"]
+                if not steps or max(steps) < STEPS_PER_EPOCH + 2:
+                    return False
+            return True
+
+        while time.time() < deadline and not ready():
+            if any(pr.poll() is not None for pr, _ in procs.values()):
+                break
+            time.sleep(0.2)
+        alive = all(pr.poll() is None for pr, _ in procs.values())
+        f.check(alive and ready(),
+                "world-3 training is live past an epoch-0 checkpoint "
+                "and into epoch 1 on every host")
+        if not (alive and ready()):
+            raise RuntimeError("never reached the kill window")
+
+        # -- phase 2: SIGKILL the victim mid-epoch ----------------------
+        kill_ts = time.time()
+        os.kill(procs[VICTIM][0].pid, signal.SIGKILL)
+        print(f"  SIGKILLed h{VICTIM} (pid {procs[VICTIM][0].pid})")
+
+        survivors = [i for i in range(HOSTS) if i != VICTIM]
+        rcs = {}
+        for i in survivors:
+            pr, _ = procs[i]
+            try:
+                rcs[i] = pr.wait(timeout=420)
+            except subprocess.TimeoutExpired:
+                pr.kill()
+                rcs[i] = "timeout"
+        procs[VICTIM][0].wait()
+        for i in survivors:
+            f.check(rcs[i] == 0,
+                    f"survivor h{i} completed the run (rc={rcs[i]}) — "
+                    "no hang, no watchdog death")
+
+        # -- phase 3: the journaled contract ----------------------------
+        resume_steps = set()
+        for i in survivors:
+            evs = read_jsonl(journals[i])
+            lost = [e for e in evs if e.get("event") == "host_lost"]
+            f.check(len(lost) >= 1
+                    and lost[0].get("host") == f"h{VICTIM}"
+                    and lost[0].get("generation") == 0,
+                    f"h{i} journaled typed host_lost for h{VICTIM} at "
+                    "generation 0")
+            if lost:
+                latency = float(lost[0].get("ts", 1e18)) - kill_ts
+                f.check(0 <= latency <= DETECT_BOUND_S,
+                        f"h{i} detected the loss within the heartbeat "
+                        f"deadline ({latency:.1f}s <= {DETECT_BOUND_S}s)")
+            resized = [e for e in evs if e.get("event") == "world_resized"]
+            ok_resize = (len(resized) == 1
+                         and resized[0].get("from") == HOSTS
+                         and resized[0].get("to") == HOSTS - 1
+                         and resized[0].get("generation") == 1
+                         and isinstance(resized[0].get("resume_step"), int)
+                         and resized[0]["resume_step"] > 0)
+            f.check(ok_resize,
+                    f"h{i} journaled world_resized 3 -> 2 at generation 1 "
+                    f"with a real resume_step ({resized})")
+            if not ok_resize:
+                continue
+            resume_step = resized[0]["resume_step"]
+            resume_steps.add(resume_step)
+            # the checkpointed step the resize promised must be the one
+            # training continues FROM: first post-resize step == S + 1
+            idx = evs.index(resized[0])
+            post_steps = [e["step"] for e in evs[idx:]
+                          if e.get("event") == "step"]
+            f.check(bool(post_steps)
+                    and post_steps[0] == resume_step + 1,
+                    f"h{i} resumed at the exact checkpointed step "
+                    f"(first post-resize step {post_steps[:1]} == "
+                    f"{resume_step + 1}); losses continue, not restart")
+            f.check(bool(post_steps)
+                    and max(post_steps) == EPOCHS * STEPS_PER_EPOCH,
+                    f"h{i} finished the full run at world 2 (last step "
+                    f"{max(post_steps) if post_steps else None} == "
+                    f"{EPOCHS * STEPS_PER_EPOCH})")
+            meshes = [e.get("mesh_shape", {}).get("data") for e in evs
+                      if e.get("event") == "note"
+                      and e.get("note") == "mesh_shape"]
+            f.check(meshes == [HOSTS * DEVICES_PER_HOST,
+                               (HOSTS - 1) * DEVICES_PER_HOST],
+                    f"h{i} rebuilt the mesh 6 -> 4 devices across the "
+                    f"resize (data axis history {meshes})")
+        f.check(len(resume_steps) == 1,
+                f"both survivors agreed on one resume step "
+                f"({sorted(resume_steps)})")
+
+        # the re-derived host shards are disjoint and covering at world 2
+        shards = {}
+        for i in survivors:
+            evs = read_jsonl(journals[i])
+            rs = [e for e in evs if e.get("event") == "data_reshard"]
+            f.check(len(rs) == 1 and rs[0].get("generation") == 1
+                    and rs[0].get("from") == HOSTS
+                    and rs[0].get("to") == HOSTS - 1
+                    and rs[0].get("num_shards") == HOSTS - 1,
+                    f"h{i} journaled data_reshard to the 2-host world")
+            if rs:
+                shards[i] = rs[0].get("shard_index")
+        f.check(sorted(shards.values()) == list(range(HOSTS - 1)),
+                f"post-resize host shards are disjoint+covering "
+                f"({shards})")
+
+        # -- phase 4: artifact validity ---------------------------------
+        for i in survivors:
+            f.check(check_journal_strict(journals[i]),
+                    f"check_journal --strict accepts h{i}'s journal "
+                    "(membership events schema-valid, clean exit)")
+            evs = read_jsonl(journals[i])
+            viol = [e for e in evs
+                    if e.get("event") == "lock_order_violation"]
+            f.check(not viol,
+                    f"locksmith (armed whole-run) found zero lock-order "
+                    f"violations on h{i}")
+        rep = subprocess.run(
+            [sys.executable, os.path.join(ROOT, "tools", "obs_report.py")]
+            + [journals[i] for i in survivors],
+            cwd=ROOT, env=dict(os.environ, PYTHONPATH=ROOT),
+            capture_output=True, text=True)
+        f.check(rep.returncode == 0 and "host_lost" in rep.stdout
+                and "membership" in rep.stdout,
+                "obs_report renders the membership timeline")
+    finally:
+        for pr, log in procs.values():
+            if pr.poll() is None:
+                pr.kill()
+                pr.wait(timeout=30)
+            log.close()
+
+    if f.errors:
+        print(f"host-smoke: {len(f.errors)} contract(s) BROKEN")
+        for e in f.errors:
+            print(f"  - {e}")
+        return 1
+    print("host-smoke: all contracts held")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -97,6 +97,9 @@ def summarize_run(events: List[dict]) -> dict:
     data_plane = summarize_data_plane(events)
     if data_plane:
         out["data_plane"] = data_plane
+    membership = summarize_membership(events)
+    if membership:
+        out["membership"] = membership
     terminal = next(
         (e for e in reversed(events) if e.get("event") in ("exit", "crash")),
         None)
@@ -228,6 +231,44 @@ def summarize_data_plane(events: List[dict]) -> Optional[dict]:
         out["service"] = roles
     if lost or recovered:
         out["workers"] = {"lost": len(lost), "recovered": len(recovered)}
+    return out
+
+
+def summarize_membership(events: List[dict]) -> Optional[dict]:
+    """The host-membership timeline (resilience/rendezvous.py events):
+    generation history from `world_resized`, per-host loss/join rows
+    with lease gaps from `host_lost`/`host_joined`, and the data-plane
+    reshards that followed. None when the journal carries no membership
+    events — every existing report renders byte-unchanged."""
+    lost = [e for e in events if e.get("event") == "host_lost"]
+    joined = [e for e in events if e.get("event") == "host_joined"]
+    resized = [e for e in events if e.get("event") == "world_resized"]
+    reshards = [e for e in events if e.get("event") == "data_reshard"]
+    if not (lost or joined or resized or reshards):
+        return None
+    out: dict = {}
+    if resized:
+        out["generations"] = [
+            {k: e.get(k) for k in
+             ("generation", "from", "to", "resume_step", "ts")
+             if e.get(k) is not None}
+            for e in resized]
+    if lost:
+        out["lost"] = [
+            {k: e.get(k) for k in ("host", "generation", "lease_gap_s", "ts")
+             if e.get(k) is not None}
+            for e in lost]
+    if joined:
+        out["joined"] = [
+            {k: e.get(k) for k in ("host", "generation", "ts")
+             if e.get(k) is not None}
+            for e in joined]
+    if reshards:
+        out["reshards"] = [
+            {k: e.get(k) for k in
+             ("generation", "from", "to", "shard_index", "num_shards")
+             if e.get(k) is not None}
+            for e in reshards]
     return out
 
 
@@ -439,6 +480,35 @@ def render(summary: dict) -> str:
             if e.get("shard"):
                 detail += f" (shard {os.path.basename(str(e['shard']))})"
             rows.append(("data resume", f"{e.get('verdict')} ({detail})"))
+    # host-membership timeline (resilience/rendezvous.py): which hosts
+    # died at which generation (and how stale their lease was), each
+    # world resize with its resume step, and the input-pipeline reshards
+    # that followed — the 3am "why is this run suddenly world 2" answer
+    membership = summary.get("membership")
+    if membership:
+        for e in membership.get("generations", []):
+            detail = (f"world {e.get('from', '?')} -> {e.get('to', '?')}"
+                      f" at generation {e.get('generation', '?')}")
+            rs = e.get("resume_step")
+            if isinstance(rs, int) and rs >= 0:
+                detail += f", resume step {rs}"
+            elif rs is not None:
+                detail += ", no checkpoint to resume"
+            rows.append(("membership", detail))
+        for e in membership.get("lost", []):
+            detail = f"at generation {e.get('generation', '?')}"
+            if isinstance(e.get("lease_gap_s"), (int, float)):
+                detail += f" (lease gap {e['lease_gap_s']:.1f}s)"
+            rows.append((f"  host_lost {e.get('host', '?')}", detail))
+        for e in membership.get("joined", []):
+            rows.append((f"  host_joined {e.get('host', '?')}",
+                         f"at generation {e.get('generation', '?')}"))
+        for e in membership.get("reshards", []):
+            rows.append(("  data_reshard",
+                         f"hosts {e.get('from', '?')} -> {e.get('to', '?')}"
+                         f", this host now shard "
+                         f"{e.get('shard_index', '?')}/"
+                         f"{e.get('num_shards', '?')}"))
     # profiler captures: every decision the autoprof policy made, so the
     # table answers "why does this run have three trace dirs" directly
     for e in summary.get("captures", []):
