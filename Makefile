@@ -51,7 +51,7 @@ lint-baseline:
 # obs-smoke and chaos-smoke — the telemetry artifacts must validate and
 # the resilience contracts must hold before the tests count
 verify: SHELL := /bin/bash
-verify: lint preflight perf-smoke obs-smoke chaos-smoke data-smoke host-smoke serve-smoke fleet-smoke
+verify: lint preflight perf-smoke obs-smoke chaos-smoke data-smoke host-smoke serve-smoke fleet-smoke cache-smoke
 	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
 
 # environment preflight: backend liveness + libtpu/client version
@@ -109,6 +109,18 @@ serve-smoke:
 # journals pass check_journal --strict; no stray flight bundles
 fleet-smoke:
 	JAX_PLATFORMS=cpu python tools/loadgen.py --workdir artifacts/fleet_smoke
+
+# cold-path smoke: the persistent executable cache + int8 quantization
+# contracts (tools/cache_smoke.py) — run A compiles and populates the
+# cache (one excache_store per pair), run B in a FRESH process warms
+# with ZERO backend compiles (recompile-counter delta == 0, all
+# excache_hit, bit-identical outputs), a deliberately version-skewed
+# entry journals a typed excache_invalid and falls through to the
+# compiler, and the int8 engine passes the accuracy-delta gate and
+# serves the same traffic with SLO before/after printed (a poisoned
+# calibration is REFUSED). Journals pass check_journal --strict
+cache-smoke:
+	JAX_PLATFORMS=cpu python tools/cache_smoke.py --workdir artifacts/cache_smoke
 
 # resilience smoke: a record-backed CPU train under injected faults
 # (skipped bad records within budget, SIGKILL mid-checkpoint-save,
@@ -200,4 +212,4 @@ ps:
 native:
 	$(MAKE) -C native
 
-.PHONY: train resume train-fg test lint lint-baseline verify preflight obs-smoke chaos-smoke data-smoke host-smoke serve-smoke fleet-smoke perf-smoke bench bench-evidence roofline demo demo-gan demo-real dryrun tb ps native
+.PHONY: train resume train-fg test lint lint-baseline verify preflight obs-smoke chaos-smoke data-smoke host-smoke serve-smoke fleet-smoke cache-smoke perf-smoke bench bench-evidence roofline demo demo-gan demo-real dryrun tb ps native

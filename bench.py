@@ -329,6 +329,48 @@ def _log(msg: str) -> None:
     print(f"bench: {msg}", file=sys.stderr, flush=True)
 
 
+def _cold_start_fields() -> dict:
+    """cache-cold vs cache-warm cold start, measured in the SAME run on
+    the same probe computation (core/excache.py round trip):
+
+      warmup_compile_ms   the compiler's bill — lower + XLA compile +
+                          store into a fresh executable cache
+      cold_start_ms       what a restarted process pays over a POPULATED
+                          cache — lower + deserialize, zero compiles
+
+    The ratio is the recovery-time-objective win the persistent
+    executable cache buys serve warmup / elastic rebuild / host re-exec.
+    """
+    import shutil
+    import tempfile
+
+    from deep_vision_tpu.core.excache import ExecutableCache
+    from deep_vision_tpu.obs.registry import Registry
+
+    d = tempfile.mkdtemp(prefix="bench_excache_")
+    try:
+        cache = ExecutableCache(d, registry=Registry())
+        f = jax.jit(lambda v, x: jnp.tanh(x @ v) @ v)
+        v = jnp.ones((256, 256), jnp.float32)
+        spec = jax.ShapeDtypeStruct((64, 256), jnp.float32)
+        t0 = time.perf_counter()
+        compiled, src = cache.get_or_compile(
+            f.lower(v, spec), name="bench/coldstart")
+        compile_ms = (time.perf_counter() - t0) * 1e3
+        t1 = time.perf_counter()
+        cached, src2 = cache.get_or_compile(
+            f.lower(v, spec), name="bench/coldstart")
+        cached_ms = (time.perf_counter() - t1) * 1e3
+        if src != "compiled" or src2 != "cache":
+            # a backend that can't serialize executables: report the
+            # honest compile number and no fake cached one
+            return {"warmup_compile_ms": round(compile_ms, 1)}
+        return {"warmup_compile_ms": round(compile_ms, 1),
+                "cold_start_ms": round(cached_ms, 1)}
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+
+
 def make_train_parts(batch_per_chip: int, stem: str = "s2d"):
     """(train_step_fn, state, batch, batch_size, n_chips, devices): the
     UNJITTED flagship train step + freshly staged inputs.
@@ -636,6 +678,13 @@ def main(args, result: dict | None = None) -> None:
             result["errors"] = [err]
             return  # degraded emission from finally
         _log(f"backend alive ({time.perf_counter() - t0:.1f}s)")
+        try:
+            result.update(_cold_start_fields())
+            _log("cold-start probe: compile "
+                 f"{result.get('warmup_compile_ms')}ms -> cache-warm "
+                 f"{result.get('cold_start_ms')}ms")
+        except Exception as e:  # the headline must survive a probe bug
+            _log(f"cold-start probe failed ({type(e).__name__}: {e})")
         (window_dts, step, state, batch, batch_size, n_chips, devices,
          errors) = _timed_windows(args.batch, args.multistep)
         if errors:

@@ -28,6 +28,7 @@ Inception V1's aux heads (losses/classification.py handles the plumbing).
 """
 from __future__ import annotations
 
+import os
 from typing import Optional, Tuple
 
 import flax.linen as nn
@@ -38,8 +39,27 @@ from deep_vision_tpu.models import register_model
 from deep_vision_tpu.parallel.moe import load_balancing_loss
 
 # below this many tokens the dense einsum beats the flash kernel (and the
-# kernel's 128-lane tiling would need padding anyway)
+# kernel's 128-lane tiling would need padding anyway). The floor is a
+# per-platform tuning knob — the crossover sits elsewhere on a v5e than
+# on a v4 — so DVT_FLASH_MIN_TOKENS overrides it at trace time, the
+# DVT_NMS_IMPL convention (a routing knob must never no-op on a typo)
 FLASH_MIN_TOKENS = 1024
+
+
+def flash_min_tokens() -> int:
+    """The routing floor, env-overridable; a mistyped value raises
+    instead of silently running the default."""
+    env = os.environ.get("DVT_FLASH_MIN_TOKENS")
+    if env is None:
+        return FLASH_MIN_TOKENS
+    try:
+        return int(env)
+    except ValueError:
+        raise ValueError(
+            f"DVT_FLASH_MIN_TOKENS={env!r} is not an integer token count "
+            f"(default {FLASH_MIN_TOKENS}; lower routes shorter sequences "
+            "onto the flash kernel, higher keeps them on the dense einsum)"
+        ) from None
 
 
 class Attention(nn.Module):
@@ -59,7 +79,7 @@ class Attention(nn.Module):
         # t % 128 alone would admit 1280/1536-token inputs the kernel rejects
         use_flash = (
             jax.default_backend() == "tpu"
-            and t >= FLASH_MIN_TOKENS
+            and t >= flash_min_tokens()
             and t % 1024 == 0
         )
         if use_flash:

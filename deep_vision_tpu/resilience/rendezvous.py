@@ -256,6 +256,14 @@ class Rendezvous:
         self.generation = -1  # no world yet
         self.view: Optional[WorldView] = None
         self._joined_ts = time.time()  # join() restamps at the real join
+        # when a version disagreement is only a TIEBREAK loss (equal
+        # compatibility scores), self-refusal waits this long for more
+        # voters: a correct host polling in the instant before its peers'
+        # member records land must not be poisoned by a stale
+        # first-writer. A genuine 1-vs-1 skew still refuses within ~2
+        # heartbeats — seconds, not the join deadline.
+        self._tie_grace_s = 2.0 * self.heartbeat_s
+        self._tie_since: Optional[float] = None
         self._seq: Dict[str, int] = {}
         self._hb_stop = threading.Event()
         self._hb_thread: Optional[threading.Thread] = None
@@ -343,7 +351,15 @@ class Rendezvous:
         return os.path.join(self.root, "refused", f"{host}.json")
 
     @staticmethod
-    def _reference_member(members: Dict[str, dict]) -> Optional[dict]:
+    def _compat_score(rec: dict, members: Dict[str, dict]) -> int:
+        """How many of `members` this record's versions agree with (its
+        own record included, when present) — the vote both the reference
+        election and the admission tie/majority classification share."""
+        return sum(1 for other in members.values()
+                   if versions_compatible(rec, other)[0])
+
+    @classmethod
+    def _reference_member(cls, members: Dict[str, dict]) -> Optional[dict]:
         """The version reference: the member compatible with the MOST
         members (majority wins — a skewed host that happens to write its
         record first must not poison the whole fleet into self-refusing),
@@ -351,13 +367,9 @@ class Rendezvous:
         is all a 1-vs-1 disagreement has to go on)."""
         if not members:
             return None
-
-        def score(rec):
-            return sum(1 for other in members.values()
-                       if versions_compatible(rec, other)[0])
-
         return min(members.values(),
-                   key=lambda r: (-score(r), float(r.get("joined_ts", 0)),
+                   key=lambda r: (-cls._compat_score(r, members),
+                                  float(r.get("joined_ts", 0)),
                                   str(r.get("host"))))
 
     def _check_admission(self, alive: Optional[Dict[str, dict]] = None
@@ -384,20 +396,48 @@ class Rendezvous:
                 os.remove(self._refusal_path(self.host))
             except OSError:
                 pass
-        ref = self._reference_member(alive if alive is not None
-                                     else self.alive())
+        members = alive if alive is not None else self.alive()
+        # the electorate always includes THIS host: the sweep can lag our
+        # own member-record write (first poll, NFS/GCS listing delay),
+        # and without our self-vote a single stale first-writer would
+        # read as a strict majority and refuse us instantly — bypassing
+        # the very grace window below
+        electorate = dict(members)
+        electorate.setdefault(self.host, {
+            "host": self.host, "joined_ts": self._joined_ts,
+            **self.versions})
+        ref = self._reference_member(electorate)
         if ref is None or str(ref.get("host")) == self.host:
+            self._tie_since = None
             return
         ok, detail = versions_compatible(self.versions, ref)
-        if not ok:
-            # self-refusal is the fast path; also leave the marker so
-            # the ledger shows WHY this host never made a generation
-            _atomic_write(self._refusal_path(self.host), {
-                "host": self.host, "kind": REFUSAL_VERSION_SKEW,
-                "detail": detail, "versions": self.versions,
-                "ts": time.time()})
-            self.leave()
-            raise RendezvousRefused(REFUSAL_VERSION_SKEW, detail)
+        if ok:
+            self._tie_since = None
+            return
+        # the reference disagrees with us. A STRICT-majority reference
+        # refuses immediately; a reference that won only the
+        # earliest-joiner tiebreak (equal scores) gets a grace window —
+        # during assembly the tie is usually transient (our compatible
+        # peers' member records are milliseconds from landing), and
+        # self-refusing on it would let one stale first-writer poison
+        # every correct host (the majority-vote rationale, extended to
+        # the race the vote itself has before all voters are visible)
+        mine = electorate[self.host]
+        if self._compat_score(ref, electorate) \
+                <= self._compat_score(mine, electorate):
+            now = time.time()
+            if self._tie_since is None:
+                self._tie_since = now
+            if now - self._tie_since < self._tie_grace_s:
+                return  # wait for more voters before condemning anyone
+        # self-refusal is the fast path; also leave the marker so
+        # the ledger shows WHY this host never made a generation
+        _atomic_write(self._refusal_path(self.host), {
+            "host": self.host, "kind": REFUSAL_VERSION_SKEW,
+            "detail": detail, "versions": self.versions,
+            "ts": time.time()})
+        self.leave()
+        raise RendezvousRefused(REFUSAL_VERSION_SKEW, detail)
 
     def _compatible(self, members: Dict[str, dict]) -> Dict[str, dict]:
         """Members whose versions agree with the majority reference (the

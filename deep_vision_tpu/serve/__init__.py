@@ -58,6 +58,13 @@ from deep_vision_tpu.serve.buckets import (
 )
 from deep_vision_tpu.serve.engine import Engine, ModelEntry, ServeError
 from deep_vision_tpu.serve.pool import REPLICA_STATES, ReplicaLost, ReplicaPool
+from deep_vision_tpu.serve.quantize import (
+    QuantizationRejected,
+    QuantizedModel,
+    calibrate_and_quantize,
+    quantize_variables,
+    quantized_fn,
+)
 from deep_vision_tpu.serve.queue import BatchingQueue, QueueClosed, Request
 from deep_vision_tpu.serve.router import Server, ServerClosed
 from deep_vision_tpu.serve.slo import SHED_REASONS, SLOTracker
@@ -69,6 +76,8 @@ __all__ = [
     "DEFAULT_BUCKETS",
     "Engine",
     "ModelEntry",
+    "QuantizationRejected",
+    "QuantizedModel",
     "QueueClosed",
     "REPLICA_STATES",
     "ReplicaLost",
@@ -85,7 +94,10 @@ __all__ = [
     "SwapController",
     "TokenBucket",
     "bucket_for",
+    "calibrate_and_quantize",
     "normalize_buckets",
     "pad_batch",
+    "quantize_variables",
+    "quantized_fn",
     "split_rows",
 ]

@@ -13,7 +13,12 @@ detectors reuse `variables` across every request (donating state on an
 eval path is a use-after-free, the DV003 exemption rationale), while a
 request's input buffer is dead the moment the batch is dispatched, so
 its HBM is reusable for the outputs. inference.py's per-call jits carry
-the same donation (this PR's eval-path fix).
+the same donation. EXCEPTION: with an ExecutableCache attached, warmup
+lowers WITHOUT donation — jax's executable serialize round trip drops
+the donated-buffer bookkeeping, and a deserialized donating executable
+aliases buffers the caller still owns (measured: a segfault on the
+second call). One batch buffer of HBM is the price of every cached
+executable being safe to reload.
 """
 from __future__ import annotations
 
@@ -60,8 +65,13 @@ class Engine:
         out = eng.run("yolo", images)   # images.shape[0] must be a bucket
     """
 
-    def __init__(self, journal=None, registry=None):
+    def __init__(self, journal=None, registry=None, excache=None):
         self.journal = journal
+        #: core.excache.ExecutableCache or None: with a cache attached,
+        #: warmup() loads AOT-serialized executables instead of paying
+        #: the compiler — a restarted server (or a replica respawned
+        #: onto a fresh device) warms with ZERO backend compiles
+        self.excache = excache
         self._entries: Dict[str, ModelEntry] = {}
         self._compiled: Dict[Tuple[str, int], object] = {}
         self._warmed = False
@@ -103,8 +113,11 @@ class Engine:
     # -- warmup --------------------------------------------------------------
 
     def warmup(self) -> dict:
-        """Compile every (model, bucket) pair; returns the warmup report
-        (pairs, per-pair compile ms, backend-compile counter delta).
+        """Compile (or cache-load) every (model, bucket) pair; returns
+        the warmup report (pairs, per-pair compile ms + source,
+        backend-compile counter delta, cache hits). With an attached
+        ExecutableCache a fully warm cache means ZERO backend compiles —
+        the restarted-server / fresh-device cold path costs a disk read.
 
         The ONE sanctioned compile loop in the serving path — jaxlint's
         serve-aware DV004 exempts warm* functions and flags the same
@@ -118,8 +131,17 @@ class Engine:
         pairs = []
         for entry in self._entries.values():
             # the jit wrapper hoists out of the bucket loop: one traced
-            # callable per model, one lowering+compile per bucket shape
-            jitted = jax.jit(entry.fn, donate_argnums=1)
+            # callable per model, one lowering+compile per bucket shape.
+            # CACHE PATH LOWERS WITHOUT DONATION: jax's executable
+            # serialize round trip drops the donated-buffer bookkeeping,
+            # so a deserialized donating executable aliases buffers the
+            # caller still owns — measured as a segfault on the second
+            # call (use-after-free). The donated image buffer is one
+            # batch of HBM; correctness of every cached executable wins.
+            if self.excache is not None:
+                jitted = jax.jit(entry.fn)
+            else:
+                jitted = jax.jit(entry.fn, donate_argnums=1)
             for bucket in entry.buckets:
                 spec = jax.ShapeDtypeStruct(
                     (bucket,) + entry.input_shape, entry.dtype)
@@ -130,17 +152,23 @@ class Engine:
                     # the donation is real on TPU and free to declare here
                     warnings.filterwarnings(
                         "ignore", message="Some donated buffers")
-                    compiled = jitted.lower(entry.variables, spec).compile()
+                    lowered = jitted.lower(entry.variables, spec)
+                    if self.excache is not None:
+                        compiled, source = self.excache.get_or_compile(
+                            lowered, name=f"{entry.name}/b{bucket}")
+                    else:
+                        compiled, source = lowered.compile(), "compiled"
                 ms = (time.perf_counter() - t0) * 1e3
                 self._compiled[(entry.name, bucket)] = compiled
                 pairs.append({"model": entry.name, "bucket": bucket,
-                              "compile_ms": round(ms, 1)})
+                              "compile_ms": round(ms, 1), "source": source})
         self._warmed = True
         self._g_warmed.set(len(self._compiled))
         stats = {
             "models": len(self._entries),
             "pairs": len(pairs),
             "backend_compiles": recompile_count() - compiles_before,
+            "cache_hits": sum(1 for p in pairs if p["source"] == "cache"),
             "compile_ms_total": round(sum(p["compile_ms"] for p in pairs), 1),
             "detail": pairs,
         }
@@ -210,6 +238,7 @@ class Engine:
             self.entry(name)  # unknown model raises the clear error
         clone = Engine.__new__(Engine)
         clone.journal = self.journal
+        clone.excache = self.excache
         clone._compiled = self._compiled  # shared, read-only on this path
         clone._warmed = True
         clone._g_warmed = self._g_warmed

@@ -182,7 +182,8 @@ class ReplicaPool:
                  max_wait_ms: float = 5.0, slo_ms: Optional[float] = None,
                  health_policy: str = "warn", drain_timeout_s: float = 30.0,
                  respawn_policy: Optional[RetryPolicy] = None,
-                 monitor_interval_s: float = 0.25):
+                 monitor_interval_s: float = 0.25,
+                 respawn_fresh: bool = False):
         if replicas < 1:
             raise ValueError(f"replicas must be >= 1, got {replicas}")
         self.build_engine = build_engine
@@ -195,6 +196,14 @@ class ReplicaPool:
         self.health_policy = health_policy
         self.drain_timeout_s = float(drain_timeout_s)
         self.monitor_interval_s = float(monitor_interval_s)
+        # respawn_fresh: rebuild the ENGINE too, not just the server —
+        # the fresh-device model, where the dead replica's executables
+        # died with its device and there is nothing warm to borrow. The
+        # rebuilt engine warms through build_engine's ExecutableCache
+        # (when the factory attaches one), so even the nothing-to-borrow
+        # respawn performs zero backend compiles — cache-warm AND int8
+        # if the factory registers quantized models.
+        self.respawn_fresh = bool(respawn_fresh)
         self.respawn_policy = respawn_policy or RetryPolicy(
             name="serve.replica", max_attempts=4, base_delay_s=0.05,
             max_delay_s=1.0, journal=journal,
@@ -442,6 +451,7 @@ class ReplicaPool:
             engine = slot.engine
         self._retire(slot)
         attempts = {"n": 0}
+        fresh = {"engine": None}
 
         def build() -> _ReplicaServer:
             attempts["n"] += 1
@@ -449,7 +459,22 @@ class ReplicaPool:
             # serve.replica io_error here is a failed respawn attempt
             # the RetryPolicy backs off and retries
             faults.fire("serve.replica")
-            server = self._make_server(rid, engine)
+            server_engine = engine
+            if self.respawn_fresh:
+                # fresh-device respawn: nothing survives to borrow, so
+                # the engine rebuilds and re-warms — through the
+                # factory's ExecutableCache when one is attached, which
+                # is what keeps this path off the compiler
+                server_engine = self.build_engine(rid)
+                stats = server_engine.warmup()
+                fresh["engine"] = server_engine
+                if self.journal is not None:
+                    self.journal.write(
+                        "note", note="replica_respawn_fresh", replica=rid,
+                        pairs=stats["pairs"],
+                        backend_compiles=stats["backend_compiles"],
+                        cache_hits=stats.get("cache_hits", 0))
+            server = self._make_server(rid, server_engine)
             server.start()
             return server
 
@@ -462,6 +487,8 @@ class ReplicaPool:
                     error=f"{type(e).__name__}: {e}"[:200])
             return
         with self._lock:
+            if fresh["engine"] is not None:
+                slot.engine = fresh["engine"]
             slot.server = server
             slot.inflight = 0
             slot.retired = False  # a fresh ledger to fold in later

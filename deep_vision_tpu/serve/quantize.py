@@ -1,0 +1,289 @@
+"""Int8 post-training quantization for the serving path.
+
+The second memory-bandwidth lever of speed arc 2 (the first is the
+fused kernels): conv/dense kernels are stored int8 with per-output-
+channel symmetric scales and dequantized INSIDE the jitted executable
+(``q8.astype(f32) * scale`` feeding the matmul), so the serving engine
+streams a quarter of the weight bytes from HBM while every accumulation
+stays f32 and activations stay f16/f32 — weight-only PTQ, the
+production-inference table stakes (SNIPPETS.md [2] shards torch.int8
+weights as a matter of course).
+
+The contract is calibrate -> gate -> swap:
+
+1. :func:`quantize_variables` walks the weight tree and replaces each
+   selected kernel leaf with ``{"q8": int8, "scale": f32(c_out,)}``;
+   biases, norm scales, and batch stats stay f32 (they are tiny and
+   precision-critical).
+2. :func:`calibrate_and_quantize` runs the f32 reference and the
+   quantized function over a representative batch stream and computes
+   the accuracy delta — top-1 disagreement for logits-shaped outputs,
+   relative output MSE otherwise. A delta above ``tolerance`` REFUSES
+   to serve: typed ``quant_calibrated{model, delta, accepted: false}``
+   + :class:`QuantizationRejected`, because an int8 engine that ships
+   silently degraded predictions is worse than the f32 bandwidth bill.
+3. The accepted ``QuantizedModel`` registers on an Engine like any
+   other model (its variables ARE the int8 tree, its fn dequantizes
+   in-jit), warms through the executable cache like any other pair, and
+   subsequent re-calibrated int8 trees hot-swap through the existing
+   ``Engine.set_variables`` / ``clone_with_variables`` machinery — the
+   avals (int8 q8 + f32 scales) match, so the swap never compiles.
+
+Scales ride checkpoints through the crc32c sidecar:
+:func:`scales_host_state` / :func:`apply_scales` round-trip the
+per-channel scales as JSON host state next to the int8 arrays.
+"""
+from __future__ import annotations
+
+from typing import Callable, Iterable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deep_vision_tpu.serve.engine import ServeError
+
+__all__ = [
+    "QuantizationRejected",
+    "QuantizedModel",
+    "apply_scales",
+    "calibrate_and_quantize",
+    "dequantize_variables",
+    "quantize_variables",
+    "quantized_fn",
+    "scales_host_state",
+]
+
+#: leaf names treated as matmul/conv kernels (flax's `kernel`, the
+#: toy/test convention `w*`); everything else stays f32
+KERNEL_NAMES = ("kernel", "w", "w1", "w2")
+
+#: marker keys of one quantized leaf in the output tree
+_Q_KEYS = frozenset(("q8", "scale"))
+
+
+class QuantizationRejected(ServeError):
+    """The int8 engine's accuracy delta exceeded the gate; serving the
+    f32 engine is the only honest fallback."""
+
+
+def _default_select(path: tuple, leaf) -> bool:
+    dt = getattr(leaf, "dtype", None)
+    return (bool(path) and path[-1] in KERNEL_NAMES
+            and getattr(leaf, "ndim", 0) >= 2
+            and dt is not None and jnp.issubdtype(dt, jnp.floating))
+
+
+def _is_quantized_leaf(node) -> bool:
+    return (isinstance(node, dict) and set(node) == _Q_KEYS
+            and getattr(node["q8"], "dtype", None) == jnp.int8)
+
+
+def quantize_variables(variables, select: Optional[Callable] = None):
+    """(qvars, report): the weight tree with each selected kernel leaf
+    replaced by ``{"q8": int8, "scale": f32}``.
+
+    Per-OUTPUT-channel symmetric scales: the output channel is the last
+    axis in both flax conventions (dense ``(d_in, d_out)``, conv
+    ``(kh, kw, c_in, c_out)``), so ``scale = amax(|w|, all-but-last) /
+    127`` and ``q8 = clip(round(w / scale), -127, 127)``. Symmetric
+    (no zero point) keeps the in-jit dequant one multiply.
+    """
+    select = select or _default_select
+    report = {"quantized_leaves": 0, "skipped_leaves": 0,
+              "bytes_f32": 0, "bytes_int8": 0}
+
+    def walk(node, path):
+        if isinstance(node, dict):
+            return {k: walk(v, path + (k,)) for k, v in node.items()}
+        if hasattr(node, "items"):  # FrozenDict and friends
+            return {k: walk(v, path + (k,)) for k, v in node.items()}
+        if not select(path, node):
+            report["skipped_leaves"] += 1
+            return node
+        w = np.asarray(node, np.float32)
+        amax = np.max(np.abs(w), axis=tuple(range(w.ndim - 1)))
+        scale = np.maximum(amax / 127.0, 1e-12).astype(np.float32)
+        q8 = np.clip(np.round(w / scale), -127, 127).astype(np.int8)
+        report["quantized_leaves"] += 1
+        report["bytes_f32"] += w.nbytes
+        report["bytes_int8"] += q8.nbytes + scale.nbytes
+        return {"q8": q8, "scale": scale}
+
+    qvars = walk(variables, ())
+    if report["quantized_leaves"] == 0:
+        raise ServeError(
+            "quantize_variables found no kernel leaves (names "
+            f"{KERNEL_NAMES}, ndim >= 2); pass select= for exotic trees")
+    report["compression"] = round(
+        report["bytes_f32"] / max(1, report["bytes_int8"]), 2)
+    return qvars, report
+
+
+def dequantize_variables(qvars):
+    """The f32 weight tree, computed INSIDE jit: ``q8.astype(f32) *
+    scale`` per quantized leaf (broadcast over the output channel).
+    Accumulation downstream is f32 because the dequantized operand is."""
+    def walk(node):
+        if _is_quantized_leaf(node):
+            return node["q8"].astype(jnp.float32) * node["scale"]
+        if isinstance(node, dict):
+            return {k: walk(v) for k, v in node.items()}
+        if hasattr(node, "items"):
+            return {k: walk(v) for k, v in node.items()}
+        return node
+
+    return walk(qvars)
+
+
+def quantized_fn(fn: Callable) -> Callable:
+    """Wrap a serving predict fn ``fn(variables, images)`` so it takes
+    the int8 tree: dequant happens in-trace, so XLA fuses the
+    ``int8 -> f32 * scale`` expansion into the consumer and the weight
+    bytes crossing HBM are the int8 ones."""
+    def qfn(qvariables, images):
+        return fn(dequantize_variables(qvariables), images)
+
+    return qfn
+
+
+class QuantizedModel:
+    """An accepted calibrate-and-quantize result, ready to register:
+    ``engine.register(m.name, m.fn, m.variables, ...)``."""
+
+    __slots__ = ("name", "fn", "variables", "report", "delta", "metric",
+                 "tolerance")
+
+    def __init__(self, name, fn, variables, report, delta, metric,
+                 tolerance):
+        self.name = name
+        self.fn = fn
+        self.variables = variables
+        self.report = report
+        self.delta = delta
+        self.metric = metric
+        self.tolerance = tolerance
+
+
+def _accuracy_delta(f32_outs: list, q_outs: list) -> tuple:
+    """(delta, metric): top-1 disagreement when the output is a single
+    logits-shaped array, relative output MSE otherwise (both in [0, ~1],
+    0 = identical)."""
+    first = f32_outs[0]
+    logits_shaped = (not isinstance(first, dict)
+                     and getattr(first, "ndim", 0) == 2)
+    if logits_shaped:
+        mismatch = total = 0
+        for a, b in zip(f32_outs, q_outs):
+            a, b = np.asarray(a), np.asarray(b)
+            mismatch += int(np.sum(np.argmax(a, -1) != np.argmax(b, -1)))
+            total += a.shape[0]
+        return mismatch / max(1, total), "top1"
+    num = den = 0.0
+    for a, b in zip(f32_outs, q_outs):
+        for la, lb in zip(jax.tree_util.tree_leaves(a),
+                          jax.tree_util.tree_leaves(b)):
+            la = np.asarray(la, np.float64)
+            lb = np.asarray(lb, np.float64)
+            num += float(np.sum((la - lb) ** 2))
+            den += float(np.sum(la ** 2))
+    return num / max(den, 1e-12), "output_mse"
+
+
+def calibrate_and_quantize(
+    name: str,
+    fn: Callable,
+    variables,
+    calib_batches: Iterable,
+    tolerance: float = 0.02,
+    journal=None,
+    select: Optional[Callable] = None,
+) -> QuantizedModel:
+    """Quantize ``variables`` and GATE the result on a representative
+    batch stream: the f32 reference and the int8 function run the same
+    batches, and the delta must clear ``tolerance`` or the int8 tree is
+    refused. Every verdict is a typed ``quant_calibrated`` event.
+
+    ``calib_batches``: an iterable of input arrays shaped like serving
+    traffic (a handful is enough — the gate judges output drift, not
+    activation ranges: weight-only PTQ needs no activation statistics).
+    """
+    batches = [np.asarray(b) for b in calib_batches]
+    if not batches:
+        raise ServeError(f"calibrate_and_quantize({name!r}) needs at least "
+                         "one calibration batch")
+    qvars, report = quantize_variables(variables, select=select)
+    qfn = quantized_fn(fn)
+    f32_outs = [jax.device_get(fn(variables, b)) for b in batches]
+    q_outs = [jax.device_get(qfn(qvars, b)) for b in batches]
+    delta, metric = _accuracy_delta(f32_outs, q_outs)
+    accepted = bool(delta <= tolerance)
+    if journal is not None:
+        journal.write(
+            "quant_calibrated", model=name, delta=float(round(delta, 6)),
+            accepted=accepted, metric=metric, tolerance=float(tolerance),
+            batches=len(batches),
+            quantized_leaves=report["quantized_leaves"],
+            compression=report["compression"])
+    if not accepted:
+        raise QuantizationRejected(
+            f"int8 {name!r} failed the accuracy gate: {metric} delta "
+            f"{delta:.4g} > tolerance {tolerance:g} over {len(batches)} "
+            "calibration batches — serve the f32 engine and investigate "
+            "(an outlier channel usually wants a per-layer exclusion)")
+    return QuantizedModel(name, qfn, qvars, report, float(delta), metric,
+                          float(tolerance))
+
+
+# -- checkpoint sidecar round-trip -------------------------------------------
+
+def scales_host_state(qvars) -> dict:
+    """Per-channel scales as a JSON-serializable dict ('/'-joined path
+    -> list of floats) for the crc32c checkpoint sidecar: the int8
+    arrays ride the array checkpoint, the scales ride the sidecar, and
+    :func:`apply_scales` re-marries them at restore."""
+    out = {}
+
+    def walk(node, path):
+        if _is_quantized_leaf(node):
+            out["/".join(path)] = [float(s)
+                                   for s in np.asarray(node["scale"]).ravel()]
+            return
+        if isinstance(node, dict) or hasattr(node, "items"):
+            for k, v in node.items():
+                walk(v, path + (k,))
+
+    walk(qvars, ())
+    return out
+
+
+def apply_scales(qvars, host_scales: dict):
+    """The quantized tree with every scale replaced from sidecar host
+    state; a path or length mismatch raises instead of silently serving
+    mis-scaled weights."""
+    seen = set()
+
+    def walk(node, path):
+        if _is_quantized_leaf(node):
+            key = "/".join(path)
+            if key not in host_scales:
+                raise ServeError(
+                    f"sidecar carries no scales for quantized leaf {key!r}")
+            stored = np.asarray(host_scales[key], np.float32)
+            if stored.size != np.asarray(node["scale"]).size:
+                raise ServeError(
+                    f"sidecar scales for {key!r} have {stored.size} "
+                    f"channels, tree has {np.asarray(node['scale']).size}")
+            seen.add(key)
+            return {"q8": node["q8"],
+                    "scale": stored.reshape(np.asarray(node["scale"]).shape)}
+        if isinstance(node, dict) or hasattr(node, "items"):
+            return {k: walk(v, path + (k,)) for k, v in node.items()}
+        return node
+
+    out = walk(qvars, ())
+    extra = set(host_scales) - seen
+    if extra:
+        raise ServeError(
+            f"sidecar carries scales for unknown leaves {sorted(extra)}")
+    return out

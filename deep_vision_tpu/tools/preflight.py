@@ -31,6 +31,14 @@ fired at rc=124. This preflight front-loads those verdicts:
                     same tmp+fsync+rename shape the crc32c sidecar uses:
                     a read-only or mis-mounted volume fails before the
                     first epoch trains into an unsaveable run.
+  excache           with --excache: the persistent executable cache dir
+                    (core/excache.py) is probed end-to-end — writable
+                    with the tmp+fsync+rename shape, a trivial compiled
+                    executable AOT-round-trips (store -> load -> run,
+                    proving this backend can serialize executables), and
+                    a deliberately version-skewed entry is REFUSED (the
+                    stale-entry detector works). A bad cache mount fails
+                    here in seconds, not at the first warmup miss.
   rendezvous        with --expect-hosts: join the elastic rendezvous
                     (resilience/rendezvous.py) and run the join-time
                     client-version/platform-version exchange through
@@ -180,6 +188,90 @@ def check_ckpt_dir(path: str) -> CheckResult:
     return CheckResult("ckpt_dir", True, f"{path} writable (tmp+fsync+rename)")
 
 
+def check_excache(path: str) -> CheckResult:
+    """Probe the executable cache end-to-end: writability, AOT
+    serialize/deserialize round-trip, stale-entry refusal. Probe entries
+    are cleaned up after themselves (like the ckpt_dir probe)."""
+    import json as _json
+
+    import numpy as np
+
+    from deep_vision_tpu.core.excache import ExecutableCache
+    from deep_vision_tpu.obs.registry import Registry
+
+    try:
+        import jax
+
+        os.makedirs(path, exist_ok=True)
+        # private registry: a probe must not bump the run's excache
+        # counters before the first real warmup
+        cache = ExecutableCache(path, registry=Registry())
+        f = jax.jit(lambda x: x * 2.0 + 1.0)
+        lowered = f.lower(jax.ShapeDtypeStruct((8,), "float32"))
+        text = lowered.as_text()
+        key = cache.key_for(text)
+        compiled = lowered.compile()
+        cleanup = [key]
+        try:
+            if not cache.store(key, compiled, name="preflight-probe"):
+                return CheckResult(
+                    "excache", False,
+                    f"{path}: store failed — dir unwritable, or this "
+                    "backend cannot serialize executables (the cache "
+                    "would never hit)")
+            loaded = cache.load(key, lowered, name="preflight-probe")
+            if loaded is None:
+                return CheckResult(
+                    "excache", False,
+                    f"{path}: stored probe entry did not load back "
+                    "(corrupting filesystem, or deserialize unsupported)")
+            x = np.ones((8,), np.float32)
+            if not np.array_equal(np.asarray(loaded(x)),
+                                  np.asarray(compiled(x))):
+                return CheckResult(
+                    "excache", False,
+                    f"{path}: round-tripped executable computes a "
+                    "different answer — refuse this cache")
+            # stale-entry detection: a version-skewed manifest must be
+            # refused, never loaded (the never-load-stale contract)
+            skew_key = cache.key_for(text + "\n; preflight-skew-probe")
+            cleanup.append(skew_key)
+            cache.store(skew_key, compiled, name="preflight-skew-probe")
+            man = os.path.join(path, skew_key + ".json")
+            doc = _json.load(open(man))
+            doc["fingerprint"]["jax"] = "0.0.0-preflight-skew"
+            with open(man, "w") as fh:
+                fh.write(_json.dumps(doc))
+            if cache.load(skew_key, lowered,
+                          name="preflight-skew-probe") is not None:
+                return CheckResult(
+                    "excache", False,
+                    f"{path}: version-skewed entry LOADED — stale-entry "
+                    "detection is broken, refuse this cache",
+                    kind=KIND_VERSION_SKEW)
+        finally:
+            for k in cleanup:
+                for p in (os.path.join(path, k + ".exe"),
+                          os.path.join(path, k + ".json")):
+                    try:
+                        os.remove(p)
+                    except OSError:
+                        pass
+    except Exception as e:
+        # any probe failure — an unwritable mount (OSError), a wedged
+        # device erroring the probe compile/run (XlaRuntimeError), a
+        # serialize quirk — must render as a FAIL line, never a
+        # traceback breaking preflight's exit-0/1 contract (the same
+        # hardening check_rendezvous needed)
+        return CheckResult("excache", False,
+                           f"{path}: {type(e).__name__}: {e}")
+    n = len([f for f in os.listdir(path) if f.endswith(".json")])
+    return CheckResult(
+        "excache", True,
+        f"{path} writable, AOT round-trip ok, stale entry refused "
+        f"({n} cached entr{'y' if n == 1 else 'ies'})")
+
+
 def host_versions() -> dict:
     """This host's side of the join-time version exchange: the jax/jaxlib
     client pair plus the backend's platform_version string (on TPU, the
@@ -260,6 +352,7 @@ def run_preflight(data: int = -1, model: int = 1,
                   expect_hosts: Optional[int] = None,
                   rendezvous_dir: Optional[str] = None,
                   host_id: Optional[str] = None,
+                  excache_dir: Optional[str] = None,
                   journal=None) -> Tuple[bool, List[CheckResult]]:
     """Run every applicable check; returns (all_ok, results).
 
@@ -284,6 +377,10 @@ def run_preflight(data: int = -1, model: int = 1,
             expect_devices=expect_devices)
     if ckpt_dir:
         run(check_ckpt_dir, ckpt_dir)
+    if excache_dir and backend.ok:
+        # the probe compiles a trivial executable, so a dead backend
+        # already failed above and would only cascade here
+        run(check_excache, excache_dir)
     if expect_hosts is not None:
         if not rendezvous_dir:
             results.append(CheckResult(
@@ -333,6 +430,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--host-id", default=None,
                    help="this host's rendezvous member id (default: a "
                         "probe-scoped id that leaves after the check)")
+    p.add_argument("--excache", default=None, metavar="DIR",
+                   help="also probe this persistent executable-cache dir "
+                        "(writability, AOT round-trip, stale-entry "
+                        "refusal — core/excache.py)")
     p.add_argument("--budget", type=float, default=DEFAULT_BUDGET_S,
                    help="seconds the backend probe may take before the "
                         "tunnel is declared dead")
@@ -344,6 +445,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         expect_devices=args.expect_devices, ckpt_dir=args.ckpt_dir,
         budget_s=args.budget, expect_hosts=args.expect_hosts,
         rendezvous_dir=args.rendezvous_dir, host_id=args.host_id,
+        excache_dir=args.excache,
     )
     render(results)
     if args.json:

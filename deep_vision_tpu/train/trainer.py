@@ -97,6 +97,7 @@ class Trainer:
         backend_supervisor=None,  # resilience.BackendSupervisor or None
         data_loader=None,  # snapshot-capable DataLoader (data/snapshot.py)
         host_supervisor=None,  # resilience.rendezvous.HostSupervisor or None
+        executable_cache=None,  # core.excache.ExecutableCache or None
     ):
         self.mesh = mesh if mesh is not None else create_mesh()
         self.model = model  # single source of truth for summaries/export
@@ -288,6 +289,14 @@ class Trainer:
                     "microsteps would decay it once instead of K times and "
                     "silently change eval — run EMA at multistep=1"
                 )
+        # persistent executable cache (core/excache.py): step executables
+        # AOT-round-trip through the on-disk store, so a restarted
+        # process, the backend-loss rebuild-replay, and a re-exec'd host
+        # all load their supersteps instead of recompiling them — the
+        # recovery-time-objective stops paying the XLA compiler.
+        # Checkify is exempt (its jit carries the error plumbing and is
+        # a debugging mode, not a cold path worth caching).
+        self.excache = executable_cache
         self._build_jitted_steps()
         # device prefetch: pad/shard/device_put the NEXT batch(es) on a
         # producer thread so H2D transfer overlaps the current step's
@@ -331,6 +340,55 @@ class Trainer:
             self._train_multi = jax.jit(
                 self._multistep_impl, donate_argnums=0
             )
+        # AOT executables loaded/stored through self.excache, keyed by
+        # (step kind -> batch signature). Reset with the jit wrappers:
+        # after a backend rebuild the old executables pin dead buffers,
+        # and the next dispatch re-lowers and re-loads from the
+        # persistent cache (the disk read IS the recovery fast path).
+        # The cache-path jits DO NOT DONATE: jax's executable serialize
+        # round trip drops the donated-buffer bookkeeping, so a
+        # deserialized donating step aliases the old state's buffers
+        # while Python still thinks it owns them — measured as a
+        # segfault on the second step (use-after-free). The trade is
+        # transient 2x state memory during a cached step; flip the
+        # cache off for models where that peak matters more than
+        # cold-start.
+        self._train_step_cache = self._train_multi_cache = None
+        if self.excache is not None and not self._checkify:
+            # jaxlint: disable=DV003 -- cache-path step: donation must not ride the executable serialize round trip (deserialized donating executables alias freed buffers)
+            self._train_step_cache = jax.jit(self._train_step_impl)
+            if self.multistep > 1:
+                # jaxlint: disable=DV003 -- cache-path superstep: same serialize-round-trip donation hazard
+                self._train_multi_cache = jax.jit(self._multistep_impl)
+        self._aot_steps: dict = {}
+
+    @staticmethod
+    def _batch_sig(batch) -> tuple:
+        """Cheap shape/dtype signature of a (possibly nested) batch —
+        the AOT lookup key. Training batches are padded to a fixed
+        canonical shape, so in steady state this is one dict walk."""
+        return tuple(
+            (k, tuple(v.shape), str(getattr(v, "dtype", type(v).__name__)))
+            for k, v in sorted(batch.items()))
+
+    def _cached_step(self, kind: str, jitted, cache_jitted, batch):
+        """The executable for (kind, batch signature): loaded from the
+        persistent cache on a cold start / post-rebuild, compiled-and-
+        stored otherwise. Falls back to the plain (donating) jit wrapper
+        when no cache is attached — ``cache_jitted`` is the
+        donation-free variant of the same impl, the only shape safe to
+        serialize (see _build_jitted_steps)."""
+        if cache_jitted is None:
+            return jitted
+        by_sig = self._aot_steps.setdefault(kind, {})
+        sig = self._batch_sig(batch)
+        compiled = by_sig.get(sig)
+        if compiled is None:
+            lowered = cache_jitted.lower(self.state, batch)
+            compiled, _source = self.excache.get_or_compile(
+                lowered, name=f"trainer/{kind}")
+            by_sig[sig] = compiled
+        return compiled
 
     def _train_step_impl(self, state: TrainState, batch):
         step_rng = jax.random.fold_in(state.rng, state.step)
@@ -499,7 +557,9 @@ class Trainer:
             err.throw()  # located NaN/OOB/div0 inside the step, if any
             self.state = new_state
         else:
-            self.state, metrics = self._train_step(self.state, batch)
+            step_fn = self._cached_step("train_step", self._train_step,
+                                        self._train_step_cache, batch)
+            self.state, metrics = step_fn(self.state, batch)
         if self.ema is not None:
             self.ema.update(self.state.params)
         return metrics
@@ -522,7 +582,9 @@ class Trainer:
                 f"superstep got {k} batches, configured multistep is "
                 f"{self.multistep} (the epoch tail must use train_step)"
             )
-        self.state, metrics = self._train_multi(self.state, stacked)
+        multi_fn = self._cached_step("superstep", self._train_multi,
+                                     self._train_multi_cache, stacked)
+        self.state, metrics = multi_fn(self.state, stacked)
         return [jax.tree_util.tree_map(lambda v, i=i: v[i], metrics)
                 for i in range(k)]
 

@@ -330,7 +330,8 @@ def build_trainer(cfg: ExperimentConfig, train_fn, ckpt_dir: Optional[str],
                   opt_state_dtype: Optional[str] = None,
                   backend_supervisor=None,
                   data_loader=None,
-                  steps_per_epoch: Optional[int] = None):
+                  steps_per_epoch: Optional[int] = None,
+                  executable_cache=None):
     import functools
 
     import jax.numpy as jnp
@@ -414,6 +415,7 @@ def build_trainer(cfg: ExperimentConfig, train_fn, ckpt_dir: Optional[str],
         multistep=multistep, device_prefetch=device_prefetch,
         backend_supervisor=backend_supervisor,
         data_loader=data_loader,
+        executable_cache=executable_cache,
     )
 
 
@@ -872,6 +874,15 @@ def main(argv: Optional[List[str]] = None) -> int:
                              "compute (2 = double buffering; 0 = place on "
                              "the critical path as before); depth/starvation "
                              "ride the device_prefetch_* metrics")
+    parser.add_argument("--executable-cache", default=None, metavar="DIR",
+                        help="persistent compiled-executable cache dir "
+                             "(core/excache.py; env DVT_EXCACHE): step "
+                             "executables AOT-round-trip through the "
+                             "content-addressed store so a restarted "
+                             "process, a backend-loss rebuild, or a "
+                             "re-exec'd host loads instead of recompiling; "
+                             "also points jax_compilation_cache_dir at "
+                             "DIR/xla for the jit-traced leftovers")
     parser.add_argument("--opt-state-dtype", default=None,
                         choices=["bfloat16", "float32"],
                         help="storage dtype for optimizer state (momentum/"
@@ -903,6 +914,18 @@ def main(argv: Optional[List[str]] = None) -> int:
     from deep_vision_tpu.obs import flight as _flight_mod
 
     _flight_mod.clear_requeue()
+    # executable cache (core/excache.py): env fallback + jax's own
+    # persistent compilation cache installed BEFORE anything compiles
+    # (preflight's probe op would otherwise be the first, uncached one)
+    if not args.executable_cache:
+        from deep_vision_tpu.core.excache import EXCACHE_ENV
+
+        args.executable_cache = os.environ.get(EXCACHE_ENV) or None
+    if args.executable_cache:
+        from deep_vision_tpu.core.excache import install_jax_compilation_cache
+
+        install_jax_compilation_cache(
+            os.path.join(args.executable_cache, "xla"))
     if args.debug_nans:
         import jax as _jax_cfg
 
@@ -920,7 +943,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         pf_ckpt = args.ckpt_dir or os.path.join("checkpoints", cfg.name)
         if args.checkpoint and args.checkpoint != "auto":
             pf_ckpt = args.checkpoint  # saves follow the resume dir
-        pf_ok, pf_results = run_preflight(ckpt_dir=pf_ckpt)
+        pf_ok, pf_results = run_preflight(
+            ckpt_dir=pf_ckpt, excache_dir=args.executable_cache)
         if not pf_ok:
             render(pf_results)
             print("preflight FAILED: fix the environment (or pass "
@@ -1181,6 +1205,11 @@ def main(argv: Optional[List[str]] = None) -> int:
 
         supervisor = BackendSupervisor(max_retries=args.backend_retries,
                                        journal=journal, name="train.backend")
+    excache = None
+    if args.executable_cache:
+        from deep_vision_tpu.core.excache import ExecutableCache
+
+        excache = ExecutableCache(args.executable_cache, journal=journal)
     trainer = build_trainer(cfg, train_fn, ckpt_dir,
                             tb_dir=args.tensorboard_dir,
                             checkify_errors=args.checkify,
@@ -1196,7 +1225,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                             backend_supervisor=supervisor,
                             data_loader=data_loader,
                             steps_per_epoch=(args.data_service_steps
-                                             if args.data_service else None))
+                                             if args.data_service else None),
+                            executable_cache=excache)
     if journal is not None:
         # an unwinding run (exception/SIGTERM) still stops an in-flight
         # profiler trace and flushes writers via the atexit crash path

@@ -139,6 +139,49 @@ class TestJoin:
             r.leave()
         stale.leave()
 
+    def test_tiebreak_disagreement_gets_grace_before_self_refusal(
+            self, tmp_path):
+        """The race inside the majority vote: a correct host whose
+        compatible peers' member records have not landed yet sees a 1-1
+        tie against a stale first-writer and must NOT self-refuse on the
+        spot — the tie gets a grace window (more voters are milliseconds
+        away). A tie that PERSISTS past the grace is a genuine 1-vs-1
+        skew and still refuses in ~2 heartbeats."""
+        m = Rendezvous(str(tmp_path), "m", **FAST, client_version="jax 0.4")
+        members = {
+            "stale": {"host": "stale", "ts": time.time(), "joined_ts": 1.0,
+                      "client_version": "jax 0.3"},
+            "m": {"host": "m", "ts": time.time(), "joined_ts": 2.0,
+                  "client_version": "jax 0.4"},
+        }
+        m._check_admission(members)  # tie: grace, not refusal
+        assert m._tie_since is not None
+        # the tie persisting past the grace window IS the 1-vs-1 skew
+        m._tie_since = time.time() - 10 * FAST["heartbeat_s"]
+        with pytest.raises(RendezvousRefused) as ei:
+            m._check_admission(members)
+        assert ei.value.kind == "version_skew"
+        # ...while a compatible peer landing mid-grace breaks the tie:
+        # the majority flips, the latch clears, nobody correct refuses
+        m2 = Rendezvous(str(tmp_path / "b"), "m", **FAST,
+                        client_version="jax 0.4")
+        m2._check_admission(dict(members))
+        assert m2._tie_since is not None
+        members["n"] = {"host": "n", "ts": time.time(), "joined_ts": 3.0,
+                        "client_version": "jax 0.4"}
+        m2._check_admission(members)
+        assert m2._tie_since is None
+        # ...and a sweep that has not seen OUR OWN record yet (first
+        # poll / shared-FS listing lag) must count our self-vote: one
+        # stale record alone is a 1-1 tie, not a strict majority —
+        # instant refusal here would bypass the grace entirely
+        m3 = Rendezvous(str(tmp_path / "c"), "m", **FAST,
+                        client_version="jax 0.4")
+        m3._check_admission({
+            "stale": {"host": "stale", "ts": time.time(), "joined_ts": 1.0,
+                      "client_version": "jax 0.3"}})
+        assert m3._tie_since is not None  # grace armed, nobody refused
+
     def test_fresh_fleet_over_stale_records_forms_next_generation(
             self, tmp_path):
         # yesterday's run left gen/0.json + dead member records: a

@@ -1,0 +1,368 @@
+"""Cache smoke: the cold path must not pay the compiler twice.
+
+    PYTHONPATH=. JAX_PLATFORMS=cpu python tools/cache_smoke.py \
+        [--workdir artifacts/cache_smoke]
+
+The CI teeth behind core/excache.py + serve/quantize.py (`make
+cache-smoke`, a `make verify` prerequisite). Three REAL child processes
+share one executable-cache directory — fresh processes, because an
+in-process "second warmup" would ride jax's jit cache and prove
+nothing:
+
+  A. populate     a cold-cache Engine.warmup() compiles every
+                  (model, bucket) pair and STORES each one: the child's
+                  warmup stats show backend_compiles == pairs, and its
+                  journal carries one `excache_store` (and one
+                  `excache_miss`) per pair.
+  B. zero-compile a FRESH process over the populated cache warms with
+                  ZERO backend compiles: recompile-counter delta == 0,
+                  every pair an `excache_hit`, bit-identical outputs
+                  (the child re-runs a seeded probe batch and prints the
+                  output hash; A and B must match).
+  C. skew         the parent rewrites ONE entry's manifest fingerprint
+                  to a different jax version: the child journals exactly
+                  one typed `excache_invalid{reason: version_skew}`,
+                  recompiles exactly that pair (backend_compiles == 1),
+                  cache-hits the rest, and re-stores the refreshed entry
+                  — a stale executable is never loaded.
+  D. int8         serve/quantize.py end-to-end in the parent: clean
+                  weights calibrate, pass the accuracy-delta gate
+                  (typed `quant_calibrated accepted=true`), and the int8
+                  engine serves the same seeded traffic as the f32 one
+                  with the SLO report printed BEFORE and AFTER; then a
+                  POISONED case — weights with a cancelling-outlier
+                  channel, calibrated on the constant-image stream that
+                  exposes it — must be REFUSED (`accepted=false` +
+                  QuantizationRejected), because an int8 engine outside
+                  its gate must never serve.
+  E. artifacts    all journals pass `check_journal --strict` (excache_*
+                  + quant_calibrated schemas), obs_report renders the
+                  cold-path section, locksmith reports zero violations
+                  in every process.
+
+Exit status 0 = every contract held; 1 = something broke.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import subprocess
+import sys
+from typing import List, Optional
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+from tools.smoke_util import read_jsonl  # noqa: E402
+
+IMG = (8, 8, 1)
+BUCKETS = (1, 2, 4)
+#: unique computations per child = len(MODELS) * len(BUCKETS)
+PAIRS = 2 * len(BUCKETS)
+
+
+class Failures:
+    def __init__(self):
+        self.errors: List[str] = []
+
+    def check(self, ok: bool, what: str) -> bool:
+        print(("  ok  " if ok else "  FAIL") + f"  {what}")
+        if not ok:
+            self.errors.append(what)
+        return ok
+
+
+def build_models():
+    """Two deterministic toy models (a dense scorer and a small conv
+    net): identical weights in every child process, so runs A/B/C lower
+    to identical stablehlo and the cache keys line up."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    rng = np.random.RandomState(42)
+    dense_w = {"w": (rng.randn(int(np.prod(IMG)), 8) * 0.1)
+               .astype(np.float32)}
+    conv_vars = {
+        "conv": {"kernel": (rng.randn(3, 3, 1, 8) * 0.2).astype(np.float32)},
+        "dense": {"kernel": (rng.randn(8, 4) * 0.3).astype(np.float32)},
+    }
+
+    def dense_fn(variables, images):
+        flat = images.reshape(images.shape[0], -1)
+        return {"scores": jnp.tanh(flat @ variables["w"])}
+
+    def conv_fn(variables, images):
+        import jax
+
+        y = jax.lax.conv_general_dilated(
+            images, variables["conv"]["kernel"], (1, 1), "SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        y = jnp.maximum(y, 0.0).mean(axis=(1, 2))
+        return {"scores": y @ variables["dense"]["kernel"]}
+
+    return {"dense": (dense_fn, dense_w), "conv": (conv_fn, conv_vars)}
+
+
+# -- child: one warmup over the shared cache dir ------------------------------
+
+def child_main(argv: List[str]) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--cache", required=True)
+    p.add_argument("--journal", required=True)
+    args = p.parse_args(argv)
+    import hashlib
+
+    import numpy as np
+
+    from deep_vision_tpu.core.excache import ExecutableCache
+    from deep_vision_tpu.obs import RunJournal, locksmith
+    from deep_vision_tpu.serve import Engine
+
+    journal = RunJournal(args.journal, kind="serve")
+    journal.manifest(config={"name": "cache_smoke_child", "task": "serving"})
+    locksmith.arm(journal=journal)
+    excache = ExecutableCache(args.cache, journal=journal)
+    engine = Engine(journal=journal, excache=excache)
+    for name, (fn, variables) in build_models().items():
+        engine.register(name, fn, variables, IMG, buckets=BUCKETS)
+    stats = engine.warmup()
+    # seeded probe batch through every model: the parent compares the
+    # output hash across runs — a cached executable must be
+    # bit-identical to a freshly compiled one
+    probe = np.random.RandomState(7).rand(2, *IMG).astype(np.float32)
+    h = hashlib.sha256()
+    for name in sorted(engine.models):
+        h.update(np.asarray(engine.run(name, probe)["scores"]).tobytes())
+    lock_report = locksmith.report()
+    locksmith.disarm()
+    journal.close()
+    print(json.dumps({
+        "pairs": stats["pairs"],
+        "backend_compiles": stats["backend_compiles"],
+        "cache_hits": stats["cache_hits"],
+        "output_sha": h.hexdigest(),
+        "lock_violations": len(lock_report["violations"]),
+    }), flush=True)
+    return 0
+
+
+# -- parent --------------------------------------------------------------------
+
+def run_child(work: str, cache_dir: str, tag: str) -> Optional[dict]:
+    j_path = os.path.join(work, f"journal_{tag}.jsonl")
+    env = dict(os.environ, PYTHONPATH=ROOT, JAX_PLATFORMS="cpu")
+    env.pop("DVT_FAULT_SPEC", None)
+    env.pop("DVT_FAULT_SEED", None)
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--child",
+         "--cache", cache_dir, "--journal", j_path],
+        cwd=ROOT, env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True, timeout=600)
+    if proc.returncode != 0:
+        print(f"  child {tag} FAILED rc={proc.returncode}\n{proc.stderr[-2000:]}")
+        return None
+    line = proc.stdout.strip().splitlines()[-1]
+    return json.loads(line)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if argv and argv[0] == "--child":
+        return child_main(argv[1:])
+
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--workdir", default="artifacts/cache_smoke")
+    args = p.parse_args(argv)
+
+    work = os.path.abspath(args.workdir)
+    shutil.rmtree(work, ignore_errors=True)
+    os.makedirs(work)
+    cache_dir = os.path.join(work, "excache")
+    f = Failures()
+
+    # -- phase A: cold cache populates ----------------------------------
+    print(f"phase A: cold-cache warmup compiles + stores every pair")
+    a = run_child(work, cache_dir, "a")
+    if a is None:
+        return 1
+    f.check(a["pairs"] == PAIRS and a["backend_compiles"] == PAIRS
+            and a["cache_hits"] == 0,
+            f"run A compiled all {PAIRS} pairs "
+            f"({a['backend_compiles']} compiles, {a['cache_hits']} hits)")
+    ev_a = read_jsonl(os.path.join(work, "journal_a.jsonl"))
+    stores = [e for e in ev_a if e.get("event") == "excache_store"]
+    misses = [e for e in ev_a if e.get("event") == "excache_miss"]
+    f.check(len(stores) == PAIRS and len(misses) == PAIRS,
+            f"journal A: one excache_store + one excache_miss per pair "
+            f"({len(stores)} stores, {len(misses)} misses)")
+    f.check(a["lock_violations"] == 0, "run A: locksmith clean")
+
+    # -- phase B: fresh process, zero compiles --------------------------
+    print("phase B: FRESH process over the populated cache: zero "
+          "backend compiles")
+    b = run_child(work, cache_dir, "b")
+    if b is None:
+        return 1
+    f.check(b["backend_compiles"] == 0,
+            f"recompile-counter delta == 0 across warmup "
+            f"({b['backend_compiles']})")
+    f.check(b["cache_hits"] == PAIRS,
+            f"every pair loaded from cache ({b['cache_hits']}/{PAIRS})")
+    ev_b = read_jsonl(os.path.join(work, "journal_b.jsonl"))
+    hits = [e for e in ev_b if e.get("event") == "excache_hit"]
+    f.check(len(hits) == PAIRS and not any(
+        e.get("event") in ("excache_store", "excache_miss",
+                           "excache_invalid") for e in ev_b),
+            f"journal B: all excache_hit, nothing stored or refused "
+            f"({len(hits)} hits)")
+    f.check(a["output_sha"] == b["output_sha"],
+            "cached executables compute BIT-IDENTICAL outputs "
+            f"({b['output_sha'][:16]}...)")
+    f.check(b["lock_violations"] == 0, "run B: locksmith clean")
+
+    # -- phase C: version-skewed entry refused + recompiled -------------
+    print("phase C: a version-skewed entry journals excache_invalid and "
+          "falls through to the compiler")
+    manifests = sorted(fn for fn in os.listdir(cache_dir)
+                       if fn.endswith(".json"))
+    f.check(len(manifests) == PAIRS, f"cache holds {PAIRS} manifests")
+    victim = os.path.join(cache_dir, manifests[0])
+    doc = json.load(open(victim))
+    doc["fingerprint"]["jax"] = "0.0.0-cache-smoke-skew"
+    with open(victim, "w") as fh:
+        fh.write(json.dumps(doc))
+    c = run_child(work, cache_dir, "c")
+    if c is None:
+        return 1
+    f.check(c["backend_compiles"] == 1 and c["cache_hits"] == PAIRS - 1,
+            f"exactly the skewed pair recompiled "
+            f"({c['backend_compiles']} compiles, {c['cache_hits']} hits)")
+    ev_c = read_jsonl(os.path.join(work, "journal_c.jsonl"))
+    invalid = [e for e in ev_c if e.get("event") == "excache_invalid"]
+    f.check(len(invalid) == 1
+            and invalid[0].get("reason") == "version_skew",
+            f"typed excache_invalid{{version_skew}} journaled ({invalid})")
+    f.check(sum(1 for e in ev_c if e.get("event") == "excache_store") == 1,
+            "the refreshed entry was re-stored for the next cold start")
+    f.check(a["output_sha"] == c["output_sha"],
+            "outputs still bit-identical after the skew fall-through")
+
+    # -- phase D: int8 calibrate -> gate -> serve, and the refusal ------
+    print("phase D: int8 gate accepts clean weights (SLO before/after) "
+          "and refuses poisoned ones")
+    import numpy as np
+
+    from deep_vision_tpu.obs import RunJournal, locksmith
+    from deep_vision_tpu.obs.registry import Registry
+    from deep_vision_tpu.serve import Engine, Server
+    from deep_vision_tpu.serve.quantize import (
+        QuantizationRejected,
+        calibrate_and_quantize,
+    )
+
+    j_path = os.path.join(work, "journal_int8.jsonl")
+    journal = RunJournal(j_path, kind="serve")
+    journal.manifest(config={"name": "cache_smoke_int8", "task": "serving"})
+    locksmith.arm(journal=journal)
+    models = build_models()
+    dense_fn, dense_w = models["dense"]
+    rng = np.random.RandomState(5)
+    calib = [rng.rand(4, *IMG).astype(np.float32) for _ in range(4)]
+    qm = calibrate_and_quantize("dense", dense_fn, dense_w, calib,
+                                tolerance=0.02, journal=journal)
+    f.check(qm.delta <= 0.02,
+            f"clean weights pass the gate ({qm.metric} delta "
+            f"{qm.delta:.2g}, {qm.report['compression']}x compression)")
+
+    def serve_traffic(engine_name, fn, variables) -> "Server":
+        registry = Registry()
+        eng = Engine(journal=journal, registry=registry)
+        eng.register("dense", fn, variables, IMG, buckets=BUCKETS)
+        eng.warmup()
+        server = Server(eng, journal=journal, registry=registry,
+                        max_wait_ms=5.0, tags={"engine": engine_name})
+        server.start()
+        t_rng = np.random.RandomState(11)  # same seeded traffic for both
+        for _ in range(16):
+            out = server.submit(
+                "dense", t_rng.rand(*IMG).astype(np.float32)
+            ).result(timeout=120)
+            assert out["scores"].shape == (8,), out["scores"].shape
+        server.close()
+        return server
+
+    f32_server = serve_traffic("f32", dense_fn, dense_w)
+    int8_server = serve_traffic("int8", qm.fn, qm.variables)
+    print("  SLO before (f32):")
+    print("    " + f32_server.slo.render().replace("\n", "\n    "))
+    print("  SLO after (int8):")
+    print("    " + int8_server.slo.render().replace("\n", "\n    "))
+    f.check(f32_server.counts()["completed"] == 16
+            and int8_server.counts()["completed"] == 16,
+            "both engines served the full seeded traffic")
+
+    # the poisoned case: a cancelling-outlier channel that only the
+    # constant-image calibration stream exposes — quantization zeroes
+    # the small weights carrying the real signal, the gate must fire
+    poisoned_w = {"w": dense_w["w"].copy()}
+    poisoned_w["w"][0, :], poisoned_w["w"][1, :] = 500.0, -500.0
+    poison_calib = [np.full((4, *IMG), v, np.float32)
+                    for v in (0.2, 0.5, 0.8, 0.3)]
+    refused = False
+    try:
+        calibrate_and_quantize("dense", dense_fn, poisoned_w, poison_calib,
+                               tolerance=0.005, journal=journal)
+    except QuantizationRejected:
+        refused = True
+    f.check(refused, "poisoned weights REFUSED by the accuracy-delta gate")
+    lock_report = locksmith.report()
+    locksmith.disarm()
+    journal.close()
+    f.check(not lock_report["violations"], "int8 phase: locksmith clean")
+    ev_q = read_jsonl(j_path)
+    quants = [e for e in ev_q if e.get("event") == "quant_calibrated"]
+    f.check(len(quants) == 2 and quants[0].get("accepted") is True
+            and quants[1].get("accepted") is False,
+            f"both calibration verdicts journaled (accepted="
+            f"{[e.get('accepted') for e in quants]})")
+
+    # -- phase E: artifacts validate ------------------------------------
+    print("phase E: strict journals + cold-path report section")
+    env = dict(os.environ, PYTHONPATH=ROOT)
+    all_journals = [os.path.join(work, f"journal_{t}.jsonl")
+                    for t in ("a", "b", "c", "int8")]
+    rc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "check_journal.py")]
+        + all_journals + ["--strict"],
+        cwd=ROOT, env=env).returncode
+    f.check(rc == 0, "check_journal --strict accepts all four journals "
+                     "(excache_* + quant_calibrated schemas)")
+    rep = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "obs_report.py"),
+         os.path.join(work, "journal_c.jsonl")],
+        cwd=ROOT, env=env, stdout=subprocess.PIPE, text=True)
+    f.check(rep.returncode == 0 and "executable cache" in rep.stdout
+            and "version_skew" in rep.stdout,
+            "obs_report renders the executable-cache row with the "
+            "refusal reason")
+    rep2 = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "obs_report.py"),
+         j_path],
+        cwd=ROOT, env=env, stdout=subprocess.PIPE, text=True)
+    f.check(rep2.returncode == 0 and "int8 dense" in rep2.stdout
+            and "REFUSED" in rep2.stdout,
+            "obs_report renders both int8 calibration verdicts")
+
+    if f.errors:
+        print(f"\ncache-smoke: {len(f.errors)} contract(s) BROKEN "
+              f"(artifacts in {work})")
+        return 1
+    print(f"\ncache-smoke: the cold path never pays the compiler twice "
+          f"(artifacts in {work})")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

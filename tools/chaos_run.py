@@ -87,6 +87,8 @@ from typing import List, Optional
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, ROOT)
 
+from tools.smoke_util import read_jsonl  # noqa: E402
+
 CONFIG = "lenet5_chaos"
 SCHEMA = "chaos_mnist"
 EPOCHS = 3
@@ -202,21 +204,6 @@ def start_child(train_args: List[str], log_path: str,
         stderr=subprocess.STDOUT,
     )
     return proc, log
-
-
-def read_jsonl(path: str) -> List[dict]:
-    if not os.path.exists(path):
-        return []
-    out = []
-    with open(path) as f:
-        for line in f:
-            line = line.strip()
-            if line:
-                try:
-                    out.append(json.loads(line))
-                except json.JSONDecodeError:
-                    pass  # a torn final line is the crash phases' signature
-    return out
 
 
 def check_journal_strict(path: str) -> bool:

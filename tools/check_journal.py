@@ -72,6 +72,11 @@ EVENT_FIELDS = {
     "data_worker_lost": ("worker", "attempt"),
     "data_worker_recovered": ("worker", "attempt"),
     "data_service": ("role", "batches"),
+    "excache_hit": ("key",),
+    "excache_miss": ("key",),
+    "excache_store": ("key",),
+    "excache_invalid": ("key", "reason"),
+    "quant_calibrated": ("model", "delta", "accepted"),
     "host_lost": ("host", "generation"),
     "host_joined": ("host", "generation"),
     "world_resized": ("from", "to", "generation", "resume_step"),
@@ -109,6 +114,10 @@ BACKEND_LOST_KINDS = {"connection_lost", "timeout", "version_skew",
 # checkpointed position, 'fresh' = the checkpoint carried no loader state
 DATA_RESUME_VERDICTS = {"restored", "fresh"}
 DATA_SERVICE_ROLES = {"server", "client"}
+# cold path (core/excache.py EXCACHE_INVALID_REASONS, kept in sync by
+# tests/test_excache.py): why a present cache entry was refused
+EXCACHE_INVALID_REASONS = {"version_skew", "topology_skew", "corrupt",
+                           "deserialize_failed"}
 
 
 def check_journal(path: str, require_exit: bool = False,
@@ -250,6 +259,22 @@ def check_journal(path: str, require_exit: bool = False,
             if not isinstance(row.get("batches"), int):
                 errors.append(f"{path}:{i}: data_service batches must be "
                               f"an int, got {row.get('batches')!r}")
+        if ev in ("excache_hit", "excache_miss", "excache_store",
+                  "excache_invalid"):
+            if not isinstance(row.get("key"), str) or not row.get("key"):
+                errors.append(f"{path}:{i}: {ev} key must be a cache key "
+                              f"string, got {row.get('key')!r}")
+            if ev == "excache_invalid" and \
+                    row.get("reason") not in EXCACHE_INVALID_REASONS:
+                errors.append(f"{path}:{i}: unknown excache_invalid reason "
+                              f"{row.get('reason')!r}")
+        if ev == "quant_calibrated":
+            if not isinstance(row.get("accepted"), bool):
+                errors.append(f"{path}:{i}: quant_calibrated accepted must "
+                              f"be a bool, got {row.get('accepted')!r}")
+            if not isinstance(row.get("delta"), (int, float)):
+                errors.append(f"{path}:{i}: quant_calibrated delta must be "
+                              f"numeric, got {row.get('delta')!r}")
         if ev in ("host_lost", "host_joined"):
             # elastic membership events (resilience/rendezvous.py):
             # host is a member ID string, generation the rendezvous

@@ -46,6 +46,8 @@ from typing import Dict, List, Optional
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, ROOT)
 
+from tools.smoke_util import read_jsonl  # noqa: E402
+
 HOSTS = 3
 DEVICES_PER_HOST = 2
 GLOBAL_BS = 12           # divisible by 3 hosts, 2 hosts, and both meshes
@@ -194,21 +196,6 @@ def worker_main(args) -> int:
 
 
 # -- parent: orchestration + assertions ----------------------------------------
-
-def read_jsonl(path: str) -> List[dict]:
-    if not os.path.exists(path):
-        return []
-    out = []
-    with open(path) as f:
-        for line in f:
-            line = line.strip()
-            if line:
-                try:
-                    out.append(json.loads(line))
-                except json.JSONDecodeError:
-                    pass  # the SIGKILLed host's torn final line
-    return out
-
 
 def check_journal_strict(path: str) -> bool:
     rc = subprocess.run(

@@ -61,6 +61,8 @@ from typing import Callable, List, Optional
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, ROOT)
 
+from tools.smoke_util import read_jsonl  # noqa: E402
+
 IMG = (4, 4, 1)
 BUCKETS = (1, 2, 4)
 SLO_MS = 2000.0  # the held-through-chaos promise; generous for CI boxes
@@ -238,8 +240,16 @@ def main(argv: Optional[List[str]] = None) -> int:
     locksmith.arm(journal=journal)
     registry = Registry()
 
+    # persistent executable cache (core/excache.py): replica 0 compiles
+    # and stores, every later warmup — including the FRESH-ENGINE respawn
+    # in phase 2 — loads instead of compiling
+    from deep_vision_tpu.core.excache import ExecutableCache
+
+    excache = ExecutableCache(os.path.join(work, "excache"),
+                              journal=journal, registry=registry)
+
     def build_engine(rid: str) -> Engine:
-        eng = Engine(journal=journal, registry=registry)
+        eng = Engine(journal=journal, registry=registry, excache=excache)
         eng.register("toy", toy_fn, toy_variables(), input_shape=IMG,
                      buckets=BUCKETS)
         eng.register("aux", aux_fn, aux_variables(), input_shape=IMG,
@@ -248,9 +258,14 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     # -- phase 1: fleet warmup ------------------------------------------
     print(f"phase 1: {args.replicas} replicas warm their engines (AOT)")
+    # respawn_fresh: a dead replica rebuilds its ENGINE too — the
+    # fresh-device model, where nothing warm survives to borrow and the
+    # executable cache is the only thing between recovery and the
+    # compiler (phase 2 asserts the respawned warmup compiled NOTHING)
     pool = ReplicaPool(build_engine, replicas=args.replicas,
                        journal=journal, registry=registry,
-                       max_wait_ms=4.0, slo_ms=SLO_MS)
+                       max_wait_ms=4.0, slo_ms=SLO_MS,
+                       respawn_fresh=True)
     pool.start()
     pairs = args.replicas * 2 * len(BUCKETS)
     f.check(pool.warmup_stats["pairs"] == pairs,
@@ -260,6 +275,11 @@ def main(argv: Optional[List[str]] = None) -> int:
             f"warmup compiled every unique computation "
             f"({pool.warmup_stats['backend_compiles']} backend compiles; "
             "the cache may dedupe across replicas)")
+    f.check(pool.warmup_stats["backend_compiles"] == 2 * len(BUCKETS),
+            "executable cache deduped warmup across replicas: exactly one "
+            f"compile per unique (model, bucket) pair "
+            f"({pool.warmup_stats['backend_compiles']} compiles for "
+            f"{pairs} pairs)")
     # prep for phases 3/4 BEFORE the compile baseline: eager host-side
     # reference math and orbax saves compile their own tiny executables,
     # and the zero-compile contract below is about the SERVING path —
@@ -306,6 +326,18 @@ def main(argv: Optional[List[str]] = None) -> int:
     f.check(pool.submit("toy", np.random.RandomState(5).rand(*IMG)
                         .astype(np.float32)).result(timeout=60) is not None,
             "pool answers after the respawn")
+    fresh_notes = [e for e in read_jsonl(j_path)
+                   if e.get("event") == "note"
+                   and e.get("note") == "replica_respawn_fresh"]
+    f.check(len(fresh_notes) == 1
+            and fresh_notes[0].get("backend_compiles") == 0
+            and fresh_notes[0].get("cache_hits")
+            == fresh_notes[0].get("pairs"),
+            "fresh-engine respawn warmed ENTIRELY from the executable "
+            "cache (zero backend compiles, "
+            f"{fresh_notes[0].get('cache_hits') if fresh_notes else '?'}"
+            f"/{fresh_notes[0].get('pairs') if fresh_notes else '?'} "
+            "pairs cache-hit)")
 
     # -- phase 3: canary swap, auto-promote -----------------------------
     print("phase 3: canary weight swap promotes under live traffic")
@@ -404,15 +436,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     f.check(not os.listdir(flight_dir) if os.path.isdir(flight_dir)
             else True, "clean run left no flight bundle")
 
-    ev = []
-    with open(j_path) as fh:
-        for line in fh:
-            line = line.strip()
-            if line:
-                try:
-                    ev.append(json.loads(line))
-                except json.JSONDecodeError:
-                    pass
+    ev = read_jsonl(j_path)
     losts = [e for e in ev if e.get("event") == "replica_lost"]
     recs = [e for e in ev if e.get("event") == "replica_recovered"]
     f.check(len(losts) == 1 and len(recs) == 1

@@ -100,6 +100,9 @@ def summarize_run(events: List[dict]) -> dict:
     membership = summarize_membership(events)
     if membership:
         out["membership"] = membership
+    cold_path = summarize_cold_path(events)
+    if cold_path:
+        out["cold_path"] = cold_path
     terminal = next(
         (e for e in reversed(events) if e.get("event") in ("exit", "crash")),
         None)
@@ -269,6 +272,38 @@ def summarize_membership(events: List[dict]) -> Optional[dict]:
              ("generation", "from", "to", "shard_index", "num_shards")
              if e.get(k) is not None}
             for e in reshards]
+    return out
+
+
+def summarize_cold_path(events: List[dict]) -> Optional[dict]:
+    """The executable-cache / quantization view (core/excache.py +
+    serve/quantize.py events): hit/miss/store/invalid counts with the
+    invalid reasons spelled out, plus each calibration verdict. None
+    when the journal carries no cold-path events — training-only and
+    pre-cache serving reports render byte-unchanged."""
+    hits = [e for e in events if e.get("event") == "excache_hit"]
+    misses = [e for e in events if e.get("event") == "excache_miss"]
+    stores = [e for e in events if e.get("event") == "excache_store"]
+    invalid = [e for e in events if e.get("event") == "excache_invalid"]
+    quants = [e for e in events if e.get("event") == "quant_calibrated"]
+    if not (hits or misses or stores or invalid or quants):
+        return None
+    out: dict = {"hits": len(hits), "misses": len(misses),
+                 "stores": len(stores), "invalid": len(invalid)}
+    if invalid:
+        by_reason: dict = {}
+        for e in invalid:
+            r = str(e.get("reason", "?"))
+            by_reason[r] = by_reason.get(r, 0) + 1
+        out["invalid_reasons"] = by_reason
+    if quants:
+        out["quant"] = [
+            {"model": e.get("model", "?"),
+             "metric": e.get("metric", "?"),
+             "delta": e.get("delta"),
+             "tolerance": e.get("tolerance"),
+             "accepted": bool(e.get("accepted"))}
+            for e in quants]
     return out
 
 
@@ -509,6 +544,25 @@ def render(summary: dict) -> str:
                          f", this host now shard "
                          f"{e.get('shard_index', '?')}/"
                          f"{e.get('num_shards', '?')}"))
+    # cold path (core/excache.py + serve/quantize.py): cache hit/miss/
+    # store accounting with refused entries by reason, and each int8
+    # calibration verdict — the "did this restart pay the compiler"
+    # and "is the int8 engine inside its gate" answers
+    cold = summary.get("cold_path")
+    if cold:
+        parts = (f"{cold['hits']} hit, {cold['misses']} miss, "
+                 f"{cold['stores']} stored")
+        if cold["invalid"]:
+            reasons = ", ".join(f"{n} {r}" for r, n in
+                                sorted(cold["invalid_reasons"].items()))
+            parts += f", {cold['invalid']} refused ({reasons})"
+        rows.append(("executable cache", parts))
+        for q in cold.get("quant", []):
+            verdict = "accepted" if q["accepted"] else "REFUSED"
+            detail = f"{q['metric']} delta {q['delta']}"
+            if q.get("tolerance") is not None:
+                detail += f" (tolerance {q['tolerance']})"
+            rows.append((f"  int8 {q['model']}", f"{verdict}: {detail}"))
     # profiler captures: every decision the autoprof policy made, so the
     # table answers "why does this run have three trace dirs" directly
     for e in summary.get("captures", []):

@@ -19,14 +19,20 @@ proves the preemption path:
                   boundary: exactly one request fails with the injected
                   error, everyone else (including requests submitted
                   after) is served — request-scoped degradation.
-  4. clean close  drain journals `serve_drain(close, flushed)`, the
+  4. int8         the pose model calibrates and quantizes
+                  (serve/quantize.py): per-channel int8 weights pass the
+                  accuracy-delta gate (typed `quant_calibrated`), the
+                  quantized engine serves the same traffic through its
+                  own warmed server, and the SLO report prints BEFORE
+                  (f32) and AFTER (int8) so the swap is a number.
+  5. clean close  drain journals `serve_drain(close, flushed)`, the
                   journal passes `check_journal --strict` (serve_*
                   schemas + trace), obs_report renders the serving
                   summary, and the flight dir is EMPTY — a healthy
                   shutdown leaves no postmortem. The runtime lock
                   sanitizer (obs/locksmith.py), armed since startup,
                   must report ZERO lock-order violations.
-  5. sigterm      a child server under live traffic gets SIGTERM: it
+  6. sigterm      a child server under live traffic gets SIGTERM: it
                   must flush every accepted request, journal
                   `serve_drain(sigterm, flushed)`, leave a crc-valid
                   `preempt` flight bundle, and exit 0 with a clean
@@ -50,6 +56,8 @@ from typing import List, Optional
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, ROOT)
 
+from tools.smoke_util import read_jsonl  # noqa: E402
+
 INPUT_SHAPE = (64, 64, 3)
 YOLO_BUCKETS = (1, 2, 4)
 POSE_BUCKETS = (1, 2, 4)
@@ -65,21 +73,6 @@ class Failures:
         if not ok:
             self.errors.append(what)
         return ok
-
-
-def read_jsonl(path: str) -> List[dict]:
-    if not os.path.exists(path):
-        return []
-    out = []
-    with open(path) as f:
-        for line in f:
-            line = line.strip()
-            if line:
-                try:
-                    out.append(json.loads(line))
-                except json.JSONDecodeError:
-                    pass
-    return out
 
 
 def check_journal_strict(path: str, trace: Optional[str] = None) -> bool:
@@ -286,8 +279,53 @@ def main(argv: Optional[List[str]] = None) -> int:
     f.check(after.shape == (4, 3),
             "server keeps answering after the injected fault")
 
-    # -- phase 4: clean close leaves no postmortem ----------------------
-    print("phase 4: clean shutdown — strict journal, no flight bundle")
+    # -- phase 4: int8 calibrate -> gate -> serve -----------------------
+    print("phase 4: int8 quantization passes the gate and serves "
+          "(SLO before/after)")
+    from deep_vision_tpu.serve.quantize import (
+        QuantizationRejected,
+        calibrate_and_quantize,
+    )
+
+    pose_fn, pose_vars, pose_buckets = models["pose"]
+    calib = [np.stack([rand_image(rng) for _ in range(2)])
+             for _ in range(4)]
+    try:
+        qm = calibrate_and_quantize("pose", pose_fn, pose_vars, calib,
+                                    tolerance=0.02, journal=journal)
+        f.check(True, f"int8 pose passed the gate ({qm.metric} delta "
+                      f"{qm.delta:.2g} <= 0.02, "
+                      f"{qm.report['compression']}x weight compression)")
+    except QuantizationRejected as e:
+        qm = None
+        f.check(False, f"int8 pose refused by the gate: {e}")
+    if qm is not None:
+        from deep_vision_tpu.obs.registry import Registry
+
+        # private registry: the int8 SLO must be its own numbers, not
+        # the f32 histograms with more samples mixed in
+        q_registry = Registry()
+        q_engine = Engine(journal=journal, registry=q_registry)
+        q_engine.register("pose", qm.fn, qm.variables, INPUT_SHAPE,
+                          buckets=pose_buckets)
+        q_engine.warmup()
+        q_server = Server(q_engine, journal=journal, registry=q_registry,
+                          max_wait_ms=MAX_WAIT_MS, tags={"engine": "int8"})
+        q_server.start()
+        for _ in range(12):
+            out = q_server.submit("pose", rand_image(rng)).result(timeout=120)
+            assert out.shape == (4, 3), out.shape
+        q_summary = q_server.close()
+        f.check(q_summary["outcome"] == "flushed",
+                f"int8 server drained clean ({q_summary['completed']} "
+                "served)")
+        print("  SLO before (f32):")
+        print("    " + server.slo.render().replace("\n", "\n    "))
+        print("  SLO after (int8):")
+        print("    " + q_server.slo.render().replace("\n", "\n    "))
+
+    # -- phase 5: clean close leaves no postmortem ----------------------
+    print("phase 5: clean shutdown — strict journal, no flight bundle")
     summary = server.close()
     f.check(summary["outcome"] == "flushed" and summary["pending"] == 0,
             f"close drained everything ({summary})")
@@ -328,8 +366,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     f.check(not any(e.get("event") == "lock_order_violation" for e in ev),
             "journal carries zero lock_order_violation events")
 
-    # -- phase 5: SIGTERM drain in a child server -----------------------
-    print("phase 5: SIGTERM drain flushes in-flight requests + dumps "
+    # -- phase 6: SIGTERM drain in a child server -----------------------
+    print("phase 6: SIGTERM drain flushes in-flight requests + dumps "
           "a preempt flight bundle")
     log_path = os.path.join(work, "sigterm_child.log")
     env = dict(os.environ, PYTHONPATH=ROOT, JAX_PLATFORMS="cpu")

@@ -1,0 +1,27 @@
+"""Shared helpers for the smoke harnesses (serve/fleet/cache/host/chaos).
+
+One tolerant JSONL reader instead of five drifting copies: smokes read
+journals whose FINAL line may be torn (a SIGKILLed child's signature),
+so undecodable lines are skipped, a missing file is an empty list, and
+the caller asserts on the events that did land.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import List
+
+
+def read_jsonl(path: str) -> List[dict]:
+    if not os.path.exists(path):
+        return []
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                try:
+                    out.append(json.loads(line))
+                except json.JSONDecodeError:
+                    pass  # a torn final line (crash/SIGKILL mid-write)
+    return out
