@@ -51,7 +51,7 @@ lint-baseline:
 # obs-smoke and chaos-smoke — the telemetry artifacts must validate and
 # the resilience contracts must hold before the tests count
 verify: SHELL := /bin/bash
-verify: lint preflight perf-smoke obs-smoke chaos-smoke data-smoke host-smoke serve-smoke fleet-smoke cache-smoke
+verify: lint preflight perf-smoke obs-smoke chaos-smoke data-smoke host-smoke serve-smoke fleet-smoke cache-smoke shard-smoke
 	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
 
 # environment preflight: backend liveness + libtpu/client version
@@ -121,6 +121,19 @@ fleet-smoke:
 # calibration is REFUSED). Journals pass check_journal --strict
 cache-smoke:
 	JAX_PLATFORMS=cpu python tools/cache_smoke.py --workdir artifacts/cache_smoke
+
+# shard smoke: declarative sharding on a forced 8-device CPU mesh
+# (tools/shard_smoke.py) — ViT and the V-MoE variant train GENUINELY
+# sharded multi-step (table-resolved NamedShardings on device, zero
+# recompiles after warmup), tp_sharded_leaves clears each family's
+# declared floor via the TABLE (and beats the size heuristic it
+# replaces), a deliberately gutted table fails at startup NAMING the
+# replicated leaves, scaling efficiency is measured at data={1,2,4,8}
+# sub-meshes, and the journals (typed sharding_resolved + bench
+# events) pass check_journal --strict with obs_report rendering the
+# sharding section
+shard-smoke:
+	JAX_PLATFORMS=cpu python tools/shard_smoke.py --workdir artifacts/shard_smoke
 
 # resilience smoke: a record-backed CPU train under injected faults
 # (skipped bad records within budget, SIGKILL mid-checkpoint-save,
@@ -212,4 +225,4 @@ ps:
 native:
 	$(MAKE) -C native
 
-.PHONY: train resume train-fg test lint lint-baseline verify preflight obs-smoke chaos-smoke data-smoke host-smoke serve-smoke fleet-smoke cache-smoke perf-smoke bench bench-evidence roofline demo demo-gan demo-real dryrun tb ps native
+.PHONY: train resume train-fg test lint lint-baseline verify preflight obs-smoke chaos-smoke data-smoke host-smoke serve-smoke fleet-smoke cache-smoke shard-smoke perf-smoke bench bench-evidence roofline demo demo-gan demo-real dryrun tb ps native

@@ -329,6 +329,53 @@ def _log(msg: str) -> None:
     print(f"bench: {msg}", file=sys.stderr, flush=True)
 
 
+# steps per timed scaling window; small by default — four sub-mesh builds
+# each pay a compile, and the signal is the RATIO between rows, which
+# stabilizes in a handful of steps
+MULTICHIP_STEPS = int(os.environ.get("BENCH_MULTICHIP_STEPS", "16"))
+MULTICHIP_BATCH = int(os.environ.get("BENCH_MULTICHIP_BATCH", "16"))
+
+
+def multichip_result_stub() -> dict:
+    return {"metric": "multichip_scaling", "value": 0.0,
+            "unit": "efficiency_fraction", "rows": []}
+
+
+def multichip_main(result: dict) -> None:
+    """MULTICHIP mode: the scaling-efficiency block that replaces the
+    dryrun's bare `loss=OK` as the multi-chip artifact's payload.
+
+    A table-sharded (parallel/shardmap.py) slim-flagship train step is
+    timed at data={1,2,4,8} sub-meshes of the available devices
+    (deep_vision_tpu/tools/scaling.py); the one contract JSON line
+    carries throughput + per-device examples/s per row and the
+    efficiency fraction vs the 1-device baseline as the headline value
+    — also journaled as a typed `bench` event under --journal, so
+    obs_report renders the curve and MULTICHIP_r0N rounds diff as
+    numbers."""
+    from deep_vision_tpu.tools.scaling import (
+        format_rows,
+        measure_scaling,
+        scaling_result,
+    )
+
+    _log(f"multichip scaling: {len(jax.devices())} devices, "
+         f"{MULTICHIP_STEPS} steps x batch {MULTICHIP_BATCH}/device")
+    try:
+        rows = measure_scaling(batch_per_device=MULTICHIP_BATCH,
+                               steps=MULTICHIP_STEPS)
+        print(format_rows(rows), file=sys.stderr, flush=True)
+        result.update(scaling_result(rows))
+    except KeyboardInterrupt:
+        raise
+    except Exception as e:
+        result["errors"] = result.get("errors", []) + [
+            f"{type(e).__name__}: {e}"]
+        _log(f"fatal: {type(e).__name__}: {e}")
+    finally:
+        _emit(result)
+
+
 def _cold_start_fields() -> dict:
     """cache-cold vs cache-warm cold start, measured in the SAME run on
     the same probe computation (core/excache.py round trip):
@@ -952,6 +999,15 @@ if __name__ == "__main__":
     parser.add_argument("--sweep", metavar="OUT_JSON", default=None,
                         help="run the dispatch-overhead/batch sweep and "
                              "write the artifact JSON")
+    parser.add_argument("--multichip", action="store_true",
+                        help="MULTICHIP scaling mode: time a table-sharded "
+                             "train step at data={1,2,4,8} sub-meshes and "
+                             "emit the scaling-efficiency block (throughput, "
+                             "per-device examples/s, efficiency vs the "
+                             "1-device baseline) — the perf number that "
+                             "replaces the dryrun's loss=OK smoke "
+                             "(BENCH_MULTICHIP_STEPS/_BATCH tune the "
+                             "windows)")
     parser.add_argument("--flight-dir", default=None, metavar="DIR",
                         help="flight recorder (obs/flight.py): dump a "
                              "postmortem bundle under DIR if the bench "
@@ -980,6 +1036,10 @@ if __name__ == "__main__":
         # 'host' mode never touches a device: no liveness gate needed
         run = lambda: data_main(args.data, args.num_procs)
         needs_device = args.data == "fused"
+    elif args.multichip:
+        stub = multichip_result_stub()
+        run = lambda: multichip_main(stub)
+        needs_device = True
     elif args.sweep:
         stub = {"metric": "dispatch_sweep", "artifact": args.sweep,
                 "rows": []}

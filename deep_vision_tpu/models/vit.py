@@ -28,7 +28,6 @@ Inception V1's aux heads (losses/classification.py handles the plumbing).
 """
 from __future__ import annotations
 
-import os
 from typing import Optional, Tuple
 
 import flax.linen as nn
@@ -36,30 +35,14 @@ import jax
 import jax.numpy as jnp
 
 from deep_vision_tpu.models import register_model
+# the flash routing floor lives with the kernel (shared by this backbone
+# and parallel/ring_attention.py); re-exported here for the historical
+# import path (tests, train_cli)
+from deep_vision_tpu.ops.pallas.flash_attention import (  # noqa: F401
+    FLASH_MIN_TOKENS,
+    flash_min_tokens,
+)
 from deep_vision_tpu.parallel.moe import load_balancing_loss
-
-# below this many tokens the dense einsum beats the flash kernel (and the
-# kernel's 128-lane tiling would need padding anyway). The floor is a
-# per-platform tuning knob — the crossover sits elsewhere on a v5e than
-# on a v4 — so DVT_FLASH_MIN_TOKENS overrides it at trace time, the
-# DVT_NMS_IMPL convention (a routing knob must never no-op on a typo)
-FLASH_MIN_TOKENS = 1024
-
-
-def flash_min_tokens() -> int:
-    """The routing floor, env-overridable; a mistyped value raises
-    instead of silently running the default."""
-    env = os.environ.get("DVT_FLASH_MIN_TOKENS")
-    if env is None:
-        return FLASH_MIN_TOKENS
-    try:
-        return int(env)
-    except ValueError:
-        raise ValueError(
-            f"DVT_FLASH_MIN_TOKENS={env!r} is not an integer token count "
-            f"(default {FLASH_MIN_TOKENS}; lower routes shorter sequences "
-            "onto the flash kernel, higher keeps them on the dense einsum)"
-        ) from None
 
 
 class Attention(nn.Module):

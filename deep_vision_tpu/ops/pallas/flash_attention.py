@@ -35,6 +35,7 @@ come from that sweep.
 from __future__ import annotations
 
 import functools
+import os
 from typing import Optional
 
 import jax
@@ -43,6 +44,32 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
+
+# below this many tokens the dense einsum beats the flash kernel (and the
+# kernel's 128-lane tiling would need padding anyway). The floor is a
+# per-platform tuning knob — the crossover sits elsewhere on a v5e than
+# on a v4 — so DVT_FLASH_MIN_TOKENS overrides it at trace time, the
+# DVT_NMS_IMPL convention (a routing knob must never no-op on a typo).
+# Lives with the kernel so BOTH consumers — the ViT backbone
+# (models/vit.py) and ring attention's per-shard compute
+# (parallel/ring_attention.py) — route through the same floor.
+FLASH_MIN_TOKENS = 1024
+
+
+def flash_min_tokens() -> int:
+    """The routing floor, env-overridable; a mistyped value raises
+    instead of silently running the default."""
+    env = os.environ.get("DVT_FLASH_MIN_TOKENS")
+    if env is None:
+        return FLASH_MIN_TOKENS
+    try:
+        return int(env)
+    except ValueError:
+        raise ValueError(
+            f"DVT_FLASH_MIN_TOKENS={env!r} is not an integer token count "
+            f"(default {FLASH_MIN_TOKENS}; lower routes shorter sequences "
+            "onto the flash kernel, higher keeps them on the dense einsum)"
+        ) from None
 
 
 def _causal_mask(s, qi, ki, block_q, block_k):

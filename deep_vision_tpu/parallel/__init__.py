@@ -9,6 +9,17 @@ from deep_vision_tpu.parallel.mesh import (
     sharding_coverage,
     local_mesh_devices,
 )
+from deep_vision_tpu.parallel.shardmap import (
+    FAMILY_RULES,
+    HeuristicRules,
+    MOE_RULES,
+    RESNET_RULES,
+    VIT_RULES,
+    ShardingRuleError,
+    ShardingRules,
+    get_rules,
+    rules_for,
+)
 from deep_vision_tpu.parallel.moe import (
     expert_param_sharding,
     moe_ffn,

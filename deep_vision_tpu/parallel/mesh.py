@@ -84,19 +84,35 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
-def data_sharding(mesh: Mesh, ndim: int = 1) -> NamedSharding:
-    """Shard the leading (batch) dimension over the 'data' axis."""
-    return NamedSharding(mesh, P(DATA_AXIS, *([None] * (ndim - 1))))
+def _batch_entry(axes: Sequence[str]):
+    """The PartitionSpec entry for a batch dim sharded over `axes` (one
+    axis name, or a tuple for multi-axis batch layouts like
+    ('data', 'model') fully-data-parallel tables)."""
+    axes = tuple(axes)
+    return axes[0] if len(axes) == 1 else axes
 
 
-def stacked_data_sharding(mesh: Mesh, ndim: int = 2) -> NamedSharding:
+def data_sharding(mesh: Mesh, ndim: int = 1,
+                  axes: Sequence[str] = (DATA_AXIS,)) -> NamedSharding:
+    """Shard the leading (batch) dimension over `axes` (default 'data').
+    Declarative sharding tables (parallel/shardmap.py) may declare other
+    batch axes; the Trainer threads them through here."""
+    return NamedSharding(
+        mesh, P(_batch_entry(axes), *([None] * (ndim - 1))))
+
+
+def stacked_data_sharding(mesh: Mesh, ndim: int = 2,
+                          axes: Sequence[str] = (DATA_AXIS,)
+                          ) -> NamedSharding:
     """Sharding for a (K, B, ...) stacked superstep batch (train/trainer.py
     multistep mode): the scan axis K replicates, the batch dim shards over
-    'data' — each dispatch carries K microsteps' batches in one transfer."""
-    return NamedSharding(mesh, P(None, DATA_AXIS, *([None] * (ndim - 2))))
+    the table's batch axes (default 'data') — each dispatch carries K
+    microsteps' batches in one transfer."""
+    return NamedSharding(
+        mesh, P(None, _batch_entry(axes), *([None] * (ndim - 2))))
 
 
-def shard_batch(mesh: Mesh, batch):
+def shard_batch(mesh: Mesh, batch, axes: Sequence[str] = (DATA_AXIS,)):
     """Place a host batch (pytree of np/jnp arrays) with batch-dim sharding.
 
     The device boundary of the framework: everything before this call is
@@ -110,7 +126,7 @@ def shard_batch(mesh: Mesh, batch):
             # re-place an array whose shards live on other hosts)
             return x
         x = np.asarray(x)
-        return jax.device_put(x, data_sharding(mesh, x.ndim))
+        return jax.device_put(x, data_sharding(mesh, x.ndim, axes))
 
     return jax.tree_util.tree_map(_place, batch)
 
@@ -153,14 +169,15 @@ class ShardingCoverageError(ValueError):
 def sharding_coverage(tree, shardings) -> dict:
     """Coverage stats for a (params/state, shardings) pair.
 
-    Returns {"float_leaves", "sharded", "replicated", "unmatched"}:
-    `sharded` counts float leaves whose NamedSharding references at least
-    one mesh axis, `replicated` the rest, and `unmatched` lists the paths
-    of float leaves the sharding tree does not cover with a Sharding at
-    all (a declarative rule that stopped matching, a structure drift).
-    Non-float leaves (step counters, RNG keys, labels) are ignored — only
-    the leaves whose placement decides memory and collective traffic
-    count."""
+    Returns {"float_leaves", "sharded", "replicated", "replicated_paths",
+    "unmatched"}: `sharded` counts float leaves whose NamedSharding
+    references at least one mesh axis, `replicated` the rest (their paths
+    in `replicated_paths` — the 108 -> 34 incident was undebuggable from
+    bare counts), and `unmatched` lists the paths of float leaves the
+    sharding tree does not cover with a Sharding at all (a declarative
+    rule that stopped matching, a structure drift). Non-float leaves
+    (step counters, RNG keys, labels) are ignored — only the leaves whose
+    placement decides memory and collective traffic count."""
     import jax.numpy as jnp
     from jax.sharding import Sharding
 
@@ -169,7 +186,7 @@ def sharding_coverage(tree, shardings) -> dict:
         shardings, is_leaf=lambda x: isinstance(x, Sharding))
     by_path = {jax.tree_util.keystr(p): s for p, s in flat_s}
     stats = {"float_leaves": 0, "sharded": 0, "replicated": 0,
-             "unmatched": []}
+             "replicated_paths": [], "unmatched": []}
     for p, x in flat_t:
         dtype = getattr(x, "dtype", None)
         if dtype is None or not jnp.issubdtype(dtype, jnp.floating):
@@ -184,7 +201,14 @@ def sharding_coverage(tree, shardings) -> dict:
             stats["sharded"] += 1
         else:
             stats["replicated"] += 1
+            stats["replicated_paths"].append(path)
     return stats
+
+
+def _sample_paths(paths, n: int = 5) -> str:
+    sample = ", ".join(paths[:n])
+    more = len(paths) - n
+    return sample + (f" (+{more} more)" if more > 0 else "")
 
 
 def assert_sharding_coverage(tree, shardings, mesh=None, min_sharded: int = 0,
@@ -210,21 +234,27 @@ def assert_sharding_coverage(tree, shardings, mesh=None, min_sharded: int = 0,
     except Exception:
         pass  # metrics must not turn the check itself into a crash
     if stats["unmatched"]:
-        sample = ", ".join(stats["unmatched"][:5])
-        more = len(stats["unmatched"]) - 5
         raise ShardingCoverageError(
             f"{len(stats['unmatched'])} float leaf(s) matched NO sharding "
-            f"rule: {sample}" + (f" (+{more} more)" if more > 0 else "")
-            + " — every float leaf must resolve to a sharding; a rule "
+            f"rule: {_sample_paths(stats['unmatched'])}"
+            " — every float leaf must resolve to a sharding; a rule "
             "stopped matching or the state structure drifted")
     if stats["sharded"] < min_sharded:
         shape = dict(mesh.shape) if mesh is not None else "?"
+        # name the leaves that fell back to replication, not just the
+        # counts: the 108 -> 34 regression was undebuggable from the
+        # numbers alone — the operator needs to see WHICH leaves the
+        # rules stopped sharding to find the rule that went stale
+        named = ""
+        if stats["replicated_paths"]:
+            named = ("; replicated float leaves: "
+                     + _sample_paths(stats["replicated_paths"]))
         raise ShardingCoverageError(
             f"sharded-leaf count regressed: {stats['sharded']} < floor "
             f"{min_sharded} (mesh {shape}, {stats['float_leaves']} float "
             "leaves) — the tp_sharded_leaves 108 -> 34 regression "
             "signature; check the sharding rules against the current "
-            "model structure")
+            f"model structure{named}")
     return stats
 
 

@@ -183,6 +183,23 @@ def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool,
     return (o / denom).astype(out_dtype)
 
 
+def _default_use_flash(t_loc: int) -> bool:
+    """Flash-kernel routing for a ring shard of `t_loc` local tokens:
+    TPU only, at or above the shared `flash_min_tokens()` floor
+    (ops/pallas/flash_attention.py; DVT_FLASH_MIN_TOKENS overrides it
+    per platform — the ring path must honor the same knob as ViT, not
+    a hard-coded 1024), AND block-divisible: `_flash_block` runs the
+    kernel at block_q=512 / block_k=1024, whose grid asserts
+    `t % block == 0` — a lowered floor must route a 768-token shard to
+    the dense body, not into the kernel's shape assert (the same
+    `t % 1024 == 0` guard models/vit.py keeps)."""
+    from deep_vision_tpu.ops.pallas.flash_attention import flash_min_tokens
+
+    return (jax.default_backend() == "tpu"
+            and t_loc >= flash_min_tokens()
+            and t_loc % 1024 == 0)
+
+
 def ring_attention(
     q, k, v, mesh: Mesh, *, causal: bool = False,
     axis_name: str = DATA_AXIS, scale: Optional[float] = None,
@@ -195,11 +212,12 @@ def ring_attention(
 
     `use_flash` routes each ring step through the fused Pallas kernel
     (O(T_loc) memory instead of a dense (T_loc, T_loc) score block); default
-    None auto-enables it on TPU for long local shards.
+    None auto-enables it on TPU for long local shards (the
+    `flash_min_tokens()` floor, DVT_FLASH_MIN_TOKENS-overridable — the
+    same knob that governs the ViT backbone's routing).
     """
     if use_flash is None:
-        t_loc = q.shape[1] // mesh.shape[axis_name]
-        use_flash = jax.default_backend() == "tpu" and t_loc >= 1024
+        use_flash = _default_use_flash(q.shape[1] // mesh.shape[axis_name])
     spec = P(None, axis_name, None, None)
     body = _ring_attention_local_flash if use_flash else _ring_attention_local
     fn = functools.partial(

@@ -1,0 +1,505 @@
+"""Declarative pattern -> PartitionSpec sharding tables.
+
+The `infer_tp_sharding` size heuristic (parallel/mesh.py) decides tensor
+parallelism from leaf shapes alone — which is exactly how the
+`tp_sharded_leaves` count silently regressed 108 -> 34 between MULTICHIP
+r03 and r05: a model refactor changed shapes, the heuristic changed its
+mind, and nothing could say WHICH leaves it dropped or WHY. PR 10 turned
+the regression into a hard startup failure; this module makes the
+sharding itself an auditable artifact instead of an emergent property.
+
+A `ShardingRules` table is an ORDERED list of (pattern, spec) pairs in
+the GSPMD/pjit tradition (the `"layers.*.attention.wo.weight":
+('fsdp', 'tp')` style):
+
+- leaf paths are flattened to dotted names (`params.ViTBlock_0.
+  Attention_0.qkv.kernel`) and NORMALIZED: pure-integer path tokens
+  become `*` (optimizer-state tuple indices, torch-style `layers.11.`);
+  flax's `Name_N` suffixes stay LITERAL — `Mlp_0.Dense_0` vs
+  `Mlp_0.Dense_1` distinguishes the column- from the row-parallel
+  projection — and the pattern's glob (`ViTBlock_*`) generalizes over
+  layer indices, so one table covers every depth of a model family;
+- patterns are glob-style (`fnmatch`) over the normalized path;
+  FIRST MATCH WINS, so specific rules shadow general ones by order;
+- every table must end in a catch-all `"*"` rule — a leaf that no rule
+  covers is a construction-time error, never a silent replicate;
+- a spec is a tuple of per-dimension entries (None, an axis name, or a
+  tuple of axis names — `PartitionSpec` semantics). Unknown mesh axes
+  and specs longer than the leaf's rank REFUSE at resolve time; an axis
+  that does not divide the dimension is dropped (replicating that dim,
+  the `elastic.replace_on_mesh` convention) and counted in the report.
+
+`resolve(tree, mesh)` returns a full `NamedSharding` tree for the state
+(params, optimizer momentum — whose paths carry the param path as a
+suffix, so the same leading-`*` rules match — BN stats, rng, counters)
+plus a rule -> leaf resolution report that the Trainer journals as a
+typed `sharding_resolved` event and `assert_sharding_coverage` audits
+against the family's declared floor at startup.
+"""
+from __future__ import annotations
+
+import dataclasses
+import fnmatch
+import re
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from deep_vision_tpu.parallel.mesh import (
+    DATA_AXIS,
+    MODEL_AXIS,
+    infer_tp_sharding,
+    sharding_coverage,
+)
+
+__all__ = [
+    "ShardingRuleError",
+    "ShardingRules",
+    "HeuristicRules",
+    "VIT_RULES",
+    "MOE_RULES",
+    "RESNET_RULES",
+    "FAMILY_RULES",
+    "rules_for",
+    "get_rules",
+    "leaf_path",
+    "normalize_path",
+    "resolution_event_fields",
+]
+
+
+class ShardingRuleError(ValueError):
+    """A sharding table is malformed (missing catch-all, bad spec), or a
+    rule cannot apply to the leaf it matched (unknown mesh axis, spec
+    longer than the leaf's rank). Raised at table construction or at
+    startup resolve — never mid-run."""
+
+
+_INT_TOKEN = re.compile(r"^\d+$")
+
+
+def leaf_path(key_path) -> str:
+    """Dotted path of a `tree_flatten_with_path` key path:
+    `(GetAttrKey('params'), DictKey('ViTBlock_0'), DictKey('kernel'))`
+    -> `params.ViTBlock_0.kernel`."""
+    toks = []
+    for k in key_path:
+        if hasattr(k, "name"):  # GetAttrKey (flax.struct fields)
+            toks.append(str(k.name))
+        elif hasattr(k, "key"):  # DictKey / FlattenedIndexKey
+            toks.append(str(k.key))
+        elif hasattr(k, "idx"):  # SequenceKey (optax state tuples)
+            toks.append(str(k.idx))
+        else:
+            toks.append(str(k))
+    return ".".join(toks)
+
+
+def normalize_path(path: str) -> str:
+    """Integer -> `*` name normalization (SNIPPETS.md [2]'s
+    `_process_sharding_name`): every pure-integer path token becomes
+    `*`, so `layers.11.attention.wo.weight` normalizes to
+    `layers.*.attention.wo.weight` and the optimizer state's tuple
+    indices (`opt_state.1.0.trace...`) disappear from the match. Flax's
+    `Name_N` layer suffixes are NOT normalized — `Mlp_0.Dense_0` vs
+    `Mlp_0.Dense_1` distinguishes the column- from the row-parallel
+    projection — the PATTERN's glob (`ViTBlock_*`) generalizes over
+    layer indices instead."""
+    return ".".join(
+        "*" if _INT_TOKEN.match(t) else t for t in path.split("."))
+
+
+def _floor_for(mesh: Mesh, min_sharded: int,
+               floor_axes: Sequence[str]) -> int:
+    """The coverage floor a mesh must clear: the declared `min_sharded`
+    when every floor axis is actually present with size > 1, else 0 (a
+    pure-DP mesh replicates by design). Shared by the table and the
+    heuristic fallback so their gating can never diverge."""
+    shape = dict(mesh.shape)
+    if all(shape.get(a, 0) > 1 for a in floor_axes):
+        return int(min_sharded)
+    return 0
+
+
+def _validate_spec(pattern: str, spec) -> tuple:
+    if not isinstance(spec, (tuple, list)):
+        raise ShardingRuleError(
+            f"rule {pattern!r}: spec must be a tuple of per-dimension "
+            f"entries (None / axis name / tuple of axis names), got "
+            f"{spec!r}")
+    for entry in spec:
+        if entry is None or isinstance(entry, str):
+            continue
+        if isinstance(entry, (tuple, list)) and all(
+                isinstance(a, str) for a in entry):
+            continue
+        raise ShardingRuleError(
+            f"rule {pattern!r}: spec entry {entry!r} must be None, an "
+            "axis name, or a tuple of axis names")
+    return tuple(tuple(e) if isinstance(e, list) else e for e in spec)
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardingRules:
+    """One model family's declarative sharding table.
+
+    rules: ordered ((pattern, spec), ...) — first match wins; the LAST
+    rule must be the catch-all `("*", ...)` so no leaf can fall through
+    unseen. min_sharded: the family's declared coverage floor — the
+    startup `assert_sharding_coverage` fails when fewer float leaves
+    actually shard (`floor_for(mesh)` waives it on meshes where the
+    floor's axes have size 1, e.g. a pure-DP mesh). batch_axes: the
+    mesh axes the BATCH leading dim shards over — the Trainer places
+    single batches, multistep superstep stacks, and device-prefetched
+    batches per this declaration.
+    """
+
+    name: str
+    rules: Tuple[Tuple[str, tuple], ...]
+    min_sharded: int = 0
+    batch_axes: Tuple[str, ...] = (DATA_AXIS,)
+    floor_axes: Tuple[str, ...] = (MODEL_AXIS,)
+
+    def __post_init__(self):
+        if not self.rules:
+            raise ShardingRuleError(f"table {self.name!r} has no rules")
+        validated = tuple(
+            (str(pat), _validate_spec(str(pat), spec))
+            for pat, spec in self.rules)
+        object.__setattr__(self, "rules", validated)
+        if validated[-1][0] != "*":
+            raise ShardingRuleError(
+                f"table {self.name!r} has no catch-all: the LAST rule "
+                "must be ('*', ...) so every leaf resolves explicitly — "
+                "a leaf no rule covers must be a decision, not an "
+                "accident")
+        seen = set()
+        for pat, _ in validated:
+            if pat in seen:
+                raise ShardingRuleError(
+                    f"table {self.name!r}: duplicate pattern {pat!r} — "
+                    "the second copy can never match (first match wins)")
+            seen.add(pat)
+        for field in ("batch_axes", "floor_axes"):
+            axes = getattr(self, field)
+            if not isinstance(axes, (tuple, list)) or (
+                    field == "batch_axes" and not axes) or not all(
+                    isinstance(a, str) and a for a in axes):
+                raise ShardingRuleError(
+                    f"table {self.name!r}: {field} must be a "
+                    f"{'non-empty ' if field == 'batch_axes' else ''}"
+                    f"tuple of axis names, got {axes!r}")
+            object.__setattr__(self, field, tuple(axes))
+
+    # -- matching ----------------------------------------------------------
+    def match(self, path: str) -> Tuple[str, tuple]:
+        """(pattern, spec) of the first rule matching the NORMALIZED
+        path — the catch-all guarantees a hit."""
+        norm = normalize_path(path)
+        for pat, spec in self.rules:
+            if fnmatch.fnmatchcase(norm, pat):
+                return pat, spec
+        raise ShardingRuleError(  # unreachable: catch-all is enforced
+            f"table {self.name!r}: no rule matched {norm!r}")
+
+    def floor_for(self, mesh: Mesh) -> int:
+        return _floor_for(mesh, self.min_sharded, self.floor_axes)
+
+    # -- resolution --------------------------------------------------------
+    def _entry_for(self, entry, dim: int, mesh_shape: dict, path: str,
+                   pat: str, report: dict):
+        if entry is None:
+            return None
+        axes = (entry,) if isinstance(entry, str) else tuple(entry)
+        for a in axes:
+            if a not in mesh_shape:
+                raise ShardingRuleError(
+                    f"table {self.name!r} rule {pat!r}: unknown mesh "
+                    f"axis {a!r} (mesh has {sorted(mesh_shape)}) at "
+                    f"leaf {path}")
+        size = int(np.prod([mesh_shape[a] for a in axes]))
+        if size <= 1:
+            return None  # axis of size 1: sharding over it IS
+            # replication — resolve to None so the coverage count stays
+            # honest (a table must not claim tp_sharded_leaves on a mesh
+            # with no model parallelism)
+        if dim % size != 0:
+            # the replace_on_mesh convention: an axis that does not
+            # divide the dim replicates that dim instead of failing the
+            # whole family table on one odd-width layer — counted, so
+            # the coverage floor still catches a table gone stale
+            report["dropped_dims"].append(
+                {"path": path, "rule": pat, "dim": dim, "axes": axes})
+            return None
+        return entry
+
+    def resolve(self, tree, mesh: Mesh):
+        """(shardings, report): a NamedSharding for EVERY leaf of
+        `tree`, and the rule -> leaf resolution report journaled as the
+        typed `sharding_resolved` event.
+
+        report = {model, mesh, rules: {pattern: hits}, float_leaves,
+        matched, unmatched, unmatched_paths, sharded_leaves,
+        replicated, dropped_dims}. `matched` counts float leaves an
+        EXPLICIT rule claimed; `unmatched` those only the catch-all
+        caught — the number whose growth means the table went stale.
+        """
+        import jax.numpy as jnp
+
+        mesh_shape = dict(mesh.shape)
+        # batch axes resolve at startup too: a typo'd axis must refuse
+        # HERE (the same loud-at-construction/startup contract the rule
+        # specs have), not as a raw KeyError at the first train step
+        for a in self.batch_axes:
+            if a not in mesh_shape:
+                raise ShardingRuleError(
+                    f"table {self.name!r}: batch axis {a!r} is not a "
+                    f"mesh axis (mesh has {sorted(mesh_shape)})")
+        report = {
+            "model": self.name,
+            "mesh": {k: int(v) for k, v in mesh_shape.items()},
+            "rules": {pat: 0 for pat, _ in self.rules},
+            "float_leaves": 0,
+            "matched": 0,
+            "unmatched": 0,
+            "unmatched_paths": [],
+            "sharded_leaves": 0,
+            "replicated": 0,
+            "dropped_dims": [],
+        }
+        catch_all = self.rules[-1][0]
+
+        def resolve_leaf(key_path, leaf):
+            path = leaf_path(key_path)
+            pat, spec = self.match(path)
+            shape = getattr(leaf, "shape", ())
+            if len(spec) > len(shape):
+                raise ShardingRuleError(
+                    f"table {self.name!r} rule {pat!r}: spec {spec!r} "
+                    f"has {len(spec)} entries but leaf {path} has rank "
+                    f"{len(shape)} (shape {tuple(shape)}) — a rule must "
+                    "never imply axes the tensor does not have")
+            entries = [
+                self._entry_for(e, int(shape[d]), mesh_shape, path, pat,
+                                report)
+                for d, e in enumerate(spec)
+            ]
+            dtype = getattr(leaf, "dtype", None)
+            if dtype is not None and jnp.issubdtype(dtype, jnp.floating):
+                # the per-rule ledger counts FLOAT leaves only, so its
+                # rows stay consistent with the matched/unmatched/
+                # sharded counts beside it (a catch-all hit on the rng
+                # key must not read as a leaf falling through)
+                report["rules"][pat] += 1
+                report["float_leaves"] += 1
+                if pat == catch_all:
+                    report["unmatched"] += 1
+                    report["unmatched_paths"].append(path)
+                else:
+                    report["matched"] += 1
+                if any(e is not None for e in entries):
+                    report["sharded_leaves"] += 1
+                else:
+                    report["replicated"] += 1
+            return NamedSharding(mesh, P(*entries))
+
+        shardings = jax.tree_util.tree_map_with_path(resolve_leaf, tree)
+        return shardings, report
+
+
+def resolution_event_fields(report: dict) -> dict:
+    """The journal payload of a resolve report: the typed
+    `sharding_resolved` schema (tools/check_journal.py --strict) plus
+    the per-rule hit counts obs_report renders. Path lists are capped —
+    a journal event is a summary, the full report is the return value
+    of `resolve()`."""
+    return {
+        "model": str(report["model"]),
+        "matched": int(report["matched"]),
+        "unmatched": int(report["unmatched"]),
+        "sharded_leaves": int(report["sharded_leaves"]),
+        "replicated": int(report["replicated"]),
+        "float_leaves": int(report["float_leaves"]),
+        "mesh": dict(report["mesh"]),
+        "rules": dict(report["rules"]),
+        "unmatched_paths": list(report["unmatched_paths"][:8]),
+        "dropped_dims": len(report["dropped_dims"]),
+    }
+
+
+@dataclasses.dataclass(frozen=True)
+class HeuristicRules:
+    """The `infer_tp_sharding` size heuristic behind the SAME interface
+    — the EXPLICIT fallback for model families without a curated table
+    (`--sharding-rules heuristic`). Its report has no per-rule
+    breakdown (the heuristic has one implicit rule), which is exactly
+    why the curated tables exist."""
+
+    name: str = "heuristic"
+    min_size: int = 4096
+    min_sharded: int = 0
+    batch_axes: Tuple[str, ...] = (DATA_AXIS,)
+    floor_axes: Tuple[str, ...] = (MODEL_AXIS,)
+
+    def floor_for(self, mesh: Mesh) -> int:
+        return _floor_for(mesh, self.min_sharded, self.floor_axes)
+
+    def resolve(self, tree, mesh: Mesh):
+        mesh_shape = dict(mesh.shape)
+        for a in self.batch_axes:
+            if a not in mesh_shape:
+                raise ShardingRuleError(
+                    f"heuristic rules: batch axis {a!r} is not a mesh "
+                    f"axis (mesh has {sorted(mesh_shape)})")
+        shardings = infer_tp_sharding(tree, mesh, min_size=self.min_size)
+        stats = sharding_coverage(tree, shardings)
+        report = {
+            "model": self.name,
+            "mesh": {k: int(v) for k, v in dict(mesh.shape).items()},
+            "rules": {f"<size heuristic min_size={self.min_size}>":
+                      stats["sharded"]},
+            "float_leaves": stats["float_leaves"],
+            "matched": stats["sharded"],
+            "unmatched": stats["replicated"],
+            "unmatched_paths": list(stats.get("replicated_paths", []))[:8],
+            "sharded_leaves": stats["sharded"],
+            "replicated": stats["replicated"],
+            "dropped_dims": [],
+        }
+        return shardings, report
+
+
+# -- curated family tables ----------------------------------------------------
+#
+# Axis conventions (parallel/mesh.py): 'data' = batch, 'model' = tensor
+# parallel. Leading-`*` patterns intentionally match BOTH params and the
+# optimizer momentum mirrors (their flattened paths carry the param path
+# as a suffix under opt_state...trace), so moments shard with their
+# params — the bf16-momentum HBM win scales with TP too.
+
+#: ViT family (models/vit.py): Megatron-style column->row pairing.
+#: qkv splits the HEAD dim, the out projection contracts it; the MLP
+#: splits hidden on the way up and contracts it on the way down — each
+#: pair costs one all-reduce, the textbook TP layout.
+VIT_RULES = ShardingRules(
+    name="vit",
+    rules=(
+        # attention: qkv DenseGeneral kernel (D, 3, H, Dh) / bias (3, H, Dh)
+        ("*.Attention_*.qkv.kernel", (None, None, MODEL_AXIS, None)),
+        ("*.Attention_*.qkv.bias", (None, MODEL_AXIS, None)),
+        # out projection (H, Dh, D): contracting dim sharded, bias full
+        ("*.Attention_*.out.kernel", (MODEL_AXIS, None, None)),
+        ("*.Attention_*.out.bias", ()),
+        # MLP: hidden up-projection column-split, down-projection row-split
+        ("*.Mlp_*.Dense_0.kernel", (None, MODEL_AXIS)),
+        ("*.Mlp_*.Dense_0.bias", (MODEL_AXIS,)),
+        ("*.Mlp_*.Dense_1.kernel", (MODEL_AXIS, None)),
+        ("*.Mlp_*.Dense_1.bias", ()),
+        # patch embed conv (P, P, C, D): embed dim split
+        ("*.patch_embed.kernel", (None, None, None, MODEL_AXIS)),
+        ("*.patch_embed.bias", (MODEL_AXIS,)),
+        ("*.pos_embed", ()),
+        ("*.LayerNorm_*.*", ()),
+        # classifier head (D, classes): vocab-style output split. Last
+        # of the Dense rules: the Mlp rules above already claimed the
+        # block MLPs (first match wins).
+        ("*.Dense_*.kernel", (None, MODEL_AXIS)),
+        ("*.Dense_*.bias", (MODEL_AXIS,)),
+        ("*.hyperparams.*", ()),
+        ("*", ()),
+    ),
+    min_sharded=12,
+)
+
+#: V-MoE family (models/vit.py MoeMlp + parallel/moe.py layout): the
+#: ViT attention/MLP rules plus the expert/router split — expert
+#: params (E, ...) shard their leading EXPERT dim over the model axis
+#: (each model-rank owns E/m experts), the router stays replicated
+#: (every token scores every expert locally; only expert compute is
+#: distributed).
+MOE_RULES = ShardingRules(
+    name="moe",
+    rules=(
+        ("*.MoeMlp_*.router", ()),
+        ("*.MoeMlp_*.w1", (MODEL_AXIS, None, None)),
+        ("*.MoeMlp_*.b1", (MODEL_AXIS, None)),
+        ("*.MoeMlp_*.w2", (MODEL_AXIS, None, None)),
+        ("*.MoeMlp_*.b2", (MODEL_AXIS, None)),
+    ) + VIT_RULES.rules,
+    min_sharded=16,
+)
+
+#: ResNet family (models/resnet.py + nn/layers.py ConvBN): output
+#: channels over the model axis for every conv and the dense head;
+#: BN scale/bias/running stats replicated (they are per-channel
+#: vectors XLA re-broadcasts anyway and sharding them buys nothing).
+RESNET_RULES = ShardingRules(
+    name="resnet",
+    rules=(
+        ("*.Conv_*.kernel", (None, None, None, MODEL_AXIS)),
+        ("*.Conv_*.bias", (MODEL_AXIS,)),
+        ("*.Dense_*.kernel", (None, MODEL_AXIS)),
+        ("*.Dense_*.bias", (MODEL_AXIS,)),
+        ("*.BatchNorm_*.*", ()),
+        ("*.hyperparams.*", ()),
+        ("*", ()),
+    ),
+    min_sharded=16,
+)
+
+FAMILY_RULES = {
+    "vit": VIT_RULES,
+    "moe": MOE_RULES,
+    "resnet": RESNET_RULES,
+}
+
+#: model-name prefix -> family (ordered: vmoe before vit)
+_MODEL_PREFIXES = (
+    ("vmoe", "moe"),
+    ("vit", "vit"),
+    ("resnet", "resnet"),
+)
+
+
+def rules_for(model_name: str) -> Optional[ShardingRules]:
+    """The curated table for a model/config name (`vit_s16` -> vit,
+    `vmoe_s16` -> moe, `resnet50` -> resnet), or None when the family
+    has no table yet (callers fall back to `HeuristicRules` —
+    explicitly, never silently)."""
+    name = model_name.lower()
+    if name in FAMILY_RULES:
+        return FAMILY_RULES[name]
+    for prefix, family in _MODEL_PREFIXES:
+        if name.startswith(prefix):
+            return FAMILY_RULES[family]
+    return None
+
+
+def get_rules(spec: str, model_name: str = ""):
+    """CLI resolution of `--sharding-rules`:
+
+    - a family name (`vit` / `moe` / `resnet`) -> that curated table;
+    - `auto` -> `rules_for(model_name)`, REFUSING models without a
+      table (the operator asked for declarative sharding; a silent
+      heuristic fallback would recreate the 108 -> 34 incident);
+    - `heuristic` -> the explicit `infer_tp_sharding` fallback.
+    """
+    spec = (spec or "").lower()
+    if spec in FAMILY_RULES:
+        return FAMILY_RULES[spec]
+    if spec == "heuristic":
+        return HeuristicRules()
+    if spec == "auto":
+        rules = rules_for(model_name)
+        if rules is None:
+            raise ShardingRuleError(
+                f"--sharding-rules auto: no curated table for model "
+                f"{model_name!r} (families: {sorted(FAMILY_RULES)}); "
+                "pass --sharding-rules heuristic for the explicit "
+                "size-heuristic fallback")
+        return rules
+    raise ShardingRuleError(
+        f"unknown --sharding-rules value {spec!r}: expected one of "
+        f"{sorted(FAMILY_RULES) + ['auto', 'heuristic']}")

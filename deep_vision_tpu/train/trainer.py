@@ -33,6 +33,7 @@ from deep_vision_tpu.obs.stepclock import StepClock
 from deep_vision_tpu.obs.trace import span
 from deep_vision_tpu.parallel.mesh import (
     DATA_AXIS,
+    assert_sharding_coverage,
     create_mesh,
     pad_batch_to,
     replicated,
@@ -65,6 +66,14 @@ class Trainer:
     K; incompatible with checkify/EMA); `device_prefetch=N` places the
     next N batches on the mesh from a producer thread so H2D transfer
     overlaps compute (data/device_prefetch.py).
+
+    Sharding (README "Sharding"): `sharding_rules` attaches a
+    declarative pattern -> PartitionSpec table (parallel/shardmap.py).
+    The full state tree places per the table (coverage-audited at
+    startup against the family's floor, journaled as a typed
+    `sharding_resolved` event) and every batch path — single step,
+    multistep superstep stack, device prefetcher — shards the batch dim
+    over the table's declared batch axes.
     """
 
     def __init__(
@@ -98,6 +107,7 @@ class Trainer:
         data_loader=None,  # snapshot-capable DataLoader (data/snapshot.py)
         host_supervisor=None,  # resilience.rendezvous.HostSupervisor or None
         executable_cache=None,  # core.excache.ExecutableCache or None
+        sharding_rules=None,  # parallel.shardmap.ShardingRules or None
     ):
         self.mesh = mesh if mesh is not None else create_mesh()
         self.model = model  # single source of truth for summaries/export
@@ -211,9 +221,39 @@ class Trainer:
         self._sample_input = sample_input
         self._init_rng = rng
 
+        # declarative sharding (parallel/shardmap.py): with a rules table
+        # attached, the FULL state tree (params, optimizer moments, BN
+        # stats) resolves against the table at startup —
+        # `assert_sharding_coverage` audits the result against the
+        # family's declared floor BEFORE any buffer is placed, and the
+        # rule -> leaf resolution lands in the journal as a typed
+        # `sharding_resolved` event. Batches (single, multistep stacks,
+        # device-prefetched) follow the table's declared batch axes.
+        # Without a table, the state replicates (plain data parallel) —
+        # the pre-table behavior, unchanged.
+        self.sharding_rules = sharding_rules
+        self._state_shardings = None
+        self._batch_axes = (DATA_AXIS,)
         state = create_train_state(model, tx, sample_input, rng)
-        # device boundary: state lives replicated on the mesh from here on
-        self.state = jax.device_put(state, replicated(self.mesh))
+        if sharding_rules is not None:
+            shardings, report = sharding_rules.resolve(state, self.mesh)
+            # startup hard check FIRST: a stale table must fail before
+            # any device placement, naming the leaves it lost
+            assert_sharding_coverage(
+                state, shardings, self.mesh,
+                min_sharded=sharding_rules.floor_for(self.mesh))
+            self._state_shardings = shardings
+            self._batch_axes = tuple(sharding_rules.batch_axes)
+            if journal is not None:
+                from deep_vision_tpu.parallel.shardmap import (
+                    resolution_event_fields,
+                )
+
+                journal.write("sharding_resolved",
+                              **resolution_event_fields(report))
+        # device boundary: state lives on the mesh from here on —
+        # table-sharded when rules are attached, replicated otherwise
+        self.state = self._place_state(state)
         # EMA evaluation weights (train/ema.py): updated after every step,
         # used by eval_step. Checkpointed in a SIBLING manager under
         # <ckpt_dir>/ema so the main checkpoint's on-disk structure is
@@ -313,6 +353,17 @@ class Trainer:
                 registry=self.clock.registry,
             )
 
+    def _place_state(self, state):
+        """Place a host/abstract state onto the mesh: per the resolved
+        sharding table when one is attached, fully replicated otherwise.
+        Shared by init, the backend-loss rebuild, and the legacy-restore
+        path of resume() so a recovered run lands on the SAME layout the
+        original compiled against (a layout flip would recompile every
+        step executable)."""
+        if self._state_shardings is not None:
+            return jax.device_put(state, self._state_shardings)
+        return jax.device_put(state, replicated(self.mesh))
+
     # -- jitted steps ------------------------------------------------------
     def _build_jitted_steps(self) -> None:
         """(Re)create the jitted step callables. Called once at init and
@@ -320,6 +371,18 @@ class Trainer:
         the old executables reference dead buffers, so the wrappers are
         remade from the pure impl methods (the impls close over nothing
         device-resident — everything flows through state/batch args)."""
+        # With a sharding table attached, PIN the step executables' state
+        # input AND output to the resolved layout: left unconstrained,
+        # XLA may pick slightly different output shardings for the
+        # single-step and superstep executables (e.g. a trimmed spec),
+        # and alternating them — every epoch tail does — would recompile
+        # on the layout flip. Pinning keeps the state in the audited
+        # table layout for the whole run; batches stay unconstrained
+        # (they arrive pre-placed on the declared batch axes).
+        state_pin = {}
+        if self._state_shardings is not None:
+            state_pin = dict(in_shardings=(self._state_shardings, None),
+                             out_shardings=(self._state_shardings, None))
         if self._checkify:
             from jax.experimental import checkify
 
@@ -331,14 +394,14 @@ class Trainer:
             self._train_step = None
         else:
             self._train_step = jax.jit(
-                self._train_step_impl, donate_argnums=0
+                self._train_step_impl, donate_argnums=0, **state_pin
             )
             self._train_step_err = None
         self._eval_step = jax.jit(self._eval_step_impl)
         self._train_multi = None
         if self.multistep > 1:
             self._train_multi = jax.jit(
-                self._multistep_impl, donate_argnums=0
+                self._multistep_impl, donate_argnums=0, **state_pin
             )
         # AOT executables loaded/stored through self.excache, keyed by
         # (step kind -> batch signature). Reset with the jit wrappers:
@@ -356,10 +419,12 @@ class Trainer:
         self._train_step_cache = self._train_multi_cache = None
         if self.excache is not None and not self._checkify:
             # jaxlint: disable=DV003 -- cache-path step: donation must not ride the executable serialize round trip (deserialized donating executables alias freed buffers)
-            self._train_step_cache = jax.jit(self._train_step_impl)
+            self._train_step_cache = jax.jit(self._train_step_impl,
+                                             **state_pin)
             if self.multistep > 1:
                 # jaxlint: disable=DV003 -- cache-path superstep: same serialize-round-trip donation hazard
-                self._train_multi_cache = jax.jit(self._multistep_impl)
+                self._train_multi_cache = jax.jit(self._multistep_impl,
+                                                  **state_pin)
         self._aot_steps: dict = {}
 
     @staticmethod
@@ -463,7 +528,8 @@ class Trainer:
             # (form_global_array) — this host holds only its shards, so
             # padding must happen BEFORE assembly; callers feed full batches
             return dict(batch)
-        n_data = self.mesh.shape[DATA_AXIS]
+        n_data = int(np.prod([self.mesh.shape[a]
+                              for a in self._batch_axes]))
         batch, n_valid = pad_batch_to(dict(batch), n_data)
         n_total = np.asarray(batch[self.input_key]).shape[0]
         if "_mask" not in batch:
@@ -490,7 +556,8 @@ class Trainer:
         """Host batch -> padded/masked/sharded on the mesh (the work
         train_step otherwise does on the critical path)."""
         n = int(np.shape(batch[self.input_key])[0])
-        placed = shard_batch(self.mesh, self._pad_and_mask(batch))
+        placed = shard_batch(self.mesh, self._pad_and_mask(batch),
+                             axes=self._batch_axes)
         return PlacedBatch(placed, n, 1)
 
     def _place_group(self, batches) -> PlacedBatch:
@@ -520,7 +587,8 @@ class Trainer:
         stacked = jax.tree_util.tree_map(_stack, *padded)
         return jax.tree_util.tree_map(
             lambda x: jax.device_put(
-                x, stacked_data_sharding(self.mesh, x.ndim)),
+                x, stacked_data_sharding(self.mesh, x.ndim,
+                                         axes=self._batch_axes)),
             stacked,
         )
 
@@ -551,7 +619,8 @@ class Trainer:
         if isinstance(batch, PlacedBatch):
             batch = batch.data  # device prefetcher already padded + placed
         else:
-            batch = shard_batch(self.mesh, self._pad_and_mask(batch))
+            batch = shard_batch(self.mesh, self._pad_and_mask(batch),
+                                axes=self._batch_axes)
         if self._checkify:
             err, (new_state, metrics) = self._train_step_err(self.state, batch)
             err.throw()  # located NaN/OOB/div0 inside the step, if any
@@ -589,7 +658,8 @@ class Trainer:
                 for i in range(k)]
 
     def eval_step(self, batch) -> dict:
-        batch = shard_batch(self.mesh, self._pad_and_mask(batch))
+        batch = shard_batch(self.mesh, self._pad_and_mask(batch),
+                            axes=self._batch_axes)
         state = self.state
         if self.ema is not None:
             state = state.replace(params=self.ema.params)
@@ -833,7 +903,7 @@ class Trainer:
             pass
         state = create_train_state(self.model, self._tx, self._sample_input,
                                    self._init_rng)
-        self.state = jax.device_put(state, replicated(self.mesh))
+        self.state = self._place_state(state)
         if self.ema is not None:
             from deep_vision_tpu.train.ema import EmaParams
 
@@ -1181,9 +1251,10 @@ class Trainer:
                 "note", note="resumed", step=int(self.state.step),
                 host_state_found=host_state is not None)
         if not getattr(self.ckpt, "last_restore_placed", False):
-            # legacy manager (or nothing restored): the old blanket
-            # replicate keeps the state on this trainer's mesh
-            self.state = jax.device_put(self.state, replicated(self.mesh))
+            # legacy manager (or nothing restored): re-place on this
+            # trainer's mesh — per the sharding table when one is
+            # attached, the old blanket replicate otherwise
+            self.state = self._place_state(self.state)
         if self.ema is not None:
             restored_ema, ema_host = (None, None)
             if self._ema_ckpt is not None:

@@ -129,7 +129,7 @@ def _fake_centernet(cfg: ExperimentConfig, n_batches: int):
 def build_dataloaders(cfg: ExperimentConfig, data_dir: str, fake: bool,
                       fake_batches: int, num_workers: int,
                       preprocessing: str = "torch", num_procs: int = 0,
-                      bad_record_budget=None):
+                      bad_record_budget=None, host_shard=None):
     """Returns (train_fn, eval_fn) thunks yielding batch dicts per epoch.
 
     `preprocessing` selects the ImageNet normalization chain: "torch" is the
@@ -142,6 +142,13 @@ def build_dataloaders(cfg: ExperimentConfig, data_dir: str, fake: bool,
     dead-lettered under its bound instead of killing the epoch. One budget
     object is shared by the train and eval datasets — the bound is per
     run, not per split.
+
+    `host_shard` ((shard_index, num_shards), i.e. `multihost.host_shard()`)
+    feeds per-host sharded loading on the record-backed TRAIN loaders:
+    each host reads only its disjoint shard slice, and because the value
+    comes from the CURRENT rendezvous generation, an elastic 3->2 resize
+    re-derives the slices for free (resilience/rendezvous.py). Eval
+    loaders stay unsharded — every host evaluates the full split.
     """
     if fake or cfg.dataset.get("kind") == "fake":
         maker = {
@@ -221,7 +228,8 @@ def build_dataloaders(cfg: ExperimentConfig, data_dir: str, fake: bool,
             )
             train = DataLoader(train_ds, cfg.batch_size, train_tf, shuffle=True,
                                shuffle_buffer=10000, num_workers=num_workers,
-                               num_procs=num_procs, name="train")
+                               num_procs=num_procs, name="train",
+                               host_shard=host_shard)
         else:
             train_ds = ImageFolderDataset(os.path.join(data_dir, "train_flatten"))
             eval_ds = ImageFolderDataset(os.path.join(data_dir, "val_flatten"))
@@ -278,7 +286,7 @@ def build_dataloaders(cfg: ExperimentConfig, data_dir: str, fake: bool,
         train = DataLoader(train_ds, cfg.batch_size, Compose(train_chain),
                            shuffle=True, num_workers=num_workers,
                            num_procs=num_procs, drop_remainder=True,
-                           name="train")
+                           name="train", host_shard=host_shard)
         evl = DataLoader(eval_ds, cfg.batch_size, Compose(eval_chain),
                          num_workers=num_workers, drop_remainder=True,
                          name="val")
@@ -331,7 +339,8 @@ def build_trainer(cfg: ExperimentConfig, train_fn, ckpt_dir: Optional[str],
                   backend_supervisor=None,
                   data_loader=None,
                   steps_per_epoch: Optional[int] = None,
-                  executable_cache=None):
+                  executable_cache=None,
+                  sharding_rules=None):
     import functools
 
     import jax.numpy as jnp
@@ -416,6 +425,7 @@ def build_trainer(cfg: ExperimentConfig, train_fn, ckpt_dir: Optional[str],
         backend_supervisor=backend_supervisor,
         data_loader=data_loader,
         executable_cache=executable_cache,
+        sharding_rules=sharding_rules,
     )
 
 
@@ -874,6 +884,17 @@ def main(argv: Optional[List[str]] = None) -> int:
                              "compute (2 = double buffering; 0 = place on "
                              "the critical path as before); depth/starvation "
                              "ride the device_prefetch_* metrics")
+    parser.add_argument("--sharding-rules", default=None, metavar="TABLE",
+                        help="declarative pattern->PartitionSpec sharding "
+                             "table (parallel/shardmap.py): a family name "
+                             "(vit/moe/resnet), 'auto' (derive from the "
+                             "model, refusing families without a table), or "
+                             "'heuristic' (the explicit infer_tp_sharding "
+                             "size-heuristic fallback). The full train state "
+                             "places per the table, coverage is hard-checked "
+                             "at startup against the family's floor, and the "
+                             "rule->leaf resolution is journaled as a typed "
+                             "sharding_resolved event")
     parser.add_argument("--executable-cache", default=None, metavar="DIR",
                         help="persistent compiled-executable cache dir "
                              "(core/excache.py; env DVT_EXCACHE): step "
@@ -954,6 +975,29 @@ def main(argv: Optional[List[str]] = None) -> int:
         cfg.epochs = args.epochs
     if args.batch_size is not None:
         cfg.batch_size = args.batch_size
+    # declarative sharding table (parallel/shardmap.py): resolved here so
+    # an unknown family/typo is a usage error before any loader is built
+    sharding_rules = None
+    if args.sharding_rules:
+        from deep_vision_tpu.parallel.shardmap import (
+            ShardingRuleError,
+            get_rules,
+        )
+
+        try:
+            sharding_rules = get_rules(args.sharding_rules, cfg.model)
+        except ShardingRuleError as e:
+            parser.error(str(e))
+    # per-host sharded loading (multihost.host_shard): in a multi-host
+    # world each host reads only its disjoint record-shard slice; the
+    # value routes through the CURRENT rendezvous generation, so the
+    # elastic layer's per-generation re-derive is inherited for free.
+    # Single-host runs pass None — loader fingerprints stay unchanged.
+    host_shard = None
+    from deep_vision_tpu.parallel import multihost as _mh
+
+    if _mh.process_count() > 1:
+        host_shard = _mh.host_shard()
     if args.preprocessing == "tf" and (
         args.fake_data or cfg.dataset.get("kind") != "imagenet"
     ):
@@ -990,6 +1034,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                 cfg, args.data_dir, args.fake_data, args.fake_batches,
                 args.num_workers, preprocessing=args.preprocessing,
                 num_procs=args.num_procs, bad_record_budget=budget,
+                host_shard=host_shard,
             )
         except (FileNotFoundError, OSError) as e:
             print(f"--data-service: no local eval dataset ({e}); "
@@ -1003,9 +1048,15 @@ def main(argv: Optional[List[str]] = None) -> int:
             cfg, args.data_dir, args.fake_data, args.fake_batches,
             args.num_workers, preprocessing=args.preprocessing,
             num_procs=args.num_procs, bad_record_budget=budget,
+            host_shard=host_shard,
         )
 
     if cfg.task in ("dcgan", "cyclegan"):
+        if sharding_rules is not None:
+            parser.error(
+                "--sharding-rules rides the standard Trainer state "
+                f"placement; GAN task {cfg.task!r} has its own G/D "
+                "trainers without it")
         if args.eval_only:
             parser.error(
                 f"--eval-only is not supported for GAN task {cfg.task!r} "
@@ -1226,7 +1277,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                             data_loader=data_loader,
                             steps_per_epoch=(args.data_service_steps
                                              if args.data_service else None),
-                            executable_cache=excache)
+                            executable_cache=excache,
+                            sharding_rules=sharding_rules)
     if journal is not None:
         # an unwinding run (exception/SIGTERM) still stops an in-flight
         # profiler trace and flushes writers via the atexit crash path

@@ -576,7 +576,11 @@ class TestShardingCoverage:
         reg = Registry()
         stats = assert_sharding_coverage(tree, sh, mesh4x2, min_sharded=1,
                                          registry=reg)
+        # replicated_paths names the leaves that fell back to
+        # replication (the floor-failure message uses them — the 108->34
+        # incident was undebuggable from bare counts)
         assert stats == {"float_leaves": 2, "sharded": 1, "replicated": 1,
+                         "replicated_paths": ["['bias']"],
                          "unmatched": []}
         assert reg.gauge("parallel_sharded_leaves").value == 1
         assert reg.gauge("parallel_float_leaves").value == 2

@@ -1,0 +1,611 @@
+"""Declarative sharding tables (parallel/shardmap.py) + wiring.
+
+Table semantics (integer -> `*` normalization, first-match-wins
+ordering, catch-all enforcement, unknown-axis / rank-mismatch refusal,
+depth-independent resolution), the curated family tables against real
+model states, the Trainer/train_cli wiring with its typed
+`sharding_resolved` event, the coverage-failure messages that name leaf
+paths, the ring-attention flash-floor routing, scaling-efficiency rows,
+and the obs tooling (check_journal schema, obs_report section with its
+byte-unchanged gate).
+"""
+import json
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from deep_vision_tpu.core.train_state import create_train_state  # noqa: E402
+from deep_vision_tpu.losses.classification import (  # noqa: E402
+    classification_loss_fn,
+)
+from deep_vision_tpu.models.vit import ViT  # noqa: E402
+from deep_vision_tpu.parallel.mesh import (  # noqa: E402
+    ShardingCoverageError,
+    assert_sharding_coverage,
+    create_mesh,
+    data_sharding,
+    infer_tp_sharding,
+    sharding_coverage,
+    stacked_data_sharding,
+)
+from deep_vision_tpu.parallel.shardmap import (  # noqa: E402
+    FAMILY_RULES,
+    HeuristicRules,
+    MOE_RULES,
+    RESNET_RULES,
+    VIT_RULES,
+    ShardingRuleError,
+    ShardingRules,
+    get_rules,
+    normalize_path,
+    resolution_event_fields,
+    rules_for,
+)
+from deep_vision_tpu.train.optimizers import build_optimizer  # noqa: E402
+
+from tools.check_journal import check_journal  # noqa: E402
+
+
+def tiny_vit(num_experts: int = 0, depth: int = 2) -> ViT:
+    return ViT(depth=depth, dim=16, num_heads=2, patch=8, num_classes=8,
+               num_experts=num_experts)
+
+
+def tiny_state(model=None):
+    tx = build_optimizer("sgd", learning_rate=0.05, momentum=0.9)
+    return create_train_state(model or tiny_vit(), tx,
+                              jnp.ones((2, 16, 16, 3), jnp.float32))
+
+
+# -- normalization ------------------------------------------------------------
+
+class TestNormalize:
+    def test_integer_tokens_become_star(self):
+        assert normalize_path("layers.11.attention.wo.weight") == \
+            "layers.*.attention.wo.weight"
+
+    def test_optimizer_state_indices_normalize(self):
+        assert normalize_path("opt_state.1.0.trace.Dense_0.kernel") == \
+            "opt_state.*.*.trace.Dense_0.kernel"
+
+    def test_flax_layer_suffixes_stay_literal(self):
+        # Mlp_0.Dense_0 vs Mlp_0.Dense_1 distinguishes the column- from
+        # the row-parallel projection; the PATTERN's glob generalizes
+        # over layer indices instead
+        assert normalize_path("params.ViTBlock_7.Mlp_0.Dense_1.kernel") == \
+            "params.ViTBlock_7.Mlp_0.Dense_1.kernel"
+
+
+# -- table construction -------------------------------------------------------
+
+class TestConstruction:
+    def test_catch_all_required(self):
+        with pytest.raises(ShardingRuleError, match="catch-all"):
+            ShardingRules(name="t", rules=(("*.kernel", (None, "model")),))
+
+    def test_catch_all_must_be_last(self):
+        with pytest.raises(ShardingRuleError, match="catch-all"):
+            ShardingRules(name="t", rules=(
+                ("*", ()), ("*.kernel", (None, "model"))))
+
+    def test_empty_table_refused(self):
+        with pytest.raises(ShardingRuleError, match="no rules"):
+            ShardingRules(name="t", rules=())
+
+    def test_duplicate_pattern_refused(self):
+        with pytest.raises(ShardingRuleError, match="duplicate"):
+            ShardingRules(name="t", rules=(
+                ("*.kernel", (None, "model")),
+                ("*.kernel", ()),
+                ("*", ())))
+
+    def test_malformed_spec_refused(self):
+        with pytest.raises(ShardingRuleError, match="spec"):
+            ShardingRules(name="t", rules=(("*", "model"),))
+        with pytest.raises(ShardingRuleError, match="entry"):
+            ShardingRules(name="t", rules=(("*", (42,)),))
+
+    def test_malformed_batch_axes_refused(self):
+        # empty / non-string batch axes refuse at construction (the
+        # same loud contract the rule specs have), a typo'd-but-
+        # string axis at resolve — never a KeyError mid-train-step
+        with pytest.raises(ShardingRuleError, match="batch_axes"):
+            ShardingRules(name="t", rules=(("*", ()),), batch_axes=())
+        with pytest.raises(ShardingRuleError, match="batch_axes"):
+            ShardingRules(name="t", rules=(("*", ()),),
+                          batch_axes=("data", 3))
+
+    def test_unknown_batch_axis_refused_at_resolve(self, mesh4x2):
+        table = ShardingRules(name="t", rules=(("*", ()),),
+                              batch_axes=("dp",))
+        with pytest.raises(ShardingRuleError, match="batch axis"):
+            table.resolve({"a": jnp.ones((4,))}, mesh4x2)
+        with pytest.raises(ShardingRuleError, match="batch axis"):
+            HeuristicRules(batch_axes=("dp",)).resolve(
+                {"a": jnp.ones((4,))}, mesh4x2)
+
+
+# -- matching semantics -------------------------------------------------------
+
+class TestMatching:
+    def test_first_match_wins(self):
+        table = ShardingRules(name="t", rules=(
+            ("*.Mlp_*.Dense_0.kernel", (None, "model")),
+            ("*.Dense_*.kernel", ("model", None)),
+            ("*", ())))
+        pat, spec = table.match("params.ViTBlock_0.Mlp_0.Dense_0.kernel")
+        assert pat == "*.Mlp_*.Dense_0.kernel" and spec == (None, "model")
+        pat, spec = table.match("params.Dense_0.kernel")
+        assert pat == "*.Dense_*.kernel" and spec == ("model", None)
+
+    def test_momentum_paths_match_param_rules(self, mesh4x2):
+        # leading-* rules claim the optimizer moment mirrors too: the
+        # momentum of a sharded kernel shards with it
+        state = tiny_state()
+        shardings, _ = VIT_RULES.resolve(state, mesh4x2)
+        mom = jax.tree_util.tree_leaves(shardings.opt_state)
+        assert any(
+            any(e is not None for e in tuple(s.spec)) for s in mom
+        ), "no optimizer-state leaf sharded"
+
+    def test_integer_normalized_match(self, mesh4x2):
+        # torch-style integer layer indices resolve through the same
+        # table row (the snippet's layers.*.attention.wo.weight shape)
+        table = ShardingRules(name="t", rules=(
+            ("layers.*.wo.weight", (None, "model")), ("*", ())))
+        tree = {"layers": {str(i): {"wo": {"weight": jnp.ones((4, 8))}}
+                           for i in range(3)}}
+        _, report = table.resolve(tree, mesh4x2)
+        assert report["rules"]["layers.*.wo.weight"] == 3
+        assert report["sharded_leaves"] == 3
+
+
+# -- resolve refusals ---------------------------------------------------------
+
+class TestRefusals:
+    def test_unknown_axis_refused(self, mesh4x2):
+        table = ShardingRules(name="t", rules=(
+            ("*.kernel", (None, "tp")), ("*", ())))
+        tree = {"a": {"kernel": jnp.ones((4, 8))}}
+        with pytest.raises(ShardingRuleError, match="unknown mesh axis"):
+            table.resolve(tree, mesh4x2)
+
+    def test_rank_mismatch_refused(self, mesh4x2):
+        table = ShardingRules(name="t", rules=(
+            ("*.kernel", (None, None, "model")), ("*", ())))
+        tree = {"a": {"kernel": jnp.ones((4, 8))}}
+        with pytest.raises(ShardingRuleError, match="rank"):
+            table.resolve(tree, mesh4x2)
+
+    def test_non_divisible_dim_drops_axis(self, mesh4x2):
+        # the replace_on_mesh convention: an odd-width layer replicates
+        # that dim (counted in the report) instead of failing the family
+        table = ShardingRules(name="t", rules=(
+            ("*.kernel", (None, "model")), ("*", ())))
+        tree = {"a": {"kernel": jnp.ones((4, 7))}}  # 7 % 2 != 0
+        shardings, report = table.resolve(tree, mesh4x2)
+        assert len(report["dropped_dims"]) == 1
+        assert report["sharded_leaves"] == 0
+        spec = tuple(shardings["a"]["kernel"].spec)
+        assert all(e is None for e in spec)
+
+    def test_size_one_axis_resolves_replicated(self, mesh8):
+        # a model-axis spec on a pure-DP mesh must NOT count as sharded
+        table = ShardingRules(name="t", rules=(
+            ("*.kernel", (None, "model")), ("*", ())))
+        tree = {"a": {"kernel": jnp.ones((4, 8))}}
+        _, report = table.resolve(tree, mesh8)
+        assert report["sharded_leaves"] == 0
+        assert table.floor_for(mesh8) == 0  # floor waived without TP
+
+
+# -- depth independence -------------------------------------------------------
+
+class TestDepthIndependence:
+    def test_same_table_resolves_all_depths(self, mesh4x2):
+        """The acceptance shape: one table, depth-8 and depth-12 ViTs,
+        identical per-normalized-path resolution."""
+        def spec_map(depth):
+            state = tiny_state(tiny_vit(depth=depth))
+            shardings, _ = VIT_RULES.resolve(state, mesh4x2)
+            flat, _ = jax.tree_util.tree_flatten_with_path(
+                shardings, is_leaf=lambda x: hasattr(x, "spec"))
+            from deep_vision_tpu.parallel.shardmap import leaf_path
+
+            out = {}
+            for p, s in flat:
+                # collapse the layer index so depth-8 and depth-12 rows
+                # land on the same key
+                key = normalize_path(leaf_path(p))
+                import re
+
+                key = re.sub(r"ViTBlock_\d+", "ViTBlock_N", key)
+                out.setdefault(key, set()).add(tuple(s.spec))
+            return out
+
+        m8, m12 = spec_map(8), spec_map(12)
+        assert set(m8) == set(m12)
+        for k in m8:
+            assert m8[k] == m12[k], f"resolution drifted at {k}"
+            assert len(m8[k]) == 1, f"inconsistent specs within depth at {k}"
+
+
+# -- curated tables over real states ------------------------------------------
+
+class TestFamilyTables:
+    def test_vit_beats_heuristic(self, mesh4x2):
+        state = tiny_state()
+        shardings, report = VIT_RULES.resolve(state, mesh4x2)
+        heur = sharding_coverage(
+            state, infer_tp_sharding(state, mesh4x2, min_size=1024))
+        assert report["sharded_leaves"] >= VIT_RULES.min_sharded
+        assert report["sharded_leaves"] > heur["sharded"]
+        assert report["unmatched"] == 0
+        assert_sharding_coverage(state, shardings, mesh4x2,
+                                 min_sharded=VIT_RULES.floor_for(mesh4x2))
+
+    def test_moe_expert_router_split(self, mesh4x2):
+        state = tiny_state(tiny_vit(num_experts=4))
+        shardings, report = MOE_RULES.resolve(state, mesh4x2)
+        moe = shardings.params["ViTBlock_1"]["MoeMlp_0"]
+        assert tuple(moe["w1"].spec)[0] == "model"
+        assert tuple(moe["w2"].spec)[0] == "model"
+        assert all(e is None for e in tuple(moe["router"].spec))
+        assert report["rules"]["*.MoeMlp_*.w1"] > 0
+
+    def test_resnet_table_covers_dryrun_model(self, mesh4x2):
+        from deep_vision_tpu.models.resnet import BottleneckBlock, ResNet
+
+        model = ResNet(stage_sizes=(1, 1, 1, 1), block=BottleneckBlock,
+                       width=16, num_classes=64)
+        tx = build_optimizer("sgd", learning_rate=0.1, momentum=0.9,
+                             weight_decay=1e-4)
+        state = create_train_state(model, tx,
+                                   jnp.ones((2, 32, 32, 3), jnp.float32))
+        _, report = RESNET_RULES.resolve(state, mesh4x2)
+        heur = sharding_coverage(
+            state, infer_tp_sharding(state, mesh4x2, min_size=1024))
+        assert report["sharded_leaves"] >= RESNET_RULES.min_sharded
+        assert report["sharded_leaves"] >= heur["sharded"]
+        assert report["unmatched"] == 0
+
+    def test_registry_lookup(self):
+        assert rules_for("vit_s16") is VIT_RULES
+        assert rules_for("vit_b16") is VIT_RULES
+        assert rules_for("vmoe_s16") is MOE_RULES
+        assert rules_for("resnet50") is RESNET_RULES
+        assert rules_for("yolov3") is None
+
+    def test_get_rules_cli_semantics(self):
+        assert get_rules("vit") is VIT_RULES
+        assert get_rules("auto", "resnet50") is RESNET_RULES
+        assert isinstance(get_rules("heuristic"), HeuristicRules)
+        with pytest.raises(ShardingRuleError, match="no curated table"):
+            get_rules("auto", "yolov3")
+        with pytest.raises(ShardingRuleError, match="unknown"):
+            get_rules("vitt")
+
+    def test_heuristic_rules_match_infer_tp(self, mesh4x2):
+        state = tiny_state()
+        h = HeuristicRules(min_size=1024)
+        shardings, report = h.resolve(state, mesh4x2)
+        direct = sharding_coverage(
+            state, infer_tp_sharding(state, mesh4x2, min_size=1024))
+        assert report["sharded_leaves"] == direct["sharded"]
+        assert report["model"] == "heuristic"
+
+    def test_all_tables_have_floor_and_catch_all(self):
+        for name, table in FAMILY_RULES.items():
+            assert table.rules[-1][0] == "*", name
+            assert table.min_sharded > 0, name
+            assert table.batch_axes == ("data",), name
+
+
+# -- coverage failure messages ------------------------------------------------
+
+class TestCoverageMessages:
+    def test_floor_failure_names_replicated_paths(self, mesh4x2):
+        """Satellite: the 108 -> 34 regression was undebuggable from
+        bare counts — the floor failure must NAME the leaves that fell
+        back to replication."""
+        state = tiny_state()
+        gutted = ShardingRules(name="vit", rules=(("*", ()),),
+                               min_sharded=12)
+        shardings, _ = gutted.resolve(state, mesh4x2)
+        with pytest.raises(ShardingCoverageError) as ei:
+            assert_sharding_coverage(state, shardings, mesh4x2,
+                                     min_sharded=12)
+        msg = str(ei.value)
+        assert "replicated float leaves" in msg
+        assert "ViTBlock" in msg  # real leaf paths, not counts
+
+    def test_unmatched_failure_still_names_paths(self, mesh4x2):
+        state = tiny_state()
+        shardings, _ = VIT_RULES.resolve(state.params, mesh4x2)
+        # shardings for params only, checked against the full state:
+        # every non-params float leaf is unmatched
+        with pytest.raises(ShardingCoverageError, match="NO sharding"):
+            assert_sharding_coverage(state, shardings, mesh4x2)
+
+
+# -- batch-axes placement helpers ---------------------------------------------
+
+class TestBatchAxes:
+    def test_data_sharding_axes(self, mesh4x2):
+        s = data_sharding(mesh4x2, 4, axes=("data",))
+        assert tuple(s.spec) == ("data", None, None, None)
+        s2 = data_sharding(mesh4x2, 2, axes=("data", "model"))
+        assert tuple(s2.spec)[0] == ("data", "model")
+
+    def test_stacked_sharding_axes(self, mesh4x2):
+        s = stacked_data_sharding(mesh4x2, 3, axes=("data",))
+        assert tuple(s.spec) == (None, "data", None)
+
+
+# -- Trainer wiring -----------------------------------------------------------
+
+class TestTrainerWiring:
+    @pytest.fixture(scope="class")
+    def trained(self, tmp_path_factory):
+        from deep_vision_tpu.obs.journal import RunJournal
+        from deep_vision_tpu.train.trainer import Trainer
+
+        path = str(tmp_path_factory.mktemp("shard") / "journal.jsonl")
+        journal = RunJournal(path, kind="test")
+        journal.manifest(config={"tool": "test_shardmap"})
+        mesh = create_mesh(data=4, model=2)
+        trainer = Trainer(
+            tiny_vit(), build_optimizer("sgd", learning_rate=0.05,
+                                        momentum=0.9),
+            classification_loss_fn,
+            jnp.ones((2, 16, 16, 3), jnp.float32), mesh=mesh,
+            journal=journal, sharding_rules=VIT_RULES,
+        )
+        rng = np.random.RandomState(0)
+        batch = {"image": rng.rand(8, 16, 16, 3).astype(np.float32),
+                 "label": rng.randint(0, 8, (8,)).astype(np.int32)}
+        metrics = trainer.train_step(batch)
+        journal.close()
+        return trainer, metrics, path
+
+    def test_state_placed_per_table(self, trained):
+        trainer, _, _ = trained
+        qkv = trainer.state.params["ViTBlock_0"]["Attention_0"]["qkv"][
+            "kernel"]
+        assert "model" in tuple(qkv.sharding.spec)
+        assert qkv.addressable_shards[0].data.size * 2 == qkv.size
+
+    def test_step_runs_and_is_finite(self, trained):
+        _, metrics, _ = trained
+        assert np.isfinite(float(metrics["loss"]))
+
+    def test_sharding_resolved_event_journaled_and_strict_valid(
+            self, trained):
+        _, _, path = trained
+        with open(path) as fh:
+            events = [json.loads(line) for line in fh if line.strip()]
+        resolved = [e for e in events
+                    if e["event"] == "sharding_resolved"]
+        assert len(resolved) == 1
+        e = resolved[0]
+        assert e["model"] == "vit"
+        assert e["sharded_leaves"] >= VIT_RULES.min_sharded
+        assert e["mesh"] == {"data": 4, "model": 2}
+        assert check_journal(path, strict=True) == []
+
+    def test_gutted_table_fails_at_trainer_startup(self):
+        from deep_vision_tpu.train.trainer import Trainer
+
+        mesh = create_mesh(data=4, model=2)
+        gutted = ShardingRules(name="vit", rules=(("*", ()),),
+                               min_sharded=12)
+        with pytest.raises(ShardingCoverageError, match="ViTBlock"):
+            Trainer(tiny_vit(),
+                    build_optimizer("sgd", learning_rate=0.05,
+                                    momentum=0.9),
+                    classification_loss_fn,
+                    jnp.ones((2, 16, 16, 3), jnp.float32), mesh=mesh,
+                    sharding_rules=gutted)
+
+    def test_cli_flag_parses_to_rules(self):
+        from deep_vision_tpu.train_cli import build_trainer  # noqa: F401
+        # CLI surface: the flag exists and maps through get_rules
+        import deep_vision_tpu.train_cli as cli
+
+        src = open(cli.__file__).read()
+        assert "--sharding-rules" in src
+
+
+# -- sharding_resolved schema (check_journal) ---------------------------------
+
+class TestSchema:
+    def _line(self, tmp_path, **overrides):
+        row = {"event": "sharding_resolved", "ts": 1.0, "run_id": "r",
+               "model": "vit", "matched": 10, "unmatched": 0,
+               "sharded_leaves": 8, "replicated": 2,
+               "mesh": {"data": 4, "model": 2}}
+        row.update(overrides)
+        rows = [
+            {"event": "run_manifest", "ts": 0.0, "run_id": "r",
+             "kind": "test", "argv": []},
+            row,
+            {"event": "exit", "ts": 2.0, "run_id": "r", "status": "ok"},
+        ]
+        p = tmp_path / "j.jsonl"
+        p.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
+        return str(p)
+
+    def test_valid_accepted(self, tmp_path):
+        assert check_journal(self._line(tmp_path), strict=True) == []
+
+    def test_bad_model_rejected(self, tmp_path):
+        errs = check_journal(self._line(tmp_path, model=7), strict=True)
+        assert any("model" in e for e in errs)
+
+    def test_bad_counts_rejected(self, tmp_path):
+        errs = check_journal(self._line(tmp_path, matched="10"),
+                             strict=True)
+        assert any("matched" in e for e in errs)
+
+    def test_bad_mesh_rejected(self, tmp_path):
+        errs = check_journal(self._line(tmp_path, mesh={}), strict=True)
+        assert any("mesh" in e for e in errs)
+        errs = check_journal(self._line(tmp_path, mesh={"data": "4"}),
+                             strict=True)
+        assert any("mesh" in e for e in errs)
+
+    def test_missing_field_rejected(self, tmp_path):
+        path = self._line(tmp_path)
+        rows = [json.loads(line) for line in open(path)]
+        del rows[1]["sharded_leaves"]
+        with open(path, "w") as fh:
+            fh.write("\n".join(json.dumps(r) for r in rows) + "\n")
+        errs = check_journal(path, strict=True)
+        assert any("sharded_leaves" in e for e in errs)
+
+    def test_event_fields_helper_is_strict_valid(self, tmp_path, mesh4x2):
+        state = tiny_state()
+        _, report = VIT_RULES.resolve(state, mesh4x2)
+        fields = resolution_event_fields(report)
+        p = self._line(tmp_path, **fields)
+        assert check_journal(p, strict=True) == []
+
+
+# -- obs_report ----------------------------------------------------------------
+
+class TestObsReport:
+    def _events(self, with_sharding: bool):
+        rows = [
+            {"event": "run_manifest", "ts": 0.0, "run_id": "r",
+             "kind": "test", "argv": []},
+            {"event": "step", "ts": 1.0, "run_id": "r", "step": 1,
+             "step_time_ms": 10.0},
+            {"event": "exit", "ts": 2.0, "run_id": "r", "status": "ok"},
+        ]
+        if with_sharding:
+            rows.insert(1, {
+                "event": "sharding_resolved", "ts": 0.5, "run_id": "r",
+                "model": "vit", "matched": 10, "unmatched": 1,
+                "sharded_leaves": 8, "replicated": 3, "float_leaves": 11,
+                "mesh": {"data": 4, "model": 2},
+                "rules": {"*.qkv.kernel": 4, "*": 1},
+                "unmatched_paths": ["params.odd.leaf"]})
+            rows.insert(2, {
+                "event": "bench", "ts": 0.7, "run_id": "r",
+                "name": "multichip_scaling",
+                "result": {"metric": "multichip_scaling", "rows": [
+                    {"data": 1, "examples_per_sec": 100.0,
+                     "per_device_examples_per_sec": 100.0,
+                     "efficiency": 1.0},
+                    {"data": 8, "examples_per_sec": 640.0,
+                     "per_device_examples_per_sec": 80.0,
+                     "efficiency": 0.8}]}})
+        return rows
+
+    def test_sharding_section_renders(self):
+        from tools.obs_report import render, summarize_run
+
+        text = render(summarize_run(self._events(True)))
+        assert "sharding vit" in text
+        assert "8 sharded / 3 replicated" in text
+        assert "*.qkv.kernel -> 4 leaves" in text
+        assert "scaling data=8" in text and "efficiency 0.8" in text
+
+    def test_report_byte_unchanged_without_sharding_events(self):
+        from tools.obs_report import render, summarize_run
+
+        base = self._events(False)
+        text = render(summarize_run(list(base)))
+        assert "sharding" not in text and "scaling" not in text
+        # and sweep-style bench rows (no efficiency key) don't trigger it
+        base.insert(1, {"event": "bench", "ts": 0.5, "run_id": "r",
+                        "name": "dispatch_sweep",
+                        "result": {"rows": [{"batch_per_chip": 256}]}})
+        assert "scaling data" not in render(summarize_run(base))
+
+
+# -- scaling rows --------------------------------------------------------------
+
+@pytest.mark.slow
+class TestScaling:
+    def test_measure_scaling_rows(self):
+        from deep_vision_tpu.tools.scaling import (
+            measure_scaling,
+            scaling_result,
+        )
+
+        rows = measure_scaling(sub_sizes=(1, 2), batch_per_device=2,
+                               steps=2, warmup=1)
+        assert [r["data"] for r in rows] == [1, 2]
+        assert rows[0]["efficiency"] == 1.0
+        assert all(r["examples_per_sec"] > 0 for r in rows)
+        result = scaling_result(rows)
+        assert result["metric"] == "multichip_scaling"
+        assert result["value"] == rows[-1]["efficiency"]
+
+    def test_oversized_meshes_skipped(self):
+        from deep_vision_tpu.tools.scaling import measure_scaling
+
+        rows = measure_scaling(sub_sizes=(1, 16), batch_per_device=2,
+                               steps=1, warmup=1)
+        assert [r["data"] for r in rows] == [1]
+
+
+# -- ring-attention flash floor (satellite) -----------------------------------
+
+class TestRingFlashFloor:
+    def test_routes_through_flash_min_tokens(self, monkeypatch):
+        from deep_vision_tpu.parallel.ring_attention import (
+            _default_use_flash,
+        )
+
+        monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+        monkeypatch.delenv("DVT_FLASH_MIN_TOKENS", raising=False)
+        assert _default_use_flash(1024) is True
+        assert _default_use_flash(512) is False
+        # the PR 14 knob governs the ring path like it governs ViT
+        monkeypatch.setenv("DVT_FLASH_MIN_TOKENS", "4096")
+        assert _default_use_flash(2048) is False
+        assert _default_use_flash(4096) is True
+        monkeypatch.setenv("DVT_FLASH_MIN_TOKENS", "lots")
+        with pytest.raises(ValueError, match="DVT_FLASH_MIN_TOKENS"):
+            _default_use_flash(512)
+
+    def test_block_divisibility_guard(self, monkeypatch):
+        # a lowered floor must not route a shard the kernel's
+        # t % block grid assert would reject — dense body instead
+        # (the t % 1024 == 0 guard models/vit.py keeps)
+        from deep_vision_tpu.parallel.ring_attention import (
+            _default_use_flash,
+        )
+
+        monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+        monkeypatch.setenv("DVT_FLASH_MIN_TOKENS", "512")
+        assert _default_use_flash(768) is False
+        assert _default_use_flash(2048) is True
+
+    def test_cpu_never_routes_to_flash(self, monkeypatch):
+        from deep_vision_tpu.parallel.ring_attention import (
+            _default_use_flash,
+        )
+
+        monkeypatch.setenv("DVT_FLASH_MIN_TOKENS", "1")
+        assert _default_use_flash(4096) is False  # cpu backend
+
+    def test_floor_shared_with_vit(self):
+        import importlib
+
+        vit_mod = importlib.import_module("deep_vision_tpu.models.vit")
+        # ops.pallas re-exports the flash_attention FUNCTION, shadowing
+        # the module attribute — import the module by dotted name
+        fa = importlib.import_module(
+            "deep_vision_tpu.ops.pallas.flash_attention")
+
+        assert vit_mod.flash_min_tokens is fa.flash_min_tokens
+        assert vit_mod.FLASH_MIN_TOKENS == fa.FLASH_MIN_TOKENS

@@ -77,6 +77,8 @@ EVENT_FIELDS = {
     "excache_store": ("key",),
     "excache_invalid": ("key", "reason"),
     "quant_calibrated": ("model", "delta", "accepted"),
+    "sharding_resolved": ("model", "matched", "unmatched",
+                          "sharded_leaves", "mesh"),
     "host_lost": ("host", "generation"),
     "host_joined": ("host", "generation"),
     "world_resized": ("from", "to", "generation", "resume_step"),
@@ -275,6 +277,24 @@ def check_journal(path: str, require_exit: bool = False,
             if not isinstance(row.get("delta"), (int, float)):
                 errors.append(f"{path}:{i}: quant_calibrated delta must be "
                               f"numeric, got {row.get('delta')!r}")
+        if ev == "sharding_resolved":
+            # declarative sharding resolution (parallel/shardmap.py):
+            # model names the rules table, the three counts are the
+            # coverage ledger, mesh is the {axis: size} it resolved on
+            if not isinstance(row.get("model"), str) or not row.get("model"):
+                errors.append(f"{path}:{i}: sharding_resolved model must "
+                              f"be a table name, got {row.get('model')!r}")
+            for k in ("matched", "unmatched", "sharded_leaves"):
+                if not isinstance(row.get(k), int):
+                    errors.append(f"{path}:{i}: sharding_resolved {k} "
+                                  f"must be an int, got {row.get(k)!r}")
+            m = row.get("mesh")
+            if not isinstance(m, dict) or not m or not all(
+                    isinstance(k, str) and isinstance(v, int)
+                    for k, v in m.items()):
+                errors.append(f"{path}:{i}: sharding_resolved mesh must "
+                              "be a non-empty {axis: size} mapping, got "
+                              f"{m!r}")
         if ev in ("host_lost", "host_joined"):
             # elastic membership events (resilience/rendezvous.py):
             # host is a member ID string, generation the rendezvous

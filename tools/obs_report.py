@@ -103,6 +103,9 @@ def summarize_run(events: List[dict]) -> dict:
     cold_path = summarize_cold_path(events)
     if cold_path:
         out["cold_path"] = cold_path
+    sharding = summarize_sharding(events)
+    if sharding:
+        out["sharding"] = sharding
     terminal = next(
         (e for e in reversed(events) if e.get("event") in ("exit", "crash")),
         None)
@@ -304,6 +307,51 @@ def summarize_cold_path(events: List[dict]) -> Optional[dict]:
              "tolerance": e.get("tolerance"),
              "accepted": bool(e.get("accepted"))}
             for e in quants]
+    return out
+
+
+def summarize_sharding(events: List[dict]) -> Optional[dict]:
+    """The declarative-sharding view (parallel/shardmap.py): each
+    `sharding_resolved` event's coverage ledger (matched/unmatched,
+    sharded vs replicated float leaves, the mesh it resolved on) with
+    the top rule hit counts, plus scaling-efficiency rows when the
+    journal carries a MULTICHIP bench event (`bench.py --multichip` /
+    tools/scaling.py rows, recognized by their data+efficiency keys).
+    None when the journal has neither — every existing report renders
+    byte-unchanged."""
+    resolved = [e for e in events if e.get("event") == "sharding_resolved"]
+    scaling: List[dict] = []
+    for e in events:
+        if e.get("event") != "bench":
+            continue
+        rows = (e.get("result") or {}).get("rows")
+        if isinstance(rows, list) and rows and all(
+                isinstance(r, dict) and "data" in r and "efficiency" in r
+                for r in rows):
+            scaling.extend(rows)
+    if not (resolved or scaling):
+        return None
+    out: dict = {}
+    if resolved:
+        tables = []
+        for e in resolved:
+            row = {k: e.get(k) for k in
+                   ("model", "matched", "unmatched", "sharded_leaves",
+                    "replicated", "float_leaves", "mesh", "dropped_dims")
+                   if e.get(k) is not None}
+            rules = e.get("rules")
+            if isinstance(rules, dict):
+                hits = [(p, n) for p, n in rules.items()
+                        if isinstance(n, int) and n > 0]
+                hits.sort(key=lambda pn: -pn[1])
+                row["top_rules"] = hits[:5]
+            paths = e.get("unmatched_paths")
+            if isinstance(paths, list) and paths:
+                row["unmatched_paths"] = [str(p) for p in paths[:5]]
+            tables.append(row)
+        out["tables"] = tables
+    if scaling:
+        out["scaling"] = scaling
     return out
 
 
@@ -563,6 +611,33 @@ def render(summary: dict) -> str:
             if q.get("tolerance") is not None:
                 detail += f" (tolerance {q['tolerance']})"
             rows.append((f"  int8 {q['model']}", f"{verdict}: {detail}"))
+    # declarative sharding (parallel/shardmap.py sharding_resolved +
+    # bench.py --multichip): which table resolved, how many leaves each
+    # rule claimed, what actually sharded, and the scaling-efficiency
+    # curve — the "is the parallelism real and what does it buy" answers
+    sharding = summary.get("sharding")
+    if sharding:
+        for t in sharding.get("tables", []):
+            mesh = t.get("mesh") or {}
+            mesh_s = ",".join(f"{k}={v}" for k, v in mesh.items())
+            parts = (f"{t.get('sharded_leaves', '?')} sharded / "
+                     f"{t.get('replicated', '?')} replicated of "
+                     f"{t.get('float_leaves', '?')} float leaves "
+                     f"(mesh {mesh_s})")
+            if t.get("unmatched"):
+                parts += f"  {t['unmatched']} catch-all-only"
+            if t.get("dropped_dims"):
+                parts += f"  {t['dropped_dims']} dims dropped"
+            rows.append((f"sharding {t.get('model', '?')}", parts))
+            for pat, n in t.get("top_rules", []):
+                rows.append(("  rule", f"{pat} -> {n} leaves"))
+            for p in t.get("unmatched_paths", []):
+                rows.append(("  catch-all", p))
+        for r in sharding.get("scaling", []):
+            rows.append((f"scaling data={r.get('data')}",
+                         f"{r.get('examples_per_sec')} ex/s  "
+                         f"{r.get('per_device_examples_per_sec')} /device  "
+                         f"efficiency {r.get('efficiency')}"))
     # profiler captures: every decision the autoprof policy made, so the
     # table answers "why does this run have three trace dirs" directly
     for e in summary.get("captures", []):
