@@ -51,7 +51,7 @@ lint-baseline:
 # obs-smoke and chaos-smoke — the telemetry artifacts must validate and
 # the resilience contracts must hold before the tests count
 verify: SHELL := /bin/bash
-verify: lint preflight perf-smoke obs-smoke chaos-smoke data-smoke host-smoke serve-smoke fleet-smoke cache-smoke shard-smoke
+verify: lint preflight perf-smoke obs-smoke chaos-smoke data-smoke host-smoke serve-smoke fleet-smoke cache-smoke shard-smoke live-smoke
 	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
 
 # environment preflight: backend liveness + libtpu/client version
@@ -134,6 +134,18 @@ cache-smoke:
 # sharding section
 shard-smoke:
 	JAX_PLATFORMS=cpu python tools/shard_smoke.py --workdir artifacts/shard_smoke
+
+# live-telemetry smoke: a REAL train.py subprocess is scraped MID-RUN
+# through its discovery file (/metrics parses as Prometheus, /healthz
+# 200, /statusz shows a live step, obs_poll renders the one-liner); a
+# data-service subprocess and an in-process client journal ONE traced
+# request that obs_report --merged stitches into a single cross-process
+# causal timeline; and a locksmith-armed probe proves concurrent
+# scraping causes zero recompiles, zero lock-order violations, and
+# <2% step-time overhead at a 1 Hz poll. Journals pass --strict with
+# typed telemetry_server events (tools/live_smoke.py)
+live-smoke:
+	JAX_PLATFORMS=cpu python tools/live_smoke.py --workdir artifacts/live_smoke
 
 # resilience smoke: a record-backed CPU train under injected faults
 # (skipped bad records within budget, SIGKILL mid-checkpoint-save,
@@ -225,4 +237,4 @@ ps:
 native:
 	$(MAKE) -C native
 
-.PHONY: train resume train-fg test lint lint-baseline verify preflight obs-smoke chaos-smoke data-smoke host-smoke serve-smoke fleet-smoke cache-smoke shard-smoke perf-smoke bench bench-evidence roofline demo demo-gan demo-real dryrun tb ps native
+.PHONY: train resume train-fg test lint lint-baseline verify preflight obs-smoke chaos-smoke data-smoke host-smoke serve-smoke fleet-smoke cache-smoke shard-smoke live-smoke perf-smoke bench bench-evidence roofline demo demo-gan demo-real dryrun tb ps native

@@ -78,7 +78,7 @@ import numpy as np
 from deep_vision_tpu.data.example_codec import decode_example, encode_example
 from deep_vision_tpu.data.pipeline import _buffer_shuffle, collate, worker_put
 from deep_vision_tpu.data.records import _masked_crc
-from deep_vision_tpu.obs import locksmith
+from deep_vision_tpu.obs import locksmith, propagate
 from deep_vision_tpu.resilience import RetryPolicy, faults
 
 
@@ -620,7 +620,16 @@ class DataService:
                     return  # client died mid-request; it will reconnect
                 if req is None:
                     return  # clean client close
-                kind = decode_example(req).get("__kind__", [b""])[0]
+                feats = decode_example(req)
+                kind = feats.get("__kind__", [b""])[0]
+                # a traced get carries the client hop's context over the
+                # wire; this hop becomes its child. Untraced gets (the
+                # steady-state training stream) carry nothing and journal
+                # nothing per-request — tracing is sampled at ingress,
+                # not paid on every batch
+                remote = propagate.from_traceparent(
+                    feats.get("traceparent", [b""])[0])
+                ctx = remote.child() if remote is not None else None
                 if kind == b"stats":
                     with self._lock:
                         served = self._served
@@ -643,6 +652,10 @@ class DataService:
                 self._c_batches.inc()
                 with self._lock:
                     self._served += 1
+                if ctx is not None and self.journal is not None:
+                    self.journal.write(
+                        "data_service", role="server", service=self.name,
+                        batches=1, op="get", **ctx.fields())
         except (OSError, IOError):
             # a frame-boundary failure (incl. the injected io_error) is
             # request-scoped: THIS connection dies, the client reconnects,
@@ -671,6 +684,33 @@ class DataService:
             except queue.Empty:
                 continue
         return None
+
+    # -- live plane (obs/telemetry.py sources) -----------------------------
+
+    def healthz(self):
+        """Telemetry health source: serving iff not stopped and the
+        pump has not latched a terminal failure."""
+        with self._lock:
+            failed = self._failed
+        ok = not self._stop.is_set() and not failed
+        detail = {"service": self.name, "stopped": self._stop.is_set(),
+                  "workers": int(self.num_workers)}
+        if failed:
+            detail["failed"] = failed
+        return ok, detail
+
+    def telemetry_status(self) -> dict:
+        """Telemetry status source: the serving ledger for /statusz."""
+        with self._lock:
+            out = {"service": self.name, "served": int(self._served),
+                   "produced": int(self._produced),
+                   "workers": int(self.num_workers),
+                   "workers_lost": int(self._lost),
+                   "workers_recovered": int(self._recovered),
+                   "clients": len(self._clients),
+                   "failed": self._failed}
+        out["queue_depth"] = self._batches.qsize()
+        return out
 
 
 # -- the client ----------------------------------------------------------------
@@ -726,6 +766,15 @@ class DataServiceClient:
         """One batch; reconnects under the retry policy. DataServiceError
         (a server-side terminal failure) is NOT retried — the service
         itself said it cannot continue."""
+        # batch ingress: a caller that installed a trace context
+        # (propagate.use at the real ingress — a traced request, a smoke)
+        # gets this fetch recorded as its child hop and propagated to the
+        # service over the frame protocol; the steady-state stream stays
+        # untraced and pays nothing
+        parent = propagate.current()
+        ctx = parent.child() if parent is not None else None
+        frame = (_control("get", traceparent=ctx.to_traceparent())
+                 if ctx is not None else _control("get"))
         out: List[dict] = []
         tries = 0
         for attempt in self._retry.attempts():
@@ -738,7 +787,7 @@ class DataServiceClient:
                     self._c_reconnects.inc()
                 sock = self._connect()
                 try:
-                    send_frame(sock, _control("get"))
+                    send_frame(sock, frame)
                     payload = recv_frame(sock)
                 except (OSError, IOError) as e:
                     self._drop()
@@ -751,6 +800,10 @@ class DataServiceClient:
             raise OSError("data.service retry loop yielded no batch")
         self.batches_received += 1
         self._c_batches.inc()
+        if ctx is not None and self.journal is not None:
+            self.journal.write("data_service", role="client",
+                               service=self.name, batches=1, op="get",
+                               reconnects=int(tries - 1), **ctx.fields())
         return out[0]
 
     def batches(self, n: int) -> Iterator[dict]:

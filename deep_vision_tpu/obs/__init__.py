@@ -23,7 +23,17 @@ records the reference's instrumentation as one examples/sec print):
 - `autoprof`: anomaly-triggered `jax.profiler` capture with cooldown
   and budget, plus the configurable static window (`AutoProfiler`).
 - `merge`: per-host journal merge + cross-host straggler detection for
-  multi-host runs (`merge_journal_files`; CLI in tools/obs_merge.py).
+  multi-host runs (`merge_journal_files`; CLI in tools/obs_merge.py),
+  plus per-request trace-id stitching into causal cross-process
+  timelines (`trace_timelines`; rendered by `obs_report --merged`).
+- `telemetry`: the live plane — per-process HTTP `/metrics` `/varz`
+  `/healthz` `/statusz` on a daemon thread, with run-dir discovery
+  files and typed `telemetry_server` journal events (`TelemetryServer`;
+  poller in tools/obs_poll.py).
+- `propagate`: W3C-traceparent-style trace context minted at
+  request/batch ingress, carried over the data-service frame protocol
+  and the serve request path, auto-stamped onto journal events and
+  trace spans (`TraceContext`, `new_trace`, `use`, `current`).
 - `locksmith`: opt-in runtime lock-order sanitizer — named lock/condition
   wrappers adopted by serve/ and obs/, order-inversion + hold-time-outlier
   detection journaled as `lock_order_violation`/`lock_contention` events;
@@ -48,6 +58,12 @@ from deep_vision_tpu.obs.health import (
     dump_all_stacks,
 )
 from deep_vision_tpu.obs.journal import RunJournal, read_journal
+from deep_vision_tpu.obs.propagate import (
+    TraceContext,
+    from_traceparent,
+    new_trace,
+)
+from deep_vision_tpu.obs.telemetry import TelemetryServer
 from deep_vision_tpu.obs.trace import (
     Tracer,
     get_tracer,
@@ -82,15 +98,19 @@ __all__ = [
     "Registry",
     "RunJournal",
     "StepClock",
+    "TelemetryServer",
+    "TraceContext",
     "Tracer",
     "TrainingHealthError",
     "dump_all_stacks",
+    "from_traceparent",
     "get_flight",
     "get_registry",
     "get_tracer",
     "hbm_bytes_in_use",
     "hbm_stats",
     "is_primary_host",
+    "new_trace",
     "process_suffix",
     "read_journal",
     "recompile_count",

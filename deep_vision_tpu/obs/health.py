@@ -127,6 +127,12 @@ class HealthMonitor:
         self._losses: deque = deque(maxlen=self.window)
         self._spike_streak = 0
         self._checks = 0
+        # readiness latch for the telemetry /healthz probe: set just
+        # before every abort-policy raise and never cleared — an aborted
+        # run stays unhealthy until a FRESH monitor re-registers (a new
+        # run is a new monitor, which is how /healthz flips back to 200)
+        self.aborted = False
+        self.abort_reason: Optional[str] = None
 
         # watchdog state: monotonic heartbeat + a fire latch so one stall
         # produces one stack dump, re-armed by the next heartbeat. The
@@ -193,11 +199,11 @@ class HealthMonitor:
                       f"{step} — update skipped", file=sys.stderr, flush=True)
                 return "skip"
             if self.policy == "abort":
-                raise TrainingHealthError(
+                raise self._abort(TrainingHealthError(
                     f"non-finite {'/'.join(bad) or 'loss'} at step {step} "
                     f"(loss={loss!r}, grad_norm={grad_norm!r}); aborting per "
                     "--health-policy abort"
-                )
+                ))
             print(f"health: non-finite {'/'.join(bad)} at step {step} "
                   f"(loss={loss!r}, grad_norm={grad_norm!r})",
                   file=sys.stderr, flush=True)
@@ -231,7 +237,7 @@ class HealthMonitor:
                            f"loss spikes (z={z:.1f}, loss={loss:.4g} vs "
                            f"window mean {mean:.4g})")
                     if self.policy == "abort":
-                        raise TrainingHealthError(msg)
+                        raise self._abort(TrainingHealthError(msg))
                     print("health: " + msg, file=sys.stderr, flush=True)
                 # a spiking loss stays OUT of the window: admitting it
                 # would inflate the std until the very spikes being
@@ -256,12 +262,40 @@ class HealthMonitor:
         self._emit("non_finite", epoch=int(epoch),
                    fields=sorted(bad), action=self.policy)
         if self.policy == "abort":
-            raise TrainingHealthError(
+            raise self._abort(TrainingHealthError(
                 f"non-finite epoch {epoch} summary: {bad}; aborting per "
                 "--health-policy abort"
-            )
+            ))
         print(f"health: non-finite epoch {epoch} summary {bad}",
               file=sys.stderr, flush=True)
+
+    def _abort(self, err: TrainingHealthError) -> TrainingHealthError:
+        """Latch the abort for /healthz, then hand the error back to its
+        raise site (the latch must be set BEFORE the raise unwinds, so a
+        probe racing the abort never sees healthy-but-dying)."""
+        self.aborted = True
+        self.abort_reason = str(err)
+        return err
+
+    def healthz(self):
+        """Telemetry health source: (ok, detail) for TelemetryServer.
+        Unhealthy once aborted or while the watchdog latch is up (the
+        next heartbeat clears the latch — a recovered stall recovers the
+        probe; an abort never does)."""
+        with self._wd_lock:
+            fired = self._wd_fired
+            beat_age = time.monotonic() - self._last_beat
+        ok = not self.aborted and not fired
+        detail = {
+            "policy": self.policy,
+            "monitor": self.name,
+            "aborted": self.aborted,
+            "watchdog_fired": fired,
+            "last_beat_age_s": round(beat_age, 3),
+        }
+        if self.abort_reason:
+            detail["abort_reason"] = self.abort_reason
+        return ok, detail
 
     @property
     def skip_nonfinite(self) -> bool:

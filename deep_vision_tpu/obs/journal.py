@@ -48,7 +48,7 @@ import threading
 import time
 from typing import Callable, List, Optional
 
-from deep_vision_tpu.obs import locksmith
+from deep_vision_tpu.obs import locksmith, propagate
 from deep_vision_tpu.obs.registry import is_primary_host, process_suffix
 
 
@@ -95,6 +95,7 @@ class RunJournal:
         # locksmith-named: the runtime sanitizer checks nothing ever holds
         # this while taking a lock that can be held around a write()
         self._lock = locksmith.lock("obs.journal")
+        self._manifest_row: Optional[dict] = None  # statusz identity card
         self._f = None
         self.dropped_lines = 0  # lines lost to journal I/O errors
         if self._primary:
@@ -164,6 +165,13 @@ class RunJournal:
     def write(self, event: str, **fields) -> None:
         row = {"event": event, "ts": round(time.time(), 3),
                "run_id": self.run_id}
+        # cross-process causality: a write made while a trace context is
+        # installed on THIS thread (obs/propagate.py) carries the request's
+        # ids — explicit trace fields passed by the caller win (the serve
+        # dispatcher stamps a request's context from another thread)
+        ctx = propagate.current()
+        if ctx is not None and "trace_id" not in fields:
+            row.update(ctx.fields())
         row.update({k: _jsonable(v) for k, v in fields.items()})
         # the fault hook sits OUTSIDE the lock: an injected fault that
         # journals its own `fault` event re-enters write(), and the lock is
@@ -230,7 +238,13 @@ class RunJournal:
         if config is not None:
             info["config"] = config
         info.update(extra)
+        self._manifest_row = {k: _jsonable(v) for k, v in info.items()}
         self.write("run_manifest", **info)
+
+    def manifest_row(self) -> Optional[dict]:
+        """The captured manifest (None before manifest() runs) — the
+        telemetry /statusz page serves it without re-reading the file."""
+        return self._manifest_row
 
     def step(self, step: int, **fields) -> None:
         self.write("step", step=int(step), **fields)

@@ -147,6 +147,57 @@ def merge_events(
     return merged, stragglers
 
 
+def trace_timelines(events: Sequence[dict]) -> List[dict]:
+    """Group a (merged) timeline's events by `trace_id` into per-request
+    causal timelines — the cross-PROCESS complement to the cross-HOST
+    straggler pass. Each timeline is one request's hops in time order:
+
+        {"trace_id": ..., "hops": [event, ...], "spans": n,
+         "duration_ms": last.ts - first.ts, "processes": [run_id, ...]}
+
+    Events without a trace_id (steps, checkpoints, ...) are untraced
+    background and simply don't participate. Ordering within a timeline
+    is (ts, parent-before-child) — two hops of one request can share a
+    rounded ts across the wire, and the parent/child span link breaks
+    the tie causally rather than arbitrarily.
+    """
+    by_trace: Dict[str, List[dict]] = {}
+    for e in events:
+        tid = e.get("trace_id")
+        if isinstance(tid, str) and tid:
+            by_trace.setdefault(tid, []).append(e)
+    timelines: List[dict] = []
+    for tid, hops in by_trace.items():
+        parents = {e.get("span_id") for e in hops}
+
+        def depth(e, _parents=parents, _hops=hops):
+            # root spans (parent absent or unknown) sort first at a tie
+            p = e.get("parent_span_id")
+            d = 0
+            seen = set()
+            by_span = {h.get("span_id"): h for h in _hops}
+            while p in _parents and p not in seen:
+                seen.add(p)
+                d += 1
+                p = by_span.get(p, {}).get("parent_span_id")
+            return d
+
+        hops.sort(key=lambda e: (e.get("ts") or 0.0, depth(e)))
+        tss = [e["ts"] for e in hops if e.get("ts") is not None]
+        timelines.append({
+            "trace_id": tid,
+            "hops": hops,
+            "spans": len({e.get("span_id") for e in hops}),
+            "duration_ms": round((max(tss) - min(tss)) * 1e3, 3)
+            if len(tss) > 1 else 0.0,
+            "processes": sorted({e.get("run_id") for e in hops
+                                 if e.get("run_id")}),
+        })
+    timelines.sort(key=lambda t: (t["hops"][0].get("ts") or 0.0,
+                                  t["trace_id"]))
+    return timelines
+
+
 def merge_journal_files(
     paths: Sequence[str],
     out_path: Optional[str] = None,

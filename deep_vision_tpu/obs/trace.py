@@ -38,7 +38,7 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional
 
-from deep_vision_tpu.obs import locksmith
+from deep_vision_tpu.obs import locksmith, propagate
 from deep_vision_tpu.obs.registry import is_primary_host, process_suffix
 
 # Trace-event timestamps are microseconds. Use an epoch-anchored clock so
@@ -145,6 +145,12 @@ class Tracer:
     # -- recording ---------------------------------------------------------
 
     def span(self, name: str, **args) -> _Span:
+        # cross-process causality: a span opened while a trace context is
+        # installed (obs/propagate.py) carries the request's ids, so the
+        # Perfetto view and the journal agree on which request this was
+        ctx = propagate.current()
+        if ctx is not None and "trace_id" not in args:
+            args = dict(args, **ctx.fields())
         return _Span(self, name, args)
 
     def event(self, name: str, t0_us: float, t1_us: Optional[float] = None,

@@ -183,7 +183,7 @@ class ReplicaPool:
                  health_policy: str = "warn", drain_timeout_s: float = 30.0,
                  respawn_policy: Optional[RetryPolicy] = None,
                  monitor_interval_s: float = 0.25,
-                 respawn_fresh: bool = False):
+                 respawn_fresh: bool = False, telemetry=None):
         if replicas < 1:
             raise ValueError(f"replicas must be >= 1, got {replicas}")
         self.build_engine = build_engine
@@ -228,6 +228,13 @@ class ReplicaPool:
         self._respawn_q: _queue.Queue = _queue.Queue()
         self._supervisor: Optional[threading.Thread] = None
         self.warmup_stats: Optional[dict] = None
+        # live plane: the pool registers the fleet view; each replica's
+        # server registers its own serve:<rid> sources (Server.__init__),
+        # and a respawn's fresh server overwrites the dead one's slot
+        self.telemetry = telemetry
+        if telemetry is not None:
+            telemetry.add_health("fleet", self.healthz)
+            telemetry.add_status("fleet", self.telemetry_status)
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -240,7 +247,7 @@ class ReplicaPool:
             max_wait_ms=self.max_wait_ms, slo_ms=self.slo_ms,
             drain_timeout_s=self.drain_timeout_s,
             health_policy=health_policy or self.health_policy,
-            tags={"replica": rid},
+            tags={"replica": rid}, telemetry=self.telemetry,
             on_fatal=lambda exc, _rid=rid: self._on_replica_fatal(_rid, exc))
 
     def start(self) -> "ReplicaPool":
@@ -581,6 +588,33 @@ class ReplicaPool:
     def replica_states(self) -> Dict[str, str]:
         with self._lock:
             return {rid: s.state for rid, s in self._slots.items()}
+
+    def healthz(self):
+        """Telemetry health source: the fleet is ready while at least one
+        replica serves and the pool is not draining — a dead replica
+        mid-respawn degrades capacity, not readiness."""
+        states = self.replica_states()
+        with self._lock:
+            draining = self._draining or self._drained is not None
+        serving = sum(1 for s in states.values() if s == "serving")
+        ok = self._started and not draining and serving > 0
+        return ok, {"started": self._started, "draining": draining,
+                    "serving": serving, "replicas": len(states),
+                    "states": states}
+
+    def telemetry_status(self) -> dict:
+        """Telemetry status source: replica states + fleet ledger +
+        canary generation for /statusz."""
+        with self._lock:
+            replicas = {rid: {"state": s.state, "inflight": s.inflight,
+                              "losses": s.losses, "canary": s.canary}
+                        for rid, s in self._slots.items()}
+            retired = dict(self._retired)
+            generation = self._canary_gen
+            canary_pct = self._canary_pct
+        return {"replicas": replicas, "retired": retired,
+                "generation": generation, "canary_pct": canary_pct,
+                "warmup": self.warmup_stats}
 
     def drain(self, reason: str = "close") -> dict:
         """Flush every admitted request, stop every replica, aggregate

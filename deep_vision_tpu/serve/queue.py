@@ -35,9 +35,14 @@ class Request:
     completed/errors/cancelled — a request must land in exactly one
     bucket no matter which path (resolve, batch failure, client cancel)
     reaches it first.
+
+    `ctx` carries the request's trace context (obs/propagate.py) from
+    the submitting thread to the dispatcher thread — the ambient
+    thread-local slot cannot make that hop, so the context rides the
+    request object itself.
     """
 
-    __slots__ = ("model", "image", "future", "t_submit", "accounted")
+    __slots__ = ("model", "image", "future", "t_submit", "accounted", "ctx")
 
     def __init__(self, model: str, image):
         self.model = model
@@ -45,6 +50,7 @@ class Request:
         self.future: Future = Future()
         self.t_submit = time.perf_counter()
         self.accounted = False
+        self.ctx = None
 
 
 class BatchingQueue:

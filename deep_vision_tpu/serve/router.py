@@ -34,7 +34,7 @@ from typing import Dict, List, Optional
 import jax
 import numpy as np
 
-from deep_vision_tpu.obs import locksmith
+from deep_vision_tpu.obs import locksmith, propagate
 from deep_vision_tpu.obs.trace import span
 from deep_vision_tpu.serve.buckets import bucket_for, pad_batch, split_rows
 from deep_vision_tpu.serve.engine import Engine, ServeError
@@ -67,7 +67,7 @@ class Server:
                  max_wait_ms: float = 5.0, drain_timeout_s: float = 30.0,
                  slo_ms: Optional[float] = None,
                  health_policy: str = "warn", health=None,
-                 tags: Optional[dict] = None):
+                 tags: Optional[dict] = None, telemetry=None):
         if health_policy not in HEALTH_POLICIES:
             raise ValueError(
                 f"health_policy {health_policy!r} not in {HEALTH_POLICIES}")
@@ -102,6 +102,14 @@ class Server:
         self._drain_done = threading.Event()
         self._stop = threading.Event()
         self._prev_sigterm = None
+        # live plane (obs/telemetry.py): registration is idempotent by
+        # name, so a respawned replica takes over its predecessor's slot
+        # and the /healthz verdict tracks the CURRENT server's drain state
+        self.telemetry = telemetry
+        if telemetry is not None:
+            name = f"serve:{self.tags.get('replica', 'server')}"
+            telemetry.add_health(name, self.healthz)
+            telemetry.add_status(name, self.telemetry_status)
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -141,6 +149,13 @@ class Server:
             raise ServeError("submit() before start(): no dispatchers are "
                              "running to answer it")
         req = Request(model, image)
+        # request ingress mints the trace context: a caller that already
+        # carries one (a traced client thread) makes this hop its child,
+        # anyone else roots a fresh trace — either way every serve_request
+        # event is stitchable by trace_id across processes
+        parent = propagate.current()
+        req.ctx = parent.child() if parent is not None else \
+            propagate.new_trace()
         # decode OUTSIDE the submit lock: the dtype cast/copy, shape check,
         # and fault boundary are per-request work that must not serialize
         # ingestion across client threads — only the accept+enqueue below
@@ -188,6 +203,29 @@ class Server:
                 self._fail_request(req, decode_err)
         return req.future
 
+    def healthz(self):
+        """Telemetry health source: ready iff started and not
+        draining/stopped — the 503 a router flips to on drain is what
+        tells an upstream balancer to stop routing here."""
+        draining = self._drained is not None or self._stop.is_set()
+        ok = self._started and not draining
+        return ok, {"started": self._started, "draining": draining,
+                    **{k: str(v) for k, v in self.tags.items()}}
+
+    def telemetry_status(self) -> dict:
+        """Telemetry status source: the request ledger + per-model SLO
+        view for /statusz. Host-side reads only."""
+        out = dict(self.counts())
+        out["models"] = sorted(self.engine.models)
+        out["draining"] = self._drained is not None or self._stop.is_set()
+        try:
+            out["slo"] = self.slo.report()
+        except Exception:
+            pass
+        if self.tags:
+            out["tags"] = dict(self.tags)
+        return out
+
     def counts(self) -> dict:
         """One consistent snapshot of the request ledger (the drain
         invariant's four buckets) — a ReplicaPool folds these into its
@@ -215,6 +253,11 @@ class Server:
         self.slo.request_done(req.model, latency_ms, outcome)
         if self.journal is not None:
             extra = {"error": error[:200]} if error else {}
+            # the request's OWN context, stamped explicitly: _account runs
+            # on the dispatcher thread, whose ambient thread-local slot
+            # belongs to no request in particular
+            if req.ctx is not None:
+                extra.update(req.ctx.fields())
             self.journal.write("serve_request", model=req.model,
                                latency_ms=round(latency_ms, 3),
                                outcome=outcome, **self.tags, **extra)

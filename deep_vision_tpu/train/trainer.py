@@ -108,6 +108,7 @@ class Trainer:
         host_supervisor=None,  # resilience.rendezvous.HostSupervisor or None
         executable_cache=None,  # core.excache.ExecutableCache or None
         sharding_rules=None,  # parallel.shardmap.ShardingRules or None
+        telemetry=None,  # obs.TelemetryServer: live /healthz + /statusz
     ):
         self.mesh = mesh if mesh is not None else create_mesh()
         self.model = model  # single source of truth for summaries/export
@@ -352,6 +353,51 @@ class Trainer:
                              if self.multistep > 1 else None),
                 registry=self.clock.registry,
             )
+        # live telemetry plane (obs/telemetry.py): register host-side
+        # status + readiness sources. The scraper thread must never touch
+        # the device, so /statusz reads the plain-Python step mirror kept
+        # by the *_and_log paths, not `int(self.state.step)` (a device
+        # fetch that could fence against an in-flight dispatch).
+        self._live_step: Optional[int] = None
+        self._live_epoch: Optional[int] = None
+        self._live_eps: Optional[float] = None
+        self.telemetry = telemetry
+        if telemetry is not None:
+            telemetry.add_status("train", self._telemetry_status)
+            if self.health is not None:
+                telemetry.add_health("train", self.health.healthz)
+            if self.hosts is not None:
+                telemetry.add_health("rendezvous", self._rendezvous_health)
+
+    def _telemetry_status(self) -> dict:
+        """Telemetry status source for /statusz: the last step/epoch and
+        throughput the train loop published, plus the world generation.
+        Host-side reads only — see the registration comment above."""
+        out = {
+            "step": self._live_step,
+            "epoch": self._live_epoch,
+            "examples_per_sec": (round(self._live_eps, 1)
+                                 if self._live_eps else self._live_eps),
+            "steps_seen": int(self.clock.steps_seen),
+            "multistep": int(self.multistep),
+        }
+        if self.hosts is not None:
+            out["generation"] = getattr(self.hosts.rdzv, "generation", None)
+        return out
+
+    def _rendezvous_health(self):
+        """Telemetry health source: this host's OWN lease freshness — a
+        host whose heartbeat thread died is about to be declared lost by
+        its peers, and /healthz should say so first."""
+        rdzv = self.hosts.rdzv
+        gap = rdzv.lease_gap(rdzv.host)
+        ok = gap is not None and gap <= rdzv.lease_s
+        return ok, {
+            "host": rdzv.host,
+            "generation": rdzv.generation,
+            "lease_gap_s": round(gap, 3) if gap is not None else None,
+            "lease_s": rdzv.lease_s,
+        }
 
     def _place_state(self, state):
         """Place a host/abstract state onto the mesh: per the resolved
@@ -1064,6 +1110,10 @@ class Trainer:
             rec.commit(step=opt_step,
                        metrics={"loss": metrics["loss"], "lr": lr}
                        if "loss" in metrics else {"lr": lr})
+        # publish the host-side mirror the telemetry scraper reads (plain
+        # attribute writes: benign to race, never a device fetch)
+        self._live_step, self._live_epoch = opt_step, epoch
+        self._live_eps = rec.examples_per_sec
         # anomaly triggers see the committed record (step-time/data-wait
         # z-scores, recompile bursts, HBM high-water jumps) and arm a
         # capture that the NEXT step's _profiler_hook starts
@@ -1127,6 +1177,8 @@ class Trainer:
                        metrics={"loss": last["loss"], "lr": lr}
                        if "loss" in last else {"lr": lr},
                        extra={"multistep": k})
+        self._live_step, self._live_epoch = opt_step, epoch
+        self._live_eps = rec.examples_per_sec
         if self.prof is not None:
             self.prof.observe_step(opt_step, rec.fields())
         floats = jax.device_get(metrics_k)  # ONE fetch for all K microsteps

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import argparse
 import os
+import sys as _sys
 from typing import List, Optional
 
 import numpy as np
@@ -340,7 +341,8 @@ def build_trainer(cfg: ExperimentConfig, train_fn, ckpt_dir: Optional[str],
                   data_loader=None,
                   steps_per_epoch: Optional[int] = None,
                   executable_cache=None,
-                  sharding_rules=None):
+                  sharding_rules=None,
+                  telemetry=None):
     import functools
 
     import jax.numpy as jnp
@@ -426,6 +428,7 @@ def build_trainer(cfg: ExperimentConfig, train_fn, ckpt_dir: Optional[str],
         data_loader=data_loader,
         executable_cache=executable_cache,
         sharding_rules=sharding_rules,
+        telemetry=telemetry,
     )
 
 
@@ -632,6 +635,44 @@ def _make_flight(args, journal):
     return flight
 
 
+def _make_telemetry(args, journal, flight, discovery_dir,
+                    role: str = "train"):
+    """--telemetry-port / DVT_TELEMETRY: the live observability plane
+    (obs/telemetry.py). Failure to bind degrades to a warning — the
+    telemetry plane must never kill the run it observes."""
+    port = args.telemetry_port
+    if port is None:
+        env = os.environ.get("DVT_TELEMETRY", "").strip()
+        if env:
+            try:
+                port = int(env)
+            except ValueError:
+                print(f"warning: DVT_TELEMETRY={env!r} is not a port; "
+                      "telemetry disabled", file=_sys.stderr)
+                return None
+    if port is None:
+        return None
+    from deep_vision_tpu.obs.registry import get_registry
+    from deep_vision_tpu.obs.telemetry import TelemetryServer
+
+    tele = TelemetryServer(port=port, role=role, registry=get_registry(),
+                           journal=journal, flight=flight,
+                           discovery_dir=discovery_dir)
+    try:
+        tele.start()
+    except OSError as e:
+        print(f"warning: telemetry server failed to bind port {port} "
+              f"({e}); continuing without live endpoints",
+              file=_sys.stderr)
+        return None
+    if journal is not None:
+        # abnormal unwinds: close is idempotent, the clean path in
+        # _finish_obs re-running it is a no-op
+        journal.add_closer(tele.close)
+    print(f"telemetry: http://{tele.address}/statusz")
+    return tele
+
+
 def _parse_profile_window(parser, spec: str):
     try:
         start_s, stop_s = spec.split(":")
@@ -668,12 +709,16 @@ def _make_autoprof(args, journal, default_dir: str, window=None):
 
 def _finish_obs(args, journal, status: str = "clean_exit",
                 tracer=None, health=None, autoprof=None,
-                flight=None) -> None:
+                flight=None, telemetry=None) -> None:
     """Clean-run epilogue: Prometheus export + trace flush + journal exit
     marker + multi-host journal aggregation + flight disarm. (Abnormal
     exits are covered by the journal's atexit crash marker, the tracer's
     atexit flush, the health closer, and the flight recorder's atexit
     crash dump.)"""
+    if telemetry is not None:
+        # first: stop answering scrapes before the sources below tear down
+        # (a probe against a half-closed run would read freed state)
+        telemetry.close()
     if autoprof is not None:
         autoprof.close()  # stop an in-flight capture instead of leaking it
     if health is not None:
@@ -779,6 +824,15 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--telemetry-sample-every", type=int, default=16,
                         help="block_until_ready fence cadence for the "
                              "step-time breakdown (obs/stepclock.py)")
+    parser.add_argument("--telemetry-port", type=int, default=None,
+                        metavar="PORT",
+                        help="serve live /metrics /varz /healthz /statusz "
+                             "over HTTP on PORT (0 = auto-assign; the bound "
+                             "port is journaled as a telemetry_server event "
+                             "and written to a discovery file under the "
+                             "checkpoint dir for tools/obs_poll.py). "
+                             "DVT_TELEMETRY=PORT is the env equivalent "
+                             "(obs/telemetry.py)")
     parser.add_argument("--trace", default=None, metavar="PATH",
                         help="write Chrome trace-event JSON spans (data "
                              "fetch/augment, train/eval steps, checkpoint "
@@ -1080,6 +1134,11 @@ def main(argv: Optional[List[str]] = None) -> int:
             args, journal, args.ckpt_dir or os.path.join("checkpoints",
                                                          cfg.name),
             window=_parse_profile_window(parser, args.profile_window))
+        telemetry = _make_telemetry(
+            args, journal, flight,
+            args.ckpt_dir or os.path.join("checkpoints", cfg.name))
+        if telemetry is not None and health is not None:
+            telemetry.add_health("train", health.healthz)
         trainer = build_gan_trainer(
             cfg, journal=journal,
             telemetry_sample_every=args.telemetry_sample_every,
@@ -1204,7 +1263,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         gan_ckpt.wait()
         _maybe_upload(args, ckpt_dir)
         _finish_obs(args, journal, tracer=tracer, health=health,
-                    autoprof=autoprof, flight=flight)
+                    autoprof=autoprof, flight=flight, telemetry=telemetry)
         # a graceful preemption exits with the requeue code (EX_TEMPFAIL):
         # the scheduler resubmits and the run resumes from the preempt
         # checkpoint — on whatever mesh the new allocation provides
@@ -1219,6 +1278,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     autoprof = _make_autoprof(
         args, journal, ckpt_dir,
         window=_parse_profile_window(parser, args.profile_window))
+    telemetry = _make_telemetry(args, journal, flight, ckpt_dir)
     # -- the data plane's two new modes (data/service.py, data/snapshot.py)
     if args.data_snapshot and args.data_service:
         # refuse BEFORE any client/loader is built: a constructed client
@@ -1278,7 +1338,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                             steps_per_epoch=(args.data_service_steps
                                              if args.data_service else None),
                             executable_cache=excache,
-                            sharding_rules=sharding_rules)
+                            sharding_rules=sharding_rules,
+                            telemetry=telemetry)
     if journal is not None:
         # an unwinding run (exception/SIGTERM) still stops an in-flight
         # profiler trace and flushes writers via the atexit crash path
@@ -1309,7 +1370,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         run_eval_only(cfg, trainer, eval_fn)
         trainer.close()
         _finish_obs(args, journal, tracer=tracer, health=health,
-                    autoprof=autoprof, flight=flight)
+                    autoprof=autoprof, flight=flight, telemetry=telemetry)
         return 0
     trainer.fit(
         train_fn, eval_fn, epochs=cfg.epochs, start_epoch=start_epoch,
@@ -1320,7 +1381,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         data_client.close()  # idempotent: the journal closer may re-run it
     _maybe_upload(args, ckpt_dir)
     _finish_obs(args, journal, tracer=tracer, health=health,
-                autoprof=autoprof, flight=flight)
+                autoprof=autoprof, flight=flight, telemetry=telemetry)
     # SIGTERM escalation epilogue: the preempt checkpoint is on disk and
     # journaled — exit with the requeue code so the scheduler resubmits
     # (resume rides the cross-mesh restore if the new slice is smaller)

@@ -1,0 +1,546 @@
+"""Live telemetry plane (obs/telemetry.py) + cross-process trace context
+(obs/propagate.py): endpoint contracts, health flips, respawn survival,
+propagation round-trips, and the journal schema drift guards."""
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deep_vision_tpu.obs import RunJournal, locksmith, propagate, read_journal
+from deep_vision_tpu.obs.registry import Registry
+from deep_vision_tpu.obs.telemetry import (
+    DISCOVERY_PREFIX,
+    TELEMETRY_OUTCOMES,
+    TelemetryServer,
+    read_discovery,
+    validate_prometheus,
+)
+
+
+def get(address, path, timeout=5.0):
+    """(status, content_type, body_text); HTTP errors return their code."""
+    try:
+        with urllib.request.urlopen(f"http://{address}{path}",
+                                    timeout=timeout) as resp:
+            return (resp.status, resp.headers.get("Content-Type", ""),
+                    resp.read().decode("utf-8"))
+    except urllib.error.HTTPError as e:
+        return e.code, e.headers.get("Content-Type", ""), \
+            e.read().decode("utf-8")
+
+
+@pytest.fixture
+def tele(tmp_path):
+    reg = Registry()
+    j = RunJournal(str(tmp_path / "run.jsonl"), kind="train")
+    t = TelemetryServer(port=0, role="test", registry=reg, journal=j,
+                        discovery_dir=str(tmp_path))
+    t.registry_ref = reg  # test convenience
+    t.journal_ref = j
+    t.start()
+    yield t
+    t.close()
+    if not j._closed:
+        j.close()
+
+
+# -- propagate: W3C-shaped trace context --------------------------------------
+
+class TestPropagate:
+    def test_traceparent_round_trip(self):
+        ctx = propagate.new_trace()
+        tp = ctx.to_traceparent()
+        assert tp.startswith("00-")
+        back = propagate.from_traceparent(tp)
+        assert back is not None
+        assert back.trace_id == ctx.trace_id
+        assert back.span_id == ctx.span_id
+        # bytes form (the data-service frame carries bytes)
+        assert propagate.from_traceparent(tp.encode()) == back
+
+    def test_child_links_parent(self):
+        root = propagate.new_trace()
+        child = root.child()
+        assert child.trace_id == root.trace_id
+        assert child.parent_span_id == root.span_id
+        assert child.span_id != root.span_id
+        assert "parent_span_id" in child.fields()
+        assert "parent_span_id" not in root.fields()
+
+    @pytest.mark.parametrize("garbage", [
+        "", "nonsense", b"", b"\x00\xff",
+        "00-zz-zz-01",                                    # non-hex
+        "00-" + "0" * 32 + "-" + "1" * 16 + "-01",        # zero trace id
+        "00-" + "1" * 32 + "-" + "0" * 16 + "-01",        # zero span id
+        "ff-" + "1" * 32 + "-" + "2" * 16 + "-01",        # forbidden version
+        "00-" + "1" * 31 + "-" + "2" * 16 + "-01",        # short trace id
+        "00-" + "A" * 32 + "-" + "2" * 16 + "-01",        # uppercase hex
+        None, 7,
+    ])
+    def test_garbage_parses_to_none(self, garbage):
+        assert propagate.from_traceparent(garbage) is None
+
+    def test_thread_local_use_nests_and_restores(self):
+        assert propagate.current() is None
+        a, b = propagate.new_trace(), propagate.new_trace()
+        with propagate.use(a):
+            assert propagate.current() is a
+            with propagate.use(b):
+                assert propagate.current() is b
+                with propagate.use(None):  # masking
+                    assert propagate.current() is None
+                assert propagate.current() is b
+            assert propagate.current() is a
+        assert propagate.current() is None
+
+    def test_context_is_thread_local(self):
+        seen = []
+        ctx = propagate.new_trace()
+        with propagate.use(ctx):
+            t = threading.Thread(
+                target=lambda: seen.append(propagate.current()))
+            t.start()
+            t.join()
+        assert seen == [None]  # other threads see nothing
+
+
+# -- the endpoints ------------------------------------------------------------
+
+class TestEndpoints:
+    def test_metrics_prometheus(self, tele):
+        tele.registry_ref.counter("thing_total", "things").inc(3)
+        tele.registry_ref.histogram("lat_ms", "latency").observe(5.0)
+        code, ctype, body = get(tele.address, "/metrics")
+        assert code == 200 and ctype.startswith("text/plain")
+        assert "thing_total 3" in body
+        assert validate_prometheus(body) == []
+
+    def test_varz_json_snapshot(self, tele):
+        tele.registry_ref.gauge("depth", "queue depth").set(4)
+        code, ctype, body = get(tele.address, "/varz")
+        assert code == 200 and ctype.startswith("application/json")
+        assert json.loads(body)["depth"] == 4
+
+    def test_healthz_aggregates_sources(self, tele):
+        code, _, body = get(tele.address, "/healthz")
+        assert code == 200  # vacuous truth: no sources, nothing failing
+        tele.add_health("good", lambda: (True, {"x": 1}))
+        code, _, body = get(tele.address, "/healthz")
+        assert code == 200 and json.loads(body)["checks"]["good"]["ok"]
+        tele.add_health("bad", lambda: (False, {"why": "down"}))
+        code, _, body = get(tele.address, "/healthz")
+        row = json.loads(body)
+        assert code == 503 and row["ok"] is False
+        assert row["checks"]["bad"]["why"] == "down"
+        assert row["checks"]["good"]["ok"] is True  # still reported
+
+    def test_raising_source_fails_closed_not_500(self, tele):
+        def boom():
+            raise RuntimeError("probe exploded")
+
+        tele.add_health("boom", boom)
+        code, _, body = get(tele.address, "/healthz")
+        assert code == 503  # an unevaluable probe is not a passing probe
+        assert "probe exploded" in json.loads(body)["checks"]["boom"]["error"]
+        # statusz still renders around a broken status source
+        tele.add_status("boom", boom)
+        code, _, body = get(tele.address, "/statusz")
+        assert code == 200
+        assert "probe exploded" in json.loads(body)["status"]["boom"]["error"]
+
+    def test_statusz_json_and_html(self, tele):
+        tele.journal_ref.manifest(config={"name": "t5", "task": "clf"})
+        tele.add_status("train", lambda: {"step": 12, "epoch": 1})
+        code, _, body = get(tele.address, "/statusz")
+        row = json.loads(body)
+        assert code == 200
+        assert row["role"] == "test"
+        assert row["status"]["train"]["step"] == 12
+        assert row["manifest"]["config"]["name"] == "t5"
+        code, ctype, html = get(tele.address, "/statusz?format=html")
+        assert code == 200 and ctype.startswith("text/html")
+        assert "HEALTHY" in html and "statusz" in html
+
+    def test_unknown_route_404(self, tele):
+        code, _, _ = get(tele.address, "/nope")
+        assert code == 404
+        code, _, body = get(tele.address, "/")
+        assert code == 200 and "/metrics" in body
+
+    def test_registration_idempotent_by_name(self, tele):
+        tele.add_status("s", lambda: {"v": 1})
+        tele.add_status("s", lambda: {"v": 2})  # replace, not duplicate
+        _, _, body = get(tele.address, "/statusz")
+        assert json.loads(body)["status"]["s"]["v"] == 2
+        tele.remove("s")
+        _, _, body = get(tele.address, "/statusz")
+        assert "s" not in json.loads(body)["status"]
+
+
+class TestLifecycle:
+    def test_discovery_and_journal_events(self, tele, tmp_path):
+        recs = read_discovery(str(tmp_path))
+        assert len(recs) == 1
+        rec = recs[0]
+        assert rec["port"] == tele.port and rec["role"] == "test"
+        assert rec["discovery_file"].startswith(DISCOVERY_PREFIX)
+        tele.close()
+        assert read_discovery(str(tmp_path)) == []
+        tele.close()  # idempotent
+        tele.journal_ref.close()
+        ev = [e for e in read_journal(tele.journal_ref.path)
+              if e.get("event") == "telemetry_server"]
+        assert [e["outcome"] for e in ev] == ["started", "stopped"]
+        assert all(e["port"] == rec["port"] for e in ev)
+
+    def test_garbled_discovery_file_skipped(self, tmp_path):
+        (tmp_path / f"{DISCOVERY_PREFIX}train-1.json").write_text("{tor")
+        (tmp_path / f"{DISCOVERY_PREFIX}train-2.json").write_text(
+            json.dumps({"host": "127.0.0.1", "port": 1234, "pid": 2}))
+        recs = read_discovery(str(tmp_path))
+        assert len(recs) == 1 and recs[0]["port"] == 1234
+
+    def test_bind_conflict_journals_failed_and_raises(self, tmp_path):
+        j = RunJournal(str(tmp_path / "r.jsonl"), kind="train")
+        a = TelemetryServer(port=0, journal=j).start()
+        b = TelemetryServer(port=a.port, journal=j)
+        with pytest.raises(OSError):
+            b.start()
+        a.close()
+        j.close()
+        ev = [e for e in read_journal(j.path)
+              if e.get("event") == "telemetry_server"]
+        assert [e["outcome"] for e in ev] == ["started", "failed", "stopped"]
+
+
+# -- health flips: abort -> 503, fresh run -> 200 -----------------------------
+
+class TestHealthFlip:
+    def test_healthz_flips_on_abort_and_back_on_fresh_monitor(
+            self, tele, tmp_path):
+        from deep_vision_tpu.obs.health import (
+            HealthMonitor,
+            TrainingHealthError,
+        )
+
+        mon = HealthMonitor(policy="abort", journal=tele.journal_ref,
+                            registry=tele.registry_ref)
+        tele.add_health("train", mon.healthz)
+        code, _, _ = get(tele.address, "/healthz")
+        assert code == 200
+        with pytest.raises(TrainingHealthError):
+            mon.check_step(7, loss=float("nan"))
+        code, _, body = get(tele.address, "/healthz")
+        row = json.loads(body)
+        assert code == 503
+        assert row["checks"]["train"]["aborted"] is True
+        assert "abort_reason" in row["checks"]["train"]
+        mon.stop()
+        # a fresh run's monitor re-registers UNDER THE SAME NAME — that
+        # is the recovery story, not clearing the dead monitor's latch
+        fresh = HealthMonitor(policy="abort", journal=tele.journal_ref,
+                              registry=Registry())
+        tele.add_health("train", fresh.healthz)
+        code, _, _ = get(tele.address, "/healthz")
+        assert code == 200
+        fresh.stop()
+
+
+# -- concurrent scrapes under a jitted loop, locksmith armed ------------------
+
+class TestConcurrentScrapes:
+    def test_scrapes_during_jit_loop_zero_violations(self, tmp_path):
+        import jax
+        import jax.numpy as jnp
+
+        from deep_vision_tpu.obs.stepclock import recompile_count
+
+        reg = Registry()
+        j = RunJournal(str(tmp_path / "r.jsonl"), kind="train")
+        san = locksmith.arm(journal=j)
+        try:
+            tele = TelemetryServer(port=0, role="train", registry=reg,
+                                   journal=j, discovery_dir=str(tmp_path))
+            tele.start()
+            step_box = [0]
+            tele.add_health("loop", lambda: (True, {}))
+            tele.add_status("loop", lambda: {"step": step_box[0]})
+            step_t = reg.histogram("step_time_ms", "steps")
+            loss_g = reg.gauge("loss", "loss")
+
+            @jax.jit
+            def step(x):
+                return (x * 1.0001 + 0.1).sum()
+
+            stop = threading.Event()
+            failures = []
+
+            def scrape():
+                while not stop.is_set():
+                    for path in ("/metrics", "/healthz", "/statusz",
+                                 "/varz"):
+                        code, _, body = get(tele.address, path)
+                        if code not in (200, 503):
+                            failures.append((path, code))
+                    time.sleep(0.002)
+
+            scrapers = [threading.Thread(target=scrape, daemon=True)
+                        for _ in range(3)]
+            for t in scrapers:
+                t.start()
+            x = jnp.arange(64, dtype=jnp.float32)
+            step(x)  # compile ONCE before the baseline
+            c0 = recompile_count()
+            for i in range(60):
+                t0 = time.perf_counter()
+                val = float(step(x))
+                step_t.observe((time.perf_counter() - t0) * 1e3)
+                loss_g.set(val)
+                step_box[0] = i
+            stop.set()
+            for t in scrapers:
+                t.join(timeout=10)
+            assert not failures, failures[:3]
+            # scraping is read-only: ZERO recompiles triggered by it
+            assert recompile_count() == c0
+            _, _, body = get(tele.address, "/metrics")
+            assert validate_prometheus(body) == []
+            tele.close()
+            report = locksmith.report()
+            assert report["violations"] == []
+        finally:
+            locksmith.disarm()
+            if not j._closed:
+                j.close()
+
+
+# -- replica respawn keeps the endpoint alive ---------------------------------
+
+class TestServeRespawn:
+    def test_endpoint_survives_replica_respawn(self, tmp_path):
+        from tests.test_serve_pool import (
+            build_engine_factory,
+            images,
+            wait_all_serving,
+        )
+
+        from deep_vision_tpu.resilience import faults
+        from deep_vision_tpu.serve import ReplicaPool, ServeError
+
+        reg = Registry()
+        j = RunJournal(str(tmp_path / "fleet.jsonl"), kind="serve")
+        tele = TelemetryServer(port=0, role="serve", registry=reg,
+                               journal=j, discovery_dir=str(tmp_path))
+        tele.start()
+        pool = ReplicaPool(build_engine_factory(reg, journal=j),
+                           replicas=2, journal=j, registry=reg,
+                           max_wait_ms=3.0, telemetry=tele)
+        pool.start()
+        try:
+            code, _, body = get(tele.address, "/healthz")
+            assert code == 200
+            checks = json.loads(body)["checks"]
+            assert "fleet" in checks
+            assert any(k.startswith("serve:") for k in checks)
+            faults.install_spec("serve.replica:io_error@1", seed=0,
+                                journal=j, export_env=False)
+            futs = [pool.submit("toy", im) for im in images(6)]
+            for f in futs:
+                try:
+                    f.result(timeout=30)
+                except ServeError:
+                    pass
+            faults.install(None)
+            assert wait_all_serving(pool)
+            # the respawned replica re-registered its sources BY NAME:
+            # the endpoint answers 200 and statusz shows full strength
+            code, _, body = get(tele.address, "/healthz")
+            assert code == 200, body
+            _, _, body = get(tele.address, "/statusz")
+            fleet = json.loads(body)["status"]["fleet"]
+            assert all(r["state"] == "serving"
+                       for r in fleet["replicas"].values())
+        finally:
+            faults.install(None)
+            pool.drain("close")
+            tele.close()
+            if not j._closed:
+                j.close()
+
+
+# -- propagation across the data-service boundary -----------------------------
+
+class TestDataServicePropagation:
+    def test_codec_round_trips_traceparent(self):
+        from deep_vision_tpu.data.example_codec import decode_example
+        from deep_vision_tpu.data.service import _control
+
+        ctx = propagate.new_trace().child()
+        frame = _control("get", traceparent=ctx.to_traceparent())
+        feats = decode_example(frame)
+        back = propagate.from_traceparent(
+            feats.get("traceparent", [b""])[0])
+        assert back is not None
+        assert back.trace_id == ctx.trace_id
+        assert back.span_id == ctx.span_id
+
+    def test_live_get_journals_one_trace_across_processes(self, tmp_path):
+        from tests.test_data_service import _smoke_schema, _write_shards
+
+        from deep_vision_tpu.data.datasets import RecordDataset
+        from deep_vision_tpu.data.service import (
+            DataService,
+            DataServiceClient,
+        )
+        from deep_vision_tpu.obs.merge import trace_timelines
+
+        pattern = _write_shards(tmp_path)
+        sj = RunJournal(str(tmp_path / "server.jsonl"), kind="data_service")
+        cj = RunJournal(str(tmp_path / "client.jsonl"), kind="train")
+        ds = RecordDataset(pattern, _smoke_schema, shuffle_shards=True,
+                           seed=3)
+        svc = DataService(ds, batch_size=8, num_workers=1,
+                          shuffle_buffer=16, seed=7, queue_depth=8,
+                          journal=sj).start()
+        try:
+            client = DataServiceClient(svc.address, name="t", journal=cj)
+            # steady state: NO trace context installed -> no per-request
+            # data_service events (training streams must pay nothing)
+            assert client.get() is not None
+            # ingress installs a root context -> both sides journal
+            root = propagate.new_trace()
+            with propagate.use(root):
+                assert client.get() is not None
+            client.close()
+        finally:
+            svc.close()
+        sj.close()
+        cj.close()
+        # op="get" marks the per-request hop events; the client's close()
+        # summary event (no op) is the pre-existing aggregate
+        client_ev = [e for e in read_journal(cj.path)
+                     if e.get("event") == "data_service"
+                     and e.get("role") == "client" and e.get("op") == "get"]
+        server_ev = [e for e in read_journal(sj.path)
+                     if e.get("event") == "data_service"
+                     and e.get("role") == "server" and e.get("op") == "get"]
+        assert len(client_ev) == 1, client_ev  # the traced get, only
+        assert len(server_ev) == 1, server_ev
+        c, s = client_ev[0], server_ev[0]
+        # one trace; the causal chain is root -> client hop -> server hop
+        assert c["trace_id"] == root.trace_id == s["trace_id"]
+        assert c["parent_span_id"] == root.span_id
+        assert s["parent_span_id"] == c["span_id"]
+        # merged, the hops stitch into ONE cross-process timeline
+        merged = read_journal(cj.path) + read_journal(sj.path)
+        tls = trace_timelines(merged)
+        assert len(tls) == 1
+        tl = tls[0]
+        assert tl["trace_id"] == root.trace_id
+        assert len(tl["processes"]) == 2
+        assert [h["role"] for h in tl["hops"]] == ["client", "server"]
+
+    def test_serve_submit_stamps_request_events(self, tmp_path):
+        from tests.test_serve_pool import build_engine_factory, images
+
+        from deep_vision_tpu.serve import Server
+
+        reg = Registry()
+        j = RunJournal(str(tmp_path / "serve.jsonl"), kind="serve")
+        eng = build_engine_factory(reg, journal=j)("r0")
+        eng.warmup()
+        srv = Server(eng, journal=j, registry=reg, max_wait_ms=2.0)
+        srv.start()
+        try:
+            root = propagate.new_trace()
+            with propagate.use(root):
+                assert srv.submit(
+                    "toy", images(1)[0]).result(timeout=30) is not None
+            # no installed context: a fresh root is minted per request
+            assert srv.submit(
+                "toy", images(1)[0]).result(timeout=30) is not None
+        finally:
+            srv.drain("close")
+            j.close()
+        reqs = [e for e in read_journal(j.path)
+                if e.get("event") == "serve_request"]
+        assert len(reqs) == 2
+        traced = [e for e in reqs if e.get("trace_id") == root.trace_id]
+        assert len(traced) == 1
+        assert traced[0]["parent_span_id"] == root.span_id
+        # the untraced request still carries ITS OWN fresh trace
+        other = next(e for e in reqs if e is not traced[0])
+        assert propagate.valid_trace_id(other.get("trace_id"))
+        assert other["trace_id"] != root.trace_id
+
+
+# -- journal schema + drift guards --------------------------------------------
+
+class TestSchema:
+    def _check(self, tmp_path, row):
+        from tools.check_journal import check_journal
+
+        path = str(tmp_path / "j.jsonl")
+        base = {"ts": time.time(), "run_id": "r1"}
+        rows = [
+            {"event": "run_manifest", "kind": "train", "argv": [], **base},
+            {**base, **row},
+            {"event": "exit", "status": "clean_exit", **base},
+        ]
+        with open(path, "w") as f:
+            for r in rows:
+                f.write(json.dumps(r) + "\n")
+        return check_journal(path, strict=True)
+
+    def test_valid_telemetry_server_passes(self, tmp_path):
+        assert self._check(tmp_path, {
+            "event": "telemetry_server", "host": "127.0.0.1",
+            "port": 9090, "outcome": "started", "role": "train",
+            "pid": 1}) == []
+
+    def test_bad_outcome_and_port_rejected(self, tmp_path):
+        errs = self._check(tmp_path, {
+            "event": "telemetry_server", "host": "h", "port": "9090",
+            "outcome": "exploded"})
+        assert any("outcome" in e for e in errs)
+        assert any("port" in e for e in errs)
+
+    def test_trace_fields_validated_everywhere(self, tmp_path):
+        good = propagate.new_trace().child()
+        assert self._check(tmp_path, {
+            "event": "serve_request", "model": "m", "latency_ms": 1.0,
+            "outcome": "ok", **good.fields()}) == []
+        errs = self._check(tmp_path, {
+            "event": "serve_request", "model": "m", "latency_ms": 1.0,
+            "outcome": "ok", "trace_id": "SHORT", "span_id": "x"})
+        assert any("trace_id" in e for e in errs)
+        assert any("span_id" in e for e in errs)
+        errs = self._check(tmp_path, {
+            "event": "data_service", "role": "client", "batches": 1,
+            **dict(good.fields(), parent_span_id="nope")})
+        assert any("parent_span_id" in e for e in errs)
+
+    def test_outcome_enums_do_not_drift(self):
+        from tools.check_journal import (
+            EVENT_FIELDS,
+            TELEMETRY_SERVER_OUTCOMES,
+        )
+
+        assert set(TELEMETRY_OUTCOMES) == TELEMETRY_SERVER_OUTCOMES
+        assert EVENT_FIELDS["telemetry_server"] == ("host", "port",
+                                                    "outcome")
+
+    def test_emitter_matches_schema(self, tele, tmp_path):
+        """The real emitter's events pass the strict checker — the
+        PR-13-style drift guard between obs/telemetry.py and
+        tools/check_journal.py."""
+        from tools.check_journal import check_journal
+
+        tele.journal_ref.manifest(config={"name": "t", "task": "clf"})
+        tele.close()
+        tele.journal_ref.close()
+        assert check_journal(tele.journal_ref.path, strict=True) == []

@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import json
 import os
+import re
 import sys
 from typing import List
 
@@ -86,6 +87,7 @@ EVENT_FIELDS = {
     "note": (),
     "exit": ("status",),
     "crash": ("reason",),
+    "telemetry_server": ("host", "port", "outcome"),
 }
 HEALTH_KINDS = {"non_finite", "loss_spike", "divergence", "hang",
                 "watchdog_started"}
@@ -120,6 +122,14 @@ DATA_SERVICE_ROLES = {"server", "client"}
 # tests/test_excache.py): why a present cache entry was refused
 EXCACHE_INVALID_REASONS = {"version_skew", "topology_skew", "corrupt",
                            "deserialize_failed"}
+# live telemetry plane (obs/telemetry.py TELEMETRY_OUTCOMES, kept in
+# sync by tests/test_telemetry.py)
+TELEMETRY_SERVER_OUTCOMES = {"started", "stopped", "failed"}
+# cross-process trace context (obs/propagate.py): W3C-traceparent-shaped
+# ids stamped onto journal events written under an installed context —
+# any event may carry them, so the hex-shape check applies everywhere
+TRACE_ID_RE = re.compile(r"^[0-9a-f]{32}$")
+SPAN_ID_RE = re.compile(r"^[0-9a-f]{16}$")
 
 
 def check_journal(path: str, require_exit: bool = False,
@@ -270,6 +280,29 @@ def check_journal(path: str, require_exit: bool = False,
                     row.get("reason") not in EXCACHE_INVALID_REASONS:
                 errors.append(f"{path}:{i}: unknown excache_invalid reason "
                               f"{row.get('reason')!r}")
+        if ev == "telemetry_server":
+            if row.get("outcome") not in TELEMETRY_SERVER_OUTCOMES:
+                errors.append(f"{path}:{i}: unknown telemetry_server "
+                              f"outcome {row.get('outcome')!r}")
+            if not isinstance(row.get("port"), int):
+                errors.append(f"{path}:{i}: telemetry_server port must be "
+                              f"an int, got {row.get('port')!r}")
+        # trace context rides ANY event written under an installed
+        # context (obs/journal.py stamps it); when present the ids must
+        # be W3C-shaped or obs/merge.py's timelines silently fragment
+        if "trace_id" in row or "span_id" in row:
+            tid, sid = row.get("trace_id"), row.get("span_id")
+            if not (isinstance(tid, str) and TRACE_ID_RE.match(tid)):
+                errors.append(f"{path}:{i}: trace_id must be 32 lowercase "
+                              f"hex chars, got {tid!r}")
+            if not (isinstance(sid, str) and SPAN_ID_RE.match(sid)):
+                errors.append(f"{path}:{i}: span_id must be 16 lowercase "
+                              f"hex chars, got {sid!r}")
+            psid = row.get("parent_span_id")
+            if psid is not None and not (isinstance(psid, str)
+                                         and SPAN_ID_RE.match(psid)):
+                errors.append(f"{path}:{i}: parent_span_id must be 16 "
+                              f"lowercase hex chars, got {psid!r}")
         if ev == "quant_calibrated":
             if not isinstance(row.get("accepted"), bool):
                 errors.append(f"{path}:{i}: quant_calibrated accepted must "

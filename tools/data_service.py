@@ -61,6 +61,11 @@ def main(argv=None) -> int:
                    help="typed data_worker_lost/recovered + data_service "
                         "events (tools/check_journal.py --strict validates)")
     p.add_argument("--worker-restarts", type=int, default=2)
+    p.add_argument("--telemetry-port", type=int, default=None, metavar="PORT",
+                   help="serve live /metrics /healthz /statusz over HTTP "
+                        "(0 = auto-assign; discovery file lands next to the "
+                        "journal, or the cwd without one). DVT_TELEMETRY=PORT "
+                        "is the env equivalent (obs/telemetry.py)")
     args = p.parse_args(argv)
 
     from deep_vision_tpu.data.datasets import RecordDataset
@@ -94,6 +99,38 @@ def main(argv=None) -> int:
     ).start()
     print(f"ready {svc.address}", flush=True)
 
+    tele_port = args.telemetry_port
+    if tele_port is None:
+        env = os.environ.get("DVT_TELEMETRY", "").strip()
+        if env:
+            try:
+                tele_port = int(env)
+            except ValueError:
+                print(f"warning: DVT_TELEMETRY={env!r} is not a port; "
+                      "telemetry disabled", file=sys.stderr)
+    telemetry = None
+    if tele_port is not None:
+        from deep_vision_tpu.obs.registry import get_registry
+        from deep_vision_tpu.obs.telemetry import TelemetryServer
+
+        disc_dir = (os.path.dirname(os.path.abspath(args.journal))
+                    if args.journal else os.getcwd())
+        telemetry = TelemetryServer(
+            port=tele_port, role="data_service", registry=get_registry(),
+            journal=journal, discovery_dir=disc_dir)
+        try:
+            telemetry.start()
+        except OSError as e:
+            print(f"warning: telemetry server failed to bind port "
+                  f"{tele_port} ({e}); continuing without live endpoints",
+                  file=sys.stderr)
+            telemetry = None
+        else:
+            telemetry.add_health("data_service", svc.healthz)
+            telemetry.add_status("data_service", svc.telemetry_status)
+            print(f"telemetry http://{telemetry.address}/statusz",
+                  flush=True)
+
     stop = threading.Event()
 
     def _on_signal(signum, frame):
@@ -103,6 +140,8 @@ def main(argv=None) -> int:
     signal.signal(signal.SIGINT, _on_signal)
     stop.wait()
     print("data_service: draining", flush=True)
+    if telemetry is not None:
+        telemetry.close()  # stop answering scrapes before draining state
     svc.close()
     if journal is not None:
         journal.close()

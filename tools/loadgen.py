@@ -110,6 +110,11 @@ class LoadGen:
         rng = np.random.RandomState(self.seed)
         inter = (1.0 / self.rps) if self.rps else 0.0
         futs = []
+        # completion stamped by done-callback (dispatcher thread), not by
+        # the sequential result() collection below — otherwise client
+        # latency would include collection-loop queueing and the /varz
+        # cross-check would read pure fiction
+        done_at: dict = {}
         shed = refused = 0
         t0 = time.perf_counter()
         for i in range(self.n_requests):
@@ -122,18 +127,24 @@ class LoadGen:
             image = rng.rand(*IMG).astype(np.float32)
             t_sub = time.perf_counter()
             try:
-                futs.append((t_sub, self.submit(model, image)))
+                fut = self.submit(model, image)
             except ShedError:
                 shed += 1
+                continue
             except Exception:
                 refused += 1
+                continue
+            fut.add_done_callback(
+                lambda f, i=i: done_at.__setitem__(i, time.perf_counter()))
+            futs.append((i, t_sub, fut))
         ok_lat: List[float] = []
         errors = 0
         deadline = time.perf_counter() + self.timeout_s
-        for t_sub, fut in futs:
+        for i, t_sub, fut in futs:
             try:
                 fut.result(timeout=max(0.1, deadline - time.perf_counter()))
-                ok_lat.append((time.perf_counter() - t_sub) * 1e3)
+                t_done = done_at.get(i, time.perf_counter())
+                ok_lat.append((t_done - t_sub) * 1e3)
             except Exception:
                 errors += 1
         wall_s = time.perf_counter() - t0
@@ -154,6 +165,50 @@ class LoadGen:
             "p95_ms": round(pct(0.95), 3),
             "p99_ms": round(pct(0.99), 3),
         }
+
+
+def crosscheck_varz(stats: dict, host: str, port: int, models,
+                    tol_ratio: float = 4.0, tol_abs_ms: float = 100.0) -> dict:
+    """Client-observed latency percentiles vs the server's /varz SLO
+    histograms (serve/slo.py `serve_request_latency_ms{model=}`).
+
+    Both sides time nearly the same span (submit -> result), but the
+    server's quantiles are bucket-resolution on a log scale (~2.2x per
+    bucket at 3/decade), so the tolerance is a ratio band around the
+    per-model min/max plus an absolute floor. Skew beyond it prints a
+    LOUD warning and lands in the returned dict — reported, not fatal:
+    it means one side's clock or histogram is lying, which is exactly
+    what an operator should go investigate.
+    """
+    import urllib.request
+
+    with urllib.request.urlopen(f"http://{host}:{port}/varz",
+                                timeout=5) as resp:
+        varz = json.loads(resp.read().decode("utf-8"))
+    out = {"checked": [], "skewed": []}
+    for q in ("p50", "p99"):
+        client = float(stats.get(f"{q}_ms") or 0.0)
+        server_vals = {}
+        for model in models:
+            snap = varz.get('serve_request_latency_ms{model="%s"}' % model)
+            if isinstance(snap, dict) and snap.get(q) is not None:
+                server_vals[model] = float(snap[q])
+        if not server_vals or client <= 0:
+            continue
+        lo = min(server_vals.values())
+        hi = max(server_vals.values())
+        entry = {"q": q, "client_ms": client,
+                 "server_ms": {m: round(v, 3)
+                               for m, v in server_vals.items()}}
+        out["checked"].append(entry)
+        if not (lo / tol_ratio - tol_abs_ms <= client
+                <= hi * tol_ratio + tol_abs_ms):
+            out["skewed"].append(entry)
+            print(f"  WARNING: client {q} {client:.1f}ms outside the "
+                  f"server band [{lo:.1f}, {hi:.1f}]ms x{tol_ratio:g} "
+                  f"+/-{tol_abs_ms:g}ms — clock or histogram skew "
+                  f"(server {entry['server_ms']})", flush=True)
+    return out
 
 
 # -- the fleet-smoke scenario -------------------------------------------------
@@ -239,6 +294,16 @@ def main(argv: Optional[List[str]] = None) -> int:
     # phase 6 asserts zero lock-order violations across all of it
     locksmith.arm(journal=journal)
     registry = Registry()
+    # live telemetry plane (obs/telemetry.py): the fleet's /metrics +
+    # /healthz + /statusz, scraped under load below and cross-checked
+    # against the client-observed percentiles after the death episode
+    from deep_vision_tpu.obs.telemetry import TelemetryServer, \
+        validate_prometheus
+
+    tele = TelemetryServer(port=0, role="serve", registry=registry,
+                           journal=journal, flight=flight,
+                           discovery_dir=work)
+    tele.start()
 
     # persistent executable cache (core/excache.py): replica 0 compiles
     # and stores, every later warmup — including the FRESH-ENGINE respawn
@@ -265,7 +330,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     pool = ReplicaPool(build_engine, replicas=args.replicas,
                        journal=journal, registry=registry,
                        max_wait_ms=4.0, slo_ms=SLO_MS,
-                       respawn_fresh=True)
+                       respawn_fresh=True, telemetry=tele)
     pool.start()
     pairs = args.replicas * 2 * len(BUCKETS)
     f.check(pool.warmup_stats["pairs"] == pairs,
@@ -338,6 +403,29 @@ def main(argv: Optional[List[str]] = None) -> int:
             f"{fresh_notes[0].get('cache_hits') if fresh_notes else '?'}"
             f"/{fresh_notes[0].get('pairs') if fresh_notes else '?'} "
             "pairs cache-hit)")
+    # the telemetry plane under a fleet that just lost + respawned a
+    # replica: /healthz answers 200 (the respawned _ReplicaServer
+    # re-registered its health source by name), /metrics parses, and
+    # the client-observed percentiles agree with the server's /varz
+    # SLO histograms within bucket-resolution tolerance
+    import urllib.request as _url
+
+    with _url.urlopen(f"http://{tele.address}/healthz", timeout=5) as r:
+        hz = json.loads(r.read().decode("utf-8"))
+    f.check(r.status == 200 and hz.get("ok") is True,
+            "/healthz answers 200 with the fleet at full strength "
+            "(respawned replica re-registered its health source)")
+    with _url.urlopen(f"http://{tele.address}/metrics", timeout=5) as r:
+        metrics_text = r.read().decode("utf-8")
+    prom_problems = validate_prometheus(metrics_text)
+    f.check(not prom_problems,
+            "live /metrics parses as Prometheus text exposition"
+            + ("" if not prom_problems else f" ({prom_problems[0]})"))
+    xc = crosscheck_varz(stats, tele.host, tele.port, ["toy", "aux"])
+    f.check(len(xc["checked"]) == 2,
+            "client p50+p99 cross-checked against /varz "
+            "serve_request_latency_ms histograms "
+            f"({len(xc['skewed'])} skew warning(s))")
 
     # -- phase 3: canary swap, auto-promote -----------------------------
     print("phase 3: canary weight swap promotes under live traffic")
@@ -427,6 +515,22 @@ def main(argv: Optional[List[str]] = None) -> int:
             + ("" if not lock_report["violations"]
                else f" ({lock_report['violations'][0]})"))
     locksmith.disarm()
+    # a drained fleet must read UNHEALTHY: /healthz flips to 503, and the
+    # discovery file vanishes with the server (tools/obs_poll.py's
+    # liveness contract)
+    try:
+        with _url.urlopen(f"http://{tele.address}/healthz", timeout=5):
+            drained_status = 200
+    except _url.HTTPError as e:
+        drained_status = e.code
+    f.check(drained_status == 503,
+            f"/healthz flips to 503 once the fleet drains "
+            f"(got {drained_status})")
+    f.check(any(p.startswith("telemetry-") for p in os.listdir(work)),
+            "discovery file present while the telemetry server lives")
+    tele.close()
+    f.check(not any(p.startswith("telemetry-") for p in os.listdir(work)),
+            "discovery file removed on telemetry close")
     mgr.close()
     tracer.close()
     set_tracer(None)

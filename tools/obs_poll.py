@@ -1,0 +1,135 @@
+"""Poll the live telemetry plane: one status line per process.
+
+    PYTHONPATH=. python tools/obs_poll.py --run-dir checkpoints/lenet5
+    PYTHONPATH=. python tools/obs_poll.py --run-dir ckpts --watch 2
+
+Each process that serves telemetry (train.py --telemetry-port,
+tools/data_service.py --telemetry-port, serve-side TelemetryServer)
+drops a `telemetry-<role>-<pid>.json` discovery file under its run dir;
+this tool reads those files (obs/telemetry.py read_discovery), hits
+each process's /statusz + /healthz, and renders one line per process:
+
+    train       pid 4242 @ 127.0.0.1:35411  OK      step 1840  ep 3  412.3 ex/s
+    data_service pid 4250 @ 127.0.0.1:35500 OK      served 9211
+    serve       pid 4260 @ 127.0.0.1:35600  UNHEALTHY(draining)  gen 2
+
+A process whose endpoint no longer answers renders as GONE — a stale
+discovery file from a crashed process, the poll's liveness signal.
+
+`--once` (default) prints a single snapshot and exits 0 if every
+discovered process is healthy, 1 otherwise (the scriptable form the
+live smoke uses). `--watch SECONDS` loops forever.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+import urllib.error
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def fetch_json(host: str, port: int, path: str, timeout: float = 3.0):
+    """GET http://host:port/path, parsed JSON — None on any failure."""
+    url = f"http://{host}:{port}{path}"
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            return json.loads(resp.read().decode("utf-8"))
+    except (OSError, urllib.error.URLError, ValueError):
+        return None
+
+
+def _healthz(host: str, port: int, timeout: float = 3.0):
+    """(ok, body) from /healthz — a 503 still carries the JSON verdict."""
+    url = f"http://{host}:{port}/healthz"
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            return True, json.loads(resp.read().decode("utf-8"))
+    except urllib.error.HTTPError as e:
+        try:
+            return False, json.loads(e.read().decode("utf-8"))
+        except (OSError, ValueError):
+            return False, None
+    except (OSError, urllib.error.URLError, ValueError):
+        return None, None
+
+
+def _unhealthy_names(body) -> str:
+    if not isinstance(body, dict):
+        return ""
+    bad = [name for name, chk in (body.get("checks") or {}).items()
+           if not chk.get("ok", False)]
+    return ",".join(sorted(bad))
+
+
+def format_line(rec: dict, status: dict, ok, health) -> str:
+    """One line: role pid@host:port verdict + role-specific vitals."""
+    role = str(rec.get("role", "?"))
+    where = f"pid {rec.get('pid', '?')} @ {rec['host']}:{rec['port']}"
+    if ok is None:
+        return f"{role:<13}{where:<28} GONE"
+    verdict = "OK" if ok else f"UNHEALTHY({_unhealthy_names(health)})"
+    vitals = []
+    for name, src in (status or {}).get("status", {}).items():
+        if not isinstance(src, dict):
+            continue
+        if src.get("step") is not None:
+            vitals.append(f"step {src['step']}")
+        if src.get("epoch") is not None:
+            vitals.append(f"ep {src['epoch']}")
+        if src.get("examples_per_sec") is not None:
+            vitals.append(f"{src['examples_per_sec']:.1f} ex/s")
+        if src.get("generation") is not None:
+            vitals.append(f"gen {src['generation']}")
+        if src.get("served") is not None:
+            vitals.append(f"served {src['served']}")
+        if src.get("done") is not None:
+            vitals.append(f"done {src['done']}")
+    return f"{role:<13}{where:<28} {verdict:<10} " + "  ".join(vitals)
+
+
+def poll_once(run_dir: str, timeout: float = 3.0):
+    """(lines, all_ok) for every discovery file under run_dir."""
+    from deep_vision_tpu.obs.telemetry import read_discovery
+
+    lines, all_ok = [], True
+    recs = read_discovery(run_dir)
+    if not recs:
+        return [f"no telemetry discovery files under {run_dir}"], False
+    for rec in recs:
+        host, port = rec["host"], rec["port"]
+        ok, health = _healthz(host, port, timeout=timeout)
+        status = fetch_json(host, port, "/statusz", timeout=timeout)
+        lines.append(format_line(rec, status, ok, health))
+        if ok is not True:
+            all_ok = False
+    return lines, all_ok
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--run-dir", required=True,
+                   help="directory holding telemetry-*.json discovery files "
+                        "(the run's checkpoint dir)")
+    p.add_argument("--watch", type=float, default=None, metavar="SECONDS",
+                   help="refresh every SECONDS instead of one snapshot")
+    p.add_argument("--timeout", type=float, default=3.0,
+                   help="per-endpoint HTTP timeout")
+    args = p.parse_args(argv)
+
+    while True:
+        lines, all_ok = poll_once(args.run_dir, timeout=args.timeout)
+        for line in lines:
+            print(line)
+        if args.watch is None:
+            return 0 if all_ok else 1
+        print("--", flush=True)
+        time.sleep(args.watch)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

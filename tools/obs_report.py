@@ -770,7 +770,10 @@ def render_merged(events: List[dict]) -> str:
             header = e
         elif e.get("event") == "straggler":
             stragglers.append(e)
-        elif "host" in e:
+        elif isinstance(e.get("host"), int):
+            # integer hosts are obs_merge host INDICES; telemetry_server
+            # events carry a bind address string in the same field and
+            # belong to no host lane
             hosts.setdefault(int(e["host"]), []).append(e)
     lines = ["== merged multi-host timeline =="]
     if header:
@@ -802,6 +805,34 @@ def render_merged(events: List[dict]) -> str:
             lines.append(f"... {len(stragglers) - 16} more")
     else:
         lines.append("no stragglers detected")
+    # cross-PROCESS request timelines (obs/merge.py trace_timelines):
+    # one request's hops — stamped by obs/propagate.py trace context —
+    # stitched across journals into a single causal sequence
+    from deep_vision_tpu.obs.merge import trace_timelines
+
+    timelines = trace_timelines(events)
+    if timelines:
+        lines.append(f"-- request timelines ({len(timelines)}) --")
+        for tl in timelines[:8]:
+            lines.append(
+                f"trace {tl['trace_id']}  {len(tl['hops'])} hop(s), "
+                f"{tl['spans']} span(s) across "
+                f"{len(tl['processes'])} process(es)  "
+                f"{tl['duration_ms']:.1f} ms")
+            t0 = tl["hops"][0].get("ts") or 0.0
+            for hop in tl["hops"][:12]:
+                bits = [hop.get("event", "?")]
+                for k in ("role", "service", "model", "outcome", "note"):
+                    if hop.get(k) is not None:
+                        bits.append(f"{k}={hop[k]}")
+                if hop.get("run_id"):
+                    bits.append(f"run {hop['run_id']}")
+                dt = ((hop.get("ts") or t0) - t0) * 1e3
+                lines.append(f"  +{dt:8.1f} ms  " + "  ".join(bits))
+            if len(tl["hops"]) > 12:
+                lines.append(f"  ... {len(tl['hops']) - 12} more hops")
+        if len(timelines) > 8:
+            lines.append(f"... {len(timelines) - 8} more traces")
     return "\n".join(lines)
 
 
