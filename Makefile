@@ -51,7 +51,7 @@ lint-baseline:
 # obs-smoke and chaos-smoke — the telemetry artifacts must validate and
 # the resilience contracts must hold before the tests count
 verify: SHELL := /bin/bash
-verify: lint preflight perf-smoke obs-smoke chaos-smoke data-smoke host-smoke serve-smoke fleet-smoke cache-smoke shard-smoke live-smoke
+verify: lint preflight perf-smoke obs-smoke chaos-smoke data-smoke host-smoke serve-smoke fleet-smoke cache-smoke shard-smoke perf-gate live-smoke
 	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
 
 # environment preflight: backend liveness + libtpu/client version
@@ -134,6 +134,16 @@ cache-smoke:
 # sharding section
 shard-smoke:
 	JAX_PLATFORMS=cpu python tools/shard_smoke.py --workdir artifacts/shard_smoke
+
+# perf-attribution smoke: two seeded CPU bench runs build the crc-
+# manifested ledger, a third run slowed through the fault-injection
+# plane must FAIL the noise-aware MAD gate (CLI exits nonzero, typed
+# perf_regression journaled, failed row excluded from future
+# baselines), --bless re-anchors, corrupt ledger rows quarantine, and
+# the sharded ViT step's parsed all-reduce inventory must match its
+# gradient-tree bytes within 5% (tools/perf_gate.py --smoke)
+perf-gate:
+	JAX_PLATFORMS=cpu python tools/perf_gate.py --smoke --workdir artifacts/perf_gate
 
 # live-telemetry smoke: a REAL train.py subprocess is scraped MID-RUN
 # through its discovery file (/metrics parses as Prometheus, /healthz
@@ -237,4 +247,4 @@ ps:
 native:
 	$(MAKE) -C native
 
-.PHONY: train resume train-fg test lint lint-baseline verify preflight obs-smoke chaos-smoke data-smoke host-smoke serve-smoke fleet-smoke cache-smoke shard-smoke live-smoke perf-smoke bench bench-evidence roofline demo demo-gan demo-real dryrun tb ps native
+.PHONY: train resume train-fg test lint lint-baseline verify preflight obs-smoke chaos-smoke data-smoke host-smoke serve-smoke fleet-smoke cache-smoke shard-smoke perf-gate live-smoke perf-smoke bench bench-evidence roofline demo demo-gan demo-real dryrun tb ps native

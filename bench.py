@@ -132,6 +132,17 @@ def _emit(result: dict) -> bool:
         if _EMITTED:
             return False
         _EMITTED = True
+        # stamp the environment fingerprint the perf ledger keys on
+        # (tools/perf_gate.py): a number without its environment is not
+        # comparable, and the stamp must ride the SAME line the driver
+        # captures — best-effort, a bench must never die to bookkeeping
+        try:
+            from tools.perf_gate import default_env, env_key
+
+            result.setdefault("env", default_env())
+            result.setdefault("env_key", env_key(result["env"]))
+        except Exception:
+            pass
         # print under the lock: if the winner released first and was then
         # descheduled before printing, the loser's path could reach
         # _hard_exit and kill the process with ZERO lines emitted

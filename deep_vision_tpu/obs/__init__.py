@@ -34,6 +34,18 @@ records the reference's instrumentation as one examples/sec print):
   request/batch ingress, carried over the data-service frame protocol
   and the serve request path, auto-stamped onto journal events and
   trace spans (`TraceContext`, `new_trace`, `use`, `current`).
+- `costmodel`: compiled-artifact introspection — XLA cost/memory
+  analysis plus the collective inventory parsed from compiled HLO
+  (`cost_summary`, `collective_inventory`, `tree_bytes`) — the
+  predicted flop/byte/comm bill of every jit pair.
+- `perfwatch`: the performance-attribution hook — profiles compiled
+  executables where a build already happened (Engine.warmup, the
+  Trainer's cached steps) into typed `perf_profile`/`perf_collective`
+  events and registry gauges, and feeds the `/statusz` perf section
+  (step-time quantiles, last perf-gate verdict, last trace digest);
+  ledger + regression gate in tools/perf_gate.py, step-time
+  decomposition in tools/trace_digest.py (`profile_compiled`,
+  `telemetry_status`).
 - `locksmith`: opt-in runtime lock-order sanitizer — named lock/condition
   wrappers adopted by serve/ and obs/, order-inversion + hold-time-outlier
   detection journaled as `lock_order_violation`/`lock_contention` events;

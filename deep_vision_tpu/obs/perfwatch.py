@@ -1,0 +1,193 @@
+"""Compiled-artifact performance attribution: typed perf events + the
+live perf status source.
+
+PR 16's telemetry plane answers *is it healthy*; this module answers
+*is it fast, and why not*. Wherever the repo already holds a compiled
+executable — `Engine.warmup`'s bucket menu, the Trainer's excache/AOT
+path, an explicit `Trainer.profile_step` — `profile_compiled` distills
+it through obs/costmodel into two typed journal events:
+
+  perf_profile     one per (name) jit pair: XLA cost analysis (flops,
+                   bytes accessed, buffer budget) + the collective
+                   roll-up (op count, total per-device payload bytes)
+  perf_collective  one per (kind, dtype) aggregate: op count, summed
+                   bytes, group size — the partitioner's comm bill,
+                   itemized
+
+Both are additive observation: every extraction failure degrades to
+None/absence, never to a raised exception, so a backend that hides HLO
+text costs fields, not warmups.
+
+The module also keeps the process-wide "last known perf state" the
+telemetry /statusz page serves (`telemetry_status`): rolling step-time
+p50/p95 fed by the Trainer's StepClock histogram, the process recompile
+count, the last profile, and the last perf-gate verdict / trace digest
+(`note_gate` / `note_digest`, set by tools/perf_gate.py and
+tools/trace_digest.py when they run in-process). A live watcher sees
+perf drift without waiting for the postmortem report.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable, List, Optional
+
+from deep_vision_tpu.obs import costmodel
+
+__all__ = [
+    "profile_compiled",
+    "telemetry_status",
+    "note_gate",
+    "note_digest",
+    "set_quantile_source",
+]
+
+# last-known perf state for /statusz; one lock, plain dicts only (the
+# scraper thread must never touch the device)
+from deep_vision_tpu.obs import locksmith
+
+_state_lock = locksmith.lock("obs.perfwatch")
+_LAST = {
+    "profile": None,   # {"name", "flops", "collective_bytes", ...}
+    "gate": None,      # {"verdict", "metric", ...} from tools/perf_gate
+    "digest": None,    # {"top_op", "collective_frac", ...} from trace_digest
+}
+_QUANTILES: Optional[Callable[[], dict]] = None
+
+
+def profile_compiled(name: str, compiled, journal=None, registry=None,
+                     extra: Optional[dict] = None) -> Optional[dict]:
+    """Extract + journal the perf profile of one compiled executable.
+
+    Returns {"name", "cost": {...}, "collectives": [op dicts],
+    "collective_bytes", "allreduce_bytes"}, or None when nothing could
+    be extracted. Never raises.
+    """
+    try:
+        cost = costmodel.cost_summary(compiled)
+        hlo = costmodel.hlo_text(compiled)
+        inventory = costmodel.collective_inventory(hlo) if hlo else []
+        total_bytes = costmodel.predicted_collective_bytes(inventory)
+        ar_bytes = costmodel.predicted_collective_bytes(inventory,
+                                                        "all-reduce")
+        profile = {
+            "name": name,
+            "cost": cost,
+            "collectives": inventory,
+            "collective_bytes": int(total_bytes),
+            "allreduce_bytes": int(ar_bytes),
+        }
+        if journal is not None:
+            fields = {
+                "name": name,
+                "flops": cost["flops"],
+                "bytes_accessed": cost["bytes_accessed"],
+                "argument_bytes": cost["argument_bytes"],
+                "output_bytes": cost["output_bytes"],
+                "temp_bytes": cost["temp_bytes"],
+                "collective_count": len(inventory),
+                "collective_bytes": int(total_bytes),
+            }
+            if extra:
+                fields.update(extra)
+            journal.write("perf_profile", **fields)
+            for agg in _aggregate(inventory):
+                journal.write("perf_collective", name=name, **agg)
+        if registry is not None:
+            try:
+                registry.gauge("perfwatch_collective_bytes",
+                               "per-device collective payload bytes of the "
+                               "last profiled executable",
+                               labels={"name": name}).set(total_bytes)
+                if cost["flops"] is not None:
+                    registry.gauge("perfwatch_flops",
+                                   "XLA-estimated flops of the last "
+                                   "profiled executable",
+                                   labels={"name": name}).set(cost["flops"])
+                registry.counter("perfwatch_profiles_total",
+                                 "compiled executables profiled").inc()
+            except Exception:
+                pass
+        with _state_lock:
+            _LAST["profile"] = {
+                "name": name,
+                "flops": cost["flops"],
+                "collective_count": len(inventory),
+                "collective_bytes": int(total_bytes),
+            }
+        return profile
+    except Exception:
+        return None
+
+
+def _aggregate(inventory: List[dict]) -> List[dict]:
+    """Per-(kind, dtype) roll-up of an op-level inventory — the
+    perf_collective event payloads."""
+    by_key: dict = {}
+    for c in inventory:
+        key = (c["kind"], c.get("dtype") or "unknown")
+        agg = by_key.setdefault(key, {
+            "kind": c["kind"], "dtype": key[1], "ops": 0, "bytes": 0,
+            "group_size": c.get("group_size"),
+        })
+        agg["ops"] += 1
+        agg["bytes"] += int(c["bytes"])
+        if agg["group_size"] is None:
+            agg["group_size"] = c.get("group_size")
+    return [by_key[k] for k in sorted(by_key)]
+
+
+# -- /statusz state ----------------------------------------------------------
+
+
+def note_gate(verdict: dict) -> None:
+    """Record the latest perf-gate verdict for /statusz (called by
+    tools/perf_gate.py after every gate decision)."""
+    with _state_lock:
+        _LAST["gate"] = dict(verdict)
+
+
+def note_digest(summary: dict) -> None:
+    """Record the latest trace-digest summary for /statusz (called by
+    tools/trace_digest.py when it runs in-process)."""
+    with _state_lock:
+        _LAST["digest"] = dict(summary)
+
+
+def set_quantile_source(fn: Optional[Callable[[], dict]]) -> None:
+    """Install the rolling step-time quantile provider (the Trainer wires
+    its StepClock histogram here; the scraper thread then reads plain
+    host-side numbers)."""
+    global _QUANTILES
+    _QUANTILES = fn
+
+
+def telemetry_status() -> dict:
+    """The /statusz "perf" status source: rolling step-time p50/p95,
+    process recompile count, last profile / gate verdict / digest."""
+    out: dict = {}
+    fn = _QUANTILES
+    if fn is not None:
+        try:
+            out.update(fn())
+        except Exception:
+            pass
+    try:
+        from deep_vision_tpu.obs.stepclock import recompile_count
+
+        out["recompiles"] = recompile_count()
+    except Exception:
+        pass
+    with _state_lock:
+        if _LAST["profile"] is not None:
+            out["last_profile"] = dict(_LAST["profile"])
+        if _LAST["gate"] is not None:
+            out["gate"] = dict(_LAST["gate"])
+        if _LAST["digest"] is not None:
+            out["digest"] = dict(_LAST["digest"])
+    return out
+
+
+def _reset_for_tests() -> None:
+    with _state_lock:
+        _LAST["profile"] = _LAST["gate"] = _LAST["digest"] = None
+    set_quantile_source(None)

@@ -29,6 +29,7 @@ from typing import Dict, Sequence, Tuple
 import jax
 import numpy as np
 
+from deep_vision_tpu.obs import perfwatch
 from deep_vision_tpu.obs.trace import span
 from deep_vision_tpu.serve.buckets import DEFAULT_BUCKETS, normalize_buckets
 
@@ -79,6 +80,7 @@ class Engine:
             from deep_vision_tpu.obs.registry import get_registry
 
             registry = get_registry()
+        self._registry = registry
         self._g_warmed = registry.gauge(
             "serve_warmed_buckets", "(model, bucket) executables compiled")
 
@@ -162,6 +164,15 @@ class Engine:
                 self._compiled[(entry.name, bucket)] = compiled
                 pairs.append({"model": entry.name, "bucket": bucket,
                               "compile_ms": round(ms, 1), "source": source})
+                # perf attribution (obs/perfwatch): the warmup loop is the
+                # one place the serving path holds a compiled executable,
+                # so its XLA cost + collective inventory are journaled
+                # here (typed perf_profile/perf_collective); extraction
+                # failures cost fields, never the warmup
+                perfwatch.profile_compiled(
+                    f"serve/{entry.name}/b{bucket}", compiled,
+                    journal=self.journal, registry=self._registry,
+                    extra={"source": source})
         self._warmed = True
         self._g_warmed.set(len(self._compiled))
         stats = {
@@ -242,6 +253,7 @@ class Engine:
         clone._compiled = self._compiled  # shared, read-only on this path
         clone._warmed = True
         clone._g_warmed = self._g_warmed
+        clone._registry = self._registry
         clone._entries = {}
         for name, entry in self._entries.items():
             variables = variables_by_model.get(name, entry.variables)

@@ -82,8 +82,34 @@ def _build_step(devices, batch_per_device: int, rules):
         return (state.apply_gradients(grads).replace(batch_stats=new_bs),
                 loss)
 
-    step = jax.jit(train_step, donate_argnums=0)
+    # AOT-compile instead of dispatch-compiling: the compiled artifact is
+    # ALSO the evidence — its HLO names every collective the partitioner
+    # inserted for this sub-mesh, which is the predicted comm bill each
+    # scaling row carries next to its measured step time (obs/costmodel)
+    import warnings
+
+    with warnings.catch_warnings():
+        # CPU has no donation support and warns once per lowering
+        warnings.filterwarnings("ignore", message="Some donated buffers")
+        step = jax.jit(train_step, donate_argnums=0).lower(
+            state, batch).compile()
     return step, state, batch, batch_size
+
+
+def _comm_profile(compiled, state) -> dict:
+    """Predicted per-device comm bytes of one compiled scaling step, plus
+    the gradient-tree size the all-reduce bytes are checked against."""
+    from deep_vision_tpu.obs import costmodel
+
+    hlo = costmodel.hlo_text(compiled)
+    inv = costmodel.collective_inventory(hlo) if hlo else []
+    return {
+        "collective_ops": len(inv),
+        "predicted_comm_bytes": costmodel.predicted_collective_bytes(inv),
+        "predicted_allreduce_bytes": costmodel.predicted_collective_bytes(
+            inv, "all-reduce"),
+        "grad_tree_bytes": costmodel.tree_bytes(state.params),
+    }
 
 
 def measure_scaling(
@@ -118,9 +144,11 @@ def measure_scaling(
     sizes = [d for d in sub_sizes if d <= len(devices)]
     rows = []
     base_per_device = None
+    base_wall_ms = None
     for d in sizes:
         step, state, batch, batch_size = _build_step(
             list(devices[:d]), batch_per_device, rules)
+        comm = _comm_profile(step, state)
         for _ in range(warmup):
             state, loss = step(state, batch)
         float(loss)  # close warmup: a scalar fetch cannot return early
@@ -131,16 +159,24 @@ def measure_scaling(
         dt = time.perf_counter() - t0
         ex_s = batch_size * steps / dt
         per_dev = ex_s / d
+        wall_ms = dt / steps * 1e3
         if base_per_device is None:
             base_per_device = per_dev
-        rows.append({
+            base_wall_ms = wall_ms
+        row = {
             "data": int(d),
             "batch": int(batch_size),
-            "wall_ms_per_step": round(dt / steps * 1e3, 3),
+            "wall_ms_per_step": round(wall_ms, 3),
             "examples_per_sec": round(ex_s, 1),
             "per_device_examples_per_sec": round(per_dev, 1),
             "efficiency": round(per_dev / base_per_device, 4),
-        })
+            # predicted comm bill (compiled HLO) next to what it cost in
+            # wall time vs the 1-device baseline: the gap ROADMAP item 2's
+            # comm/compute overlap work has to close
+            "step_time_delta_ms": round(wall_ms - base_wall_ms, 3),
+        }
+        row.update(comm)
+        rows.append(row)
     return rows
 
 
@@ -168,9 +204,13 @@ def format_rows(rows: list) -> str:
     """Human lines for the dryrun tail / smoke stdout."""
     out = []
     for r in rows:
-        out.append(
+        line = (
             f"multichip_scaling: data={r['data']} "
             f"examples_per_sec={r['examples_per_sec']} "
             f"per_device={r['per_device_examples_per_sec']} "
             f"efficiency={r['efficiency']:.3f}")
+        if r.get("predicted_comm_bytes") is not None:
+            line += (f" comm_bytes={r['predicted_comm_bytes']} "
+                     f"dt_ms={r.get('step_time_delta_ms', 0)}")
+        out.append(line)
     return "\n".join(out)

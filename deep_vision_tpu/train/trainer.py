@@ -29,6 +29,7 @@ import optax
 from deep_vision_tpu.core.metrics import MetricLogger
 from deep_vision_tpu.core.train_state import TrainState, create_train_state
 from deep_vision_tpu.data.device_prefetch import DevicePrefetcher, PlacedBatch
+from deep_vision_tpu.obs import perfwatch
 from deep_vision_tpu.obs.stepclock import StepClock
 from deep_vision_tpu.obs.trace import span
 from deep_vision_tpu.parallel.mesh import (
@@ -364,6 +365,12 @@ class Trainer:
         self.telemetry = telemetry
         if telemetry is not None:
             telemetry.add_status("train", self._telemetry_status)
+            # the perf plane's live face (obs/perfwatch): rolling
+            # step-time quantiles off this trainer's StepClock histogram
+            # (host-side bucket math, no device fetch), recompile count,
+            # last perf-gate verdict / trace digest
+            perfwatch.set_quantile_source(self._step_time_quantiles)
+            telemetry.add_status("perf", perfwatch.telemetry_status)
             if self.health is not None:
                 telemetry.add_health("train", self.health.healthz)
             if self.hosts is not None:
@@ -384,6 +391,21 @@ class Trainer:
         if self.hosts is not None:
             out["generation"] = getattr(self.hosts.rdzv, "generation", None)
         return out
+
+    def _step_time_quantiles(self) -> dict:
+        """Rolling step-time p50/p95 for the /statusz perf source —
+        bucket-resolution estimates from the StepClock histogram, so the
+        scraper thread reads plain host numbers (None until steps land)."""
+        h = self.clock._h_step
+        if not h.count:
+            return {}
+        import math
+
+        def finite(v):
+            return round(v, 3) if math.isfinite(v) else None
+
+        return {"step_time_ms_p50": finite(h.quantile(0.5)),
+                "step_time_ms_p95": finite(h.quantile(0.95))}
 
     def _rendezvous_health(self):
         """Telemetry health source: this host's OWN lease freshness — a
@@ -429,6 +451,7 @@ class Trainer:
         if self._state_shardings is not None:
             state_pin = dict(in_shardings=(self._state_shardings, None),
                              out_shardings=(self._state_shardings, None))
+        self._state_pin = state_pin  # reused by profile_step's AOT lowering
         if self._checkify:
             from jax.experimental import checkify
 
@@ -473,6 +496,37 @@ class Trainer:
                                                   **state_pin)
         self._aot_steps: dict = {}
 
+    def profile_step(self, batch, kind: str = "train"):
+        """Journal the XLA cost + collective inventory of the step
+        executable for `batch`'s signature (typed perf_profile /
+        perf_collective events; see obs/perfwatch).
+
+        The excache path profiles automatically at its AOT build; this
+        is the explicit probe for plain-jit trainers (smokes, scaling
+        benches). It lowers the NON-donating variant of the step impl —
+        same HLO modulo buffer aliasing — which costs one extra backend
+        compile the first time per signature (jax's AOT cache absorbs
+        repeats). `kind="multi"` profiles the superstep: `batch` must
+        then be the (K, B, ...) stacked pytree the superstep consumes.
+        Returns the profile dict, or None when extraction failed.
+        """
+        if kind == "multi":
+            if self.multistep <= 1:
+                raise ValueError("profile_step(kind='multi') on a "
+                                 "multistep=1 trainer")
+            impl = self._multistep_impl
+        elif kind == "train":
+            impl = self._train_step_impl
+        else:
+            raise ValueError(f"profile_step kind {kind!r} not in "
+                             "('train', 'multi')")
+        # jaxlint: disable=DV003 -- profiling probe: non-donating on purpose (the compiled artifact is inspected, not dispatched on the training hot path)
+        jitted = jax.jit(impl, **self._state_pin)
+        compiled = jitted.lower(self.state, batch).compile()
+        return perfwatch.profile_compiled(f"trainer/{kind}", compiled,
+                                          journal=self.journal,
+                                          registry=self.clock.registry)
+
     @staticmethod
     def _batch_sig(batch) -> tuple:
         """Cheap shape/dtype signature of a (possibly nested) batch —
@@ -499,6 +553,13 @@ class Trainer:
             compiled, _source = self.excache.get_or_compile(
                 lowered, name=f"trainer/{kind}")
             by_sig[sig] = compiled
+            # perf attribution (obs/perfwatch): the AOT/cache path is the
+            # one trainer site that holds a compiled executable, so its
+            # XLA cost + collective inventory journal here — once per
+            # (kind, batch signature), at the build it already paid for
+            perfwatch.profile_compiled(f"trainer/{kind}", compiled,
+                                       journal=self.journal,
+                                       registry=self.clock.registry)
         return compiled
 
     def _train_step_impl(self, state: TrainState, batch):

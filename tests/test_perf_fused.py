@@ -487,6 +487,11 @@ def test_bench_emit_journals_every_path(monkeypatch):
     monkeypatch.setattr(bench, "_EMITTED", False)
     assert bench._emit({"metric": "dispatch_sweep", "rows": []})
     assert not bench._emit({"metric": "late_duplicate"})  # latched
-    assert spy.events == [("dispatch_sweep", {"metric": "dispatch_sweep",
-                                              "rows": []})]
+    assert len(spy.events) == 1
+    name, result = spy.events[0]
+    assert name == "dispatch_sweep"
+    assert result["metric"] == "dispatch_sweep" and result["rows"] == []
+    # every emitted line carries the perf-ledger environment fingerprint
+    # (tools/perf_gate.py keys baselines on it)
+    assert result["env"]["jax"] and result["env_key"]
     assert spy.closed

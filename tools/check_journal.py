@@ -88,6 +88,9 @@ EVENT_FIELDS = {
     "exit": ("status",),
     "crash": ("reason",),
     "telemetry_server": ("host", "port", "outcome"),
+    "perf_profile": ("name", "collective_count", "collective_bytes"),
+    "perf_collective": ("name", "kind", "dtype", "ops", "bytes"),
+    "perf_regression": ("metric", "baseline", "observed", "threshold"),
 }
 HEALTH_KINDS = {"non_finite", "loss_spike", "divergence", "hang",
                 "watchdog_started"}
@@ -125,6 +128,11 @@ EXCACHE_INVALID_REASONS = {"version_skew", "topology_skew", "corrupt",
 # live telemetry plane (obs/telemetry.py TELEMETRY_OUTCOMES, kept in
 # sync by tests/test_telemetry.py)
 TELEMETRY_SERVER_OUTCOMES = {"started", "stopped", "failed"}
+# perf attribution plane (obs/costmodel.py COLLECTIVE_KINDS, kept in
+# sync by tests/test_perfwatch.py): the HLO collective opcodes the
+# inventory parser recognizes
+PERF_COLLECTIVE_KINDS = {"all-reduce", "all-gather", "reduce-scatter",
+                         "all-to-all", "collective-permute"}
 # cross-process trace context (obs/propagate.py): W3C-traceparent-shaped
 # ids stamped onto journal events written under an installed context —
 # any event may carry them, so the hex-shape check applies everywhere
@@ -287,6 +295,46 @@ def check_journal(path: str, require_exit: bool = False,
             if not isinstance(row.get("port"), int):
                 errors.append(f"{path}:{i}: telemetry_server port must be "
                               f"an int, got {row.get('port')!r}")
+        if ev == "perf_profile":
+            # compiled-artifact introspection (obs/perfwatch.py): name is
+            # the jit pair, the collective roll-up must be consistent
+            # (flops/bytes_accessed may be None where the backend hides
+            # its cost analysis — absence of data, not a violation)
+            if not isinstance(row.get("name"), str) or not row.get("name"):
+                errors.append(f"{path}:{i}: perf_profile name must be a "
+                              f"jit-pair name, got {row.get('name')!r}")
+            for k in ("collective_count", "collective_bytes"):
+                if not isinstance(row.get(k), int) or row.get(k, -1) < 0:
+                    errors.append(f"{path}:{i}: perf_profile {k} must be "
+                                  f"a non-negative int, got {row.get(k)!r}")
+            for k in ("flops", "bytes_accessed"):
+                if row.get(k) is not None and \
+                        not isinstance(row.get(k), (int, float)):
+                    errors.append(f"{path}:{i}: perf_profile {k} must be "
+                                  f"numeric or null, got {row.get(k)!r}")
+        if ev == "perf_collective":
+            if row.get("kind") not in PERF_COLLECTIVE_KINDS:
+                errors.append(f"{path}:{i}: unknown perf_collective kind "
+                              f"{row.get('kind')!r}")
+            if not isinstance(row.get("ops"), int) or row.get("ops", 0) < 1:
+                errors.append(f"{path}:{i}: perf_collective ops must be a "
+                              f"positive int, got {row.get('ops')!r}")
+            if not isinstance(row.get("bytes"), int) or \
+                    row.get("bytes", 0) <= 0:
+                errors.append(f"{path}:{i}: perf_collective bytes must be "
+                              f"positive, got {row.get('bytes')!r}")
+        if ev == "perf_regression":
+            # the gate's breach record (tools/perf_gate.py): all three
+            # numbers must be present and numeric — a regression event
+            # that can't say what it compared is not evidence
+            if not isinstance(row.get("metric"), str) or \
+                    not row.get("metric"):
+                errors.append(f"{path}:{i}: perf_regression metric must "
+                              f"be a metric name, got {row.get('metric')!r}")
+            for k in ("baseline", "observed", "threshold"):
+                if not isinstance(row.get(k), (int, float)):
+                    errors.append(f"{path}:{i}: perf_regression {k} must "
+                                  f"be numeric, got {row.get(k)!r}")
         # trace context rides ANY event written under an installed
         # context (obs/journal.py stamps it); when present the ids must
         # be W3C-shaped or obs/merge.py's timelines silently fragment

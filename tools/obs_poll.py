@@ -89,6 +89,19 @@ def format_line(rec: dict, status: dict, ok, health) -> str:
             vitals.append(f"served {src['served']}")
         if src.get("done") is not None:
             vitals.append(f"done {src['done']}")
+        # perf status source (obs/perfwatch.py): rolling step-time
+        # tail, last gate verdict, and the recompile count — the live
+        # "is this process performance-healthy" vitals
+        if src.get("step_time_ms_p50") is not None:
+            line = f"p50 {src['step_time_ms_p50']:.1f}ms"
+            if src.get("step_time_ms_p95") is not None:
+                line += f"/p95 {src['step_time_ms_p95']:.1f}ms"
+            vitals.append(line)
+        gate = src.get("gate")
+        if isinstance(gate, dict) and gate.get("verdict"):
+            vitals.append(f"gate {gate['verdict']}")
+        if src.get("recompiles") is not None:
+            vitals.append(f"recompiles {src['recompiles']}")
     return f"{role:<13}{where:<28} {verdict:<10} " + "  ".join(vitals)
 
 

@@ -106,6 +106,9 @@ def summarize_run(events: List[dict]) -> dict:
     sharding = summarize_sharding(events)
     if sharding:
         out["sharding"] = sharding
+    perf = summarize_perf(events)
+    if perf:
+        out["perf"] = perf
     terminal = next(
         (e for e in reversed(events) if e.get("event") in ("exit", "crash")),
         None)
@@ -352,6 +355,42 @@ def summarize_sharding(events: List[dict]) -> Optional[dict]:
         out["tables"] = tables
     if scaling:
         out["scaling"] = scaling
+    return out
+
+
+def summarize_perf(events: List[dict]) -> Optional[dict]:
+    """The performance-attribution view (obs/perfwatch.py +
+    tools/perf_gate.py events): one row per profiled jit pair with its
+    XLA cost analysis and collective roll-up, the per-(kind, dtype)
+    collective inventory under it, and every gate breach with the
+    baseline/threshold it broke. None when the journal carries no perf
+    events — every existing report renders byte-unchanged."""
+    profiles = [e for e in events if e.get("event") == "perf_profile"]
+    collectives = [e for e in events if e.get("event") == "perf_collective"]
+    regressions = [e for e in events if e.get("event") == "perf_regression"]
+    if not (profiles or collectives or regressions):
+        return None
+    out: dict = {}
+    if profiles:
+        pairs = []
+        for e in profiles:
+            row = {k: e.get(k) for k in
+                   ("name", "flops", "bytes_accessed", "temp_bytes",
+                    "collective_count", "collective_bytes", "source")
+                   if e.get(k) is not None}
+            row["collectives"] = [
+                {k: c.get(k) for k in
+                 ("kind", "dtype", "ops", "bytes", "group_size")
+                 if c.get(k) is not None}
+                for c in collectives if c.get("name") == e.get("name")]
+            pairs.append(row)
+        out["pairs"] = pairs
+    if regressions:
+        out["regressions"] = [
+            {k: e.get(k) for k in
+             ("metric", "baseline", "observed", "threshold", "direction")
+             if e.get(k) is not None}
+            for e in regressions]
     return out
 
 
@@ -638,6 +677,33 @@ def render(summary: dict) -> str:
                          f"{r.get('examples_per_sec')} ex/s  "
                          f"{r.get('per_device_examples_per_sec')} /device  "
                          f"efficiency {r.get('efficiency')}"))
+    # performance attribution (obs/perfwatch.py + tools/perf_gate.py):
+    # what each compiled jit pair costs (XLA cost analysis), which
+    # collectives the partitioner gave it, and any gate breach with the
+    # baseline it broke — the "why is this PR slower" paper trail
+    perf = summary.get("perf")
+    if perf:
+        for pr in perf.get("pairs", []):
+            parts = []
+            if pr.get("flops") is not None:
+                parts.append(f"flops {pr['flops']:.3g}")
+            if pr.get("bytes_accessed") is not None:
+                parts.append(f"bytes {pr['bytes_accessed']:.3g}")
+            parts.append(f"collectives {pr.get('collective_count', 0)}"
+                         f" ({pr.get('collective_bytes', 0)} B)")
+            rows.append((f"perf {pr.get('name', '?')}", "  ".join(parts)))
+            for c in pr.get("collectives", []):
+                detail = (f"{c.get('kind')} {c.get('dtype')} "
+                          f"x{c.get('ops')}  {c.get('bytes')} B")
+                if c.get("group_size"):
+                    detail += f"  group {c['group_size']}"
+                rows.append(("  collective", detail))
+        for r in perf.get("regressions", []):
+            rows.append(("PERF REGRESSION",
+                         f"{r.get('metric')}: observed {r.get('observed')}"
+                         f" vs baseline {r.get('baseline')} "
+                         f"(threshold {r.get('threshold')}, "
+                         f"{r.get('direction', '?')} is better)"))
     # profiler captures: every decision the autoprof policy made, so the
     # table answers "why does this run have three trace dirs" directly
     for e in summary.get("captures", []):
@@ -758,6 +824,49 @@ def render_trace(spans: List[dict], path: str) -> str:
     return "\n".join(lines)
 
 
+_SPARK = "▁▂▃▄▅▆▇█"
+
+
+def _sparkline(vals: List[float]) -> str:
+    lo, hi = min(vals), max(vals)
+    if hi <= lo:
+        return _SPARK[0] * len(vals)
+    return "".join(_SPARK[int((v - lo) / (hi - lo) * (len(_SPARK) - 1))]
+                   for v in vals)
+
+
+def render_ledger(path: str, *, window: int = 16) -> str:
+    """The perf-trajectory table over a tools/perf_gate.py ledger: one
+    row per (metric, env fingerprint) with the last value, a sparkline
+    of the last `window` runs, and the most recent gate verdict — the
+    "is this metric drifting" answer without opening the JSONL."""
+    from tools.perf_gate import PerfLedger
+
+    rows = PerfLedger(path).read()
+    if not rows:
+        return f"perf ledger {path}: empty"
+    series: Dict[tuple, List[dict]] = {}
+    for r in rows:
+        if isinstance(r.get("value"), (int, float)):
+            series.setdefault(
+                (str(r.get("metric", "?")), str(r.get("env_key", ""))),
+                []).append(r)
+    lines = [f"-- perf trajectory: {path} ({len(rows)} runs, "
+             f"{len(series)} series) --"]
+    w = max(len(m) for m, _ in series) if series else 6
+    for (metric, _key), rs in sorted(series.items()):
+        tail = rs[-int(window):]
+        vals = [float(r["value"]) for r in tail]
+        last = tail[-1]
+        unit = last.get("unit") or ""
+        verdict = last.get("verdict", "?")
+        line = (f"{metric:<{w}}  {_sparkline(vals)}  "
+                f"last {vals[-1]:.4g}{(' ' + unit) if unit else ''}  "
+                f"[{verdict}]  (n={len(rs)})")
+        lines.append(line)
+    return "\n".join(lines)
+
+
 def render_merged(events: List[dict]) -> str:
     """Render an obs_merge timeline: per-host step statistics side by
     side, then every detected straggler — the cross-host view a single
@@ -846,6 +955,13 @@ def main(argv=None) -> int:
                    help="the input is a tools/obs_merge.py merged "
                         "multi-host timeline: render per-host step "
                         "statistics and the detected stragglers")
+    p.add_argument("--ledger", default=None, metavar="PATH",
+                   help="also render the perf-trajectory table of this "
+                        "tools/perf_gate.py ledger (sparkline per "
+                        "metric, last gate verdict)")
+    p.add_argument("--digest", default=None, metavar="PATH",
+                   help="also render a step-time decomposition of this "
+                        "profiler capture dir (tools/trace_digest.py)")
     args = p.parse_args(argv)
 
     if args.merged:
@@ -856,8 +972,7 @@ def main(argv=None) -> int:
             print("no events found", file=sys.stderr)
             return 1
         print(render_merged(events))
-        if args.trace:
-            print(render_trace(summarize_trace(args.trace), args.trace))
+        _render_extras(args)
         return 0
 
     by_run: Dict[str, List[dict]] = {}
@@ -869,9 +984,19 @@ def main(argv=None) -> int:
         return 1
     for run_id, events in by_run.items():
         print(render(summarize_run(events)))
+    _render_extras(args)
+    return 0
+
+
+def _render_extras(args) -> None:
     if args.trace:
         print(render_trace(summarize_trace(args.trace), args.trace))
-    return 0
+    if args.ledger:
+        print(render_ledger(args.ledger))
+    if args.digest:
+        from tools.trace_digest import digest, render_digest
+
+        print(render_digest(digest(args.digest)))
 
 
 if __name__ == "__main__":

@@ -28,7 +28,9 @@ verify` prerequisite), on a forced 8-device virtual-CPU mesh
                 catch-all must refuse at construction.
   D. scaling    tools/scaling.py measures throughput at data={1,2,4,8}
                 sub-meshes (the `bench.py --multichip` measurement) and
-                the rows land as a typed `bench` event.
+                the rows land as a typed `bench` event, each carrying
+                the compiled step's predicted comm bytes next to the
+                measured step-time delta vs the 1-device baseline.
   E. artifacts  journals pass `check_journal --strict`
                 (sharding_resolved schema included) and obs_report
                 renders the sharding section with rule hit counts and
@@ -151,6 +153,19 @@ def _train_phase(f: Failures, name: str, model, rules, journal_path: str):
     f.check(bool(jnp.isfinite(
         trainer.state.params["Dense_0"]["kernel"]).all()),
             f"{name}: params finite after sharded training")
+    # perf attribution (obs/perfwatch): the compiled step's collective
+    # inventory must NAME the partitioner's comm — a sharded step whose
+    # HLO shows zero all-reduces isn't reducing gradients at all. (The
+    # byte-vs-grad-tree equality check lives in perf_gate's smoke on the
+    # pure-DP mesh, where no tensor-parallel activation collectives mix
+    # into the bill.) Runs AFTER the recompile assertions: the probe's
+    # non-donating AOT lowering owns one compile of its own.
+    prof = trainer.profile_step(data[0])
+    f.check(prof is not None and prof["collective_bytes"] > 0
+            and any(c["kind"] == "all-reduce" for c in prof["collectives"]),
+            f"{name}: compiled-step collective inventory names its "
+            f"all-reduces ({0 if prof is None else prof['collective_bytes']}"
+            " bytes)")
     trainer.close()
     journal.close()
     events = read_jsonl(journal_path)
@@ -163,6 +178,10 @@ def _train_phase(f: Failures, name: str, model, rules, journal_path: str):
     steps = [e for e in events if e.get("event") == "step"]
     f.check(any(e.get("multistep") == 2 for e in steps),
             f"{name}: superstep dispatches journaled with multistep=2")
+    profiles = [e for e in events if e.get("event") == "perf_profile"]
+    f.check(any(e.get("collective_count", 0) > 0 for e in profiles),
+            f"{name}: typed perf_profile event journaled with the "
+            "collective roll-up")
     return events
 
 
@@ -273,6 +292,10 @@ def main(argv=None) -> int:
             and rows[0]["efficiency"] == 1.0,
             "scaling rows well-formed (positive throughput, 1-device "
             "anchor at 1.0)")
+    f.check(rows[0]["predicted_comm_bytes"] == 0
+            and all(r["predicted_comm_bytes"] > 0 for r in rows[1:]),
+            "scaling rows carry the predicted comm bill (0 at data=1, "
+            "positive on every multi-device sub-mesh)")
 
     print("-- phase E: artifacts validate --")
     from tools.check_journal import check_journal
