@@ -35,11 +35,16 @@ test:
 	python -m pytest tests/ -x -q
 
 # static analysis (lint/): the review-time teeth behind the obs/ runtime
-# signals — fails on any non-baselined DV001-DV007 (JAX/TPU contracts) or
-# DV101-DV104 (concurrency pack, lint/concur.py) finding. Runs first in
-# verify: it is the cheapest gate (~3s, no jax import of the hot paths)
+# signals — fails on any non-baselined DV001-DV007 (JAX/TPU contracts),
+# DV101-DV104 (concurrency pack, lint/concur.py), or DV201-DV205
+# (distributed-correctness pack, lint/distlint.py) finding, then audits
+# the curated sharding tables semantically (tools/shard_check.py:
+# coverage floors over abstract eval_shape trees — zero devices, zero
+# compiles). Runs first in verify: it is the cheapest gate (warm lint
+# cache ~0.1s; shard_check ~2s on a cold jax import)
 lint:
 	python -m deep_vision_tpu.lint
+	JAX_PLATFORMS=cpu python tools/shard_check.py
 
 # accept the current findings into the checked-in baseline (use after an
 # intentional change; review the diff of .jaxlint-baseline.json like code)

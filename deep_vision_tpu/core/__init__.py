@@ -1,4 +1,60 @@
-from deep_vision_tpu.core.train_state import TrainState, create_train_state
-from deep_vision_tpu.core.checkpoint import CheckpointManager
-from deep_vision_tpu.core.metrics import MetricLogger, topk_accuracy
-from deep_vision_tpu.core.summary import count_params, model_summary
+"""Core training-state layer.
+
+Re-exports are LAZY (PEP 562): `core/knobs.py` (the DVT_* env-knob
+registry) and `core/backend.py` are stdlib-only by contract and are
+imported by pre-jax code paths — resilience/rendezvous.py arms its
+lease before paying the jax import, resilience/faults.py installs
+specs at import time, and the lint CLI prints the knob table without
+any jax. An eager `from .train_state import TrainState` here would
+drag flax/jax into all of them.
+"""
+from typing import TYPE_CHECKING
+
+__all__ = [
+    "TrainState",
+    "create_train_state",
+    "CheckpointManager",
+    "MetricLogger",
+    "topk_accuracy",
+    "count_params",
+    "model_summary",
+]
+
+_EXPORTS = {
+    "TrainState": "deep_vision_tpu.core.train_state",
+    "create_train_state": "deep_vision_tpu.core.train_state",
+    "CheckpointManager": "deep_vision_tpu.core.checkpoint",
+    "MetricLogger": "deep_vision_tpu.core.metrics",
+    "topk_accuracy": "deep_vision_tpu.core.metrics",
+    "count_params": "deep_vision_tpu.core.summary",
+    "model_summary": "deep_vision_tpu.core.summary",
+}
+
+if TYPE_CHECKING:  # static analyzers see the eager imports
+    from deep_vision_tpu.core.checkpoint import CheckpointManager  # noqa: F401
+    from deep_vision_tpu.core.metrics import (  # noqa: F401
+        MetricLogger,
+        topk_accuracy,
+    )
+    from deep_vision_tpu.core.summary import (  # noqa: F401
+        count_params,
+        model_summary,
+    )
+    from deep_vision_tpu.core.train_state import (  # noqa: F401
+        TrainState,
+        create_train_state,
+    )
+
+
+def __getattr__(name: str):
+    mod = _EXPORTS.get(name)
+    if mod is None:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    return getattr(importlib.import_module(mod), name)
+
+
+def __dir__():
+    return sorted(set(globals()) | set(__all__))

@@ -1,0 +1,87 @@
+"""Backend registry: the ONE module allowed to compare platform strings.
+
+ROADMAP item 4 (multi-backend PJRT seam), first concrete step. Before
+this module, "platform" was an implicit axis enforced by convention:
+eight call sites across ops/pallas/, ops/nms.py, parallel/ and models/
+each hand-rolled `jax.default_backend() == "tpu"` to decide whether a
+Pallas kernel compiles natively or must run interpreted, and which NMS
+selection backend is the default. The DV201 lint rule
+(lint/distlint.py) now fails any such comparison OUTSIDE this module;
+routing decisions read a `BackendProfile` instead, so adding a new
+PJRT platform (or re-tuning what 'gpu' means once Mosaic-GPU lands) is
+one table row here, not a grep across the tree.
+
+Deliberately NOT wrapped: telemetry/fingerprint call sites that only
+RECORD the platform string (obs/journal.py run manifests, excache
+fingerprints, preflight detail lines) — recording is not routing, and
+DV201 only fires on comparisons.
+
+jax is imported lazily so stdlib-only consumers (lint, tools) can
+import the module without paying the jax tax.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict
+
+__all__ = [
+    "BackendProfile",
+    "BACKENDS",
+    "current_platform",
+    "get_backend",
+    "is_tpu",
+    "pallas_interpret",
+    "default_nms_impl",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class BackendProfile:
+    """What the stack needs to know about one PJRT platform to route
+    work — capabilities, not a platform name to compare against."""
+
+    name: str
+    #: Mosaic compiles Pallas kernels natively; elsewhere they run
+    #: under `interpret=True` (the CPU test path).
+    pallas_compiled: bool
+    #: default NMS selection backend (ops/nms.py `impl='auto'`).
+    nms_impl: str
+
+
+BACKENDS: Dict[str, BackendProfile] = {
+    "tpu": BackendProfile(name="tpu", pallas_compiled=True,
+                          nms_impl="pallas"),
+    "cpu": BackendProfile(name="cpu", pallas_compiled=False,
+                          nms_impl="lax"),
+    "gpu": BackendProfile(name="gpu", pallas_compiled=False,
+                          nms_impl="lax"),
+}
+
+#: any platform without a curated row (plugin PJRT backends) routes
+#: like CPU: interpret Pallas, lax NMS — slow beats wrong.
+_FALLBACK = BACKENDS["cpu"]
+
+
+def current_platform() -> str:
+    """The active PJRT platform name (`jax.default_backend()`)."""
+    import jax
+
+    return jax.default_backend()
+
+
+def get_backend() -> BackendProfile:
+    return BACKENDS.get(current_platform(), _FALLBACK)
+
+
+def is_tpu() -> bool:
+    return current_platform() == "tpu"
+
+
+def pallas_interpret() -> bool:
+    """Should Pallas kernels run under `interpret=True`? The default
+    for every `interpret=None` kernel entry point."""
+    return not get_backend().pallas_compiled
+
+
+def default_nms_impl() -> str:
+    return get_backend().nms_impl

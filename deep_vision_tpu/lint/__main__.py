@@ -63,11 +63,23 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--config", default=None, metavar="PYPROJECT",
                    help="explicit pyproject.toml (default: nearest upward)")
     p.add_argument("--list-rules", action="store_true")
+    p.add_argument("--knobs", action="store_true",
+                   help="print the DVT_* environment-knob registry "
+                        "(core/knobs.py) and exit")
+    p.add_argument("--no-cache", action="store_true",
+                   help="bypass the incremental lint cache "
+                        "(artifacts/lint_cache/)")
     args = p.parse_args(argv)
 
     if args.list_rules:
         for code, (name, severity, _, doc) in sorted(RULES.items()):
             print(f"{code}  {name:<24} [{severity}]  {doc}")
+        return 0
+
+    if args.knobs:
+        from deep_vision_tpu.core.knobs import format_knob_table
+
+        print(format_knob_table())
         return 0
 
     # a typo'd code would otherwise run zero rules and report "clean"
@@ -100,12 +112,26 @@ def main(argv: Optional[List[str]] = None) -> int:
               file=sys.stderr)
         return EXIT_USAGE
 
+    cache = None
+    if not args.no_cache:
+        from deep_vision_tpu.lint.cache import (
+            DEFAULT_CACHE_DIR,
+            LintCache,
+            pack_fingerprint,
+        )
+
+        root = cfg.get("root", os.getcwd())
+        enabled = set(_codes(args.select) or RULES) - disable
+        cache = LintCache(os.path.join(root, DEFAULT_CACHE_DIR),
+                          pack_fingerprint(enabled, root=root))
+
     findings, suppressed, n_files = lint_paths(
         paths,
         root=cfg.get("root"),
         select=_codes(args.select),
         disable=disable or None,
         exclude=cfg["exclude"],
+        cache=cache,
     )
 
     baseline_path = args.baseline or os.path.join(

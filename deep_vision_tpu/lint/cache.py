@@ -1,0 +1,129 @@
+"""Incremental lint cache: skip re-analyzing files that cannot have
+changed their verdict.
+
+A cached entry is keyed by (file content sha, rule-pack fingerprint).
+The fingerprint folds in everything that can change a verdict WITHOUT
+the linted file changing:
+
+  - every .py source in the lint package itself (a rule edit must
+    invalidate the whole cache),
+  - the cross-file inputs distlint parses behind lru_cache — the
+    check_journal schema registry (DV204), the knob registry (DV203),
+    the mesh-axis constants (DV205),
+  - the enabled-rule set (a --select/--disable run must not poison
+    the full-run cache),
+  - CACHE_VERSION, for format changes.
+
+Entries store both kept and suppressed findings (the CLI summary
+counts suppressions), one JSON file per linted path under
+`artifacts/lint_cache/`. Everything is fail-open: an unreadable,
+stale, or corrupt entry is a cache miss, and a write failure is
+ignored — the cache can only ever make lint faster, never wrong or
+broken. Disable per-run with `--no-cache`.
+"""
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+from typing import Iterable, List, Optional, Tuple
+
+from deep_vision_tpu.lint.findings import Finding
+
+CACHE_VERSION = 1
+
+#: default location, relative to the lint root (repo root in practice)
+DEFAULT_CACHE_DIR = os.path.join("artifacts", "lint_cache")
+
+#: repo-relative files (beyond the lint package) whose content feeds
+#: rule verdicts: the registries distlint parses behind lru_cache
+_CROSS_FILE_DEPS = (
+    "tools/check_journal.py",
+    "deep_vision_tpu/core/knobs.py",
+    "deep_vision_tpu/parallel/mesh.py",
+)
+
+
+def _sha(data: bytes) -> str:
+    return hashlib.sha256(data).hexdigest()
+
+
+def _file_sha(path: str) -> str:
+    try:
+        with open(path, "rb") as f:
+            return _sha(f.read())
+    except OSError:
+        return "missing"
+
+
+def pack_fingerprint(enabled: Iterable[str],
+                     root: Optional[str] = None) -> str:
+    """One hash covering rule code + cross-file registries + the
+    enabled-rule set; any change invalidates every cached entry."""
+    root = os.path.abspath(root or os.getcwd())
+    parts: List[str] = [f"v{CACHE_VERSION}",
+                        "rules=" + ",".join(sorted(enabled))]
+    pkg_dir = os.path.dirname(os.path.abspath(__file__))
+    for fn in sorted(os.listdir(pkg_dir)):
+        if fn.endswith(".py"):
+            parts.append(f"{fn}={_file_sha(os.path.join(pkg_dir, fn))}")
+    for rel in _CROSS_FILE_DEPS:
+        parts.append(f"{rel}={_file_sha(os.path.join(root, rel))}")
+    return _sha("\n".join(parts).encode())
+
+
+class LintCache:
+    """Per-file verdict store; every method is fail-open."""
+
+    def __init__(self, cache_dir: str, fingerprint: str):
+        self.cache_dir = cache_dir
+        self.fingerprint = fingerprint
+        self.hits = 0
+        self.misses = 0
+
+    def _entry_path(self, relpath: str) -> str:
+        return os.path.join(self.cache_dir,
+                            _sha(relpath.encode())[:24] + ".json")
+
+    def get(self, relpath: str,
+            source: str) -> Optional[Tuple[List[Finding], List[Finding]]]:
+        try:
+            with open(self._entry_path(relpath)) as f:
+                doc = json.load(f)
+            if (doc.get("version") != CACHE_VERSION
+                    or doc.get("fingerprint") != self.fingerprint
+                    or doc.get("path") != relpath
+                    or doc.get("sha") != _sha(source.encode())):
+                self.misses += 1
+                return None
+            kept = [Finding(**row) for row in doc["kept"]]
+            dropped = [Finding(**row) for row in doc["suppressed"]]
+        except (OSError, ValueError, TypeError, KeyError):
+            self.misses += 1
+            return None
+        self.hits += 1
+        return kept, dropped
+
+    def put(self, relpath: str, source: str,
+            kept: List[Finding], dropped: List[Finding]) -> None:
+        doc = {
+            "version": CACHE_VERSION,
+            "fingerprint": self.fingerprint,
+            "path": relpath,
+            "sha": _sha(source.encode()),
+            "kept": [dataclasses.asdict(f) for f in kept],
+            "suppressed": [dataclasses.asdict(f) for f in dropped],
+        }
+        path = self._entry_path(relpath)
+        tmp = path + f".tmp.{os.getpid()}"
+        try:
+            os.makedirs(self.cache_dir, exist_ok=True)
+            with open(tmp, "w") as f:
+                json.dump(doc, f)
+            os.replace(tmp, path)
+        except OSError:
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass

@@ -1,0 +1,597 @@
+"""distlint: the DV2xx distributed-correctness pack.
+
+Rides the jaxlint engine exactly like the DV1xx concurrency pack: one
+RULES registry (rules.py merges DIST_RULES at import), one baseline,
+one suppression syntax, one CLI. Where DV0xx encodes single-process
+JAX discipline and DV1xx encodes lock discipline, DV2xx encodes the
+repo's DISTRIBUTED contracts — the ones that so far lived in memory:
+
+  DV201 hardcoded-platform-check — a string comparison against
+        'tpu'/'cpu'/'gpu' (via jax.default_backend(), `.platform`, or
+        a bare `platform` name) anywhere but core/backend.py. Platform
+        is a routing decision; the registry owns it (ROADMAP item 4).
+  DV202 unbounded-collective — a jax.experimental.multihost_utils
+        call site outside parallel/multihost.py and resilience/
+        rendezvous.py. Raw host collectives cannot name a dead peer,
+        only hang on it; the PR 13 contract is that every host-level
+        barrier/allgather is deadline-bounded by those wrappers.
+        (Device-level lax.psum/ppermute inside shard_map bodies are a
+        different animal and are not flagged.)
+  DV203 unregistered-env-knob — an os.environ/os.getenv read of a
+        DVT_* name outside core/knobs.py, or a knobs.get_*() call
+        naming a knob the KNOBS registry does not declare. One
+        registry, one mistype-raises parse contract.
+  DV204 journal-schema-drift — a `journal.write("event", ...)` emitter
+        whose event type has no tools/check_journal.py EVENT_FIELDS
+        schema (and no allowlist entry). Replaces the hand-written
+        per-PR emitter-vs-schema drift tests with one static pass.
+  DV205 pspec-table-hygiene — a ShardingRules(...) table with
+        non-literal patterns, a missing trailing catch-all, or a spec
+        naming an axis parallel/mesh.py does not declare: the
+        statically checkable half of ShardingRuleError. (The dynamic
+        half — coverage floors, shadowing, dead patterns against real
+        abstract trees — is tools/shard_check.py.)
+
+Cross-file inputs (the check_journal schema table, the knob registry,
+the mesh axis names) are read via AST from their source files, located
+relative to this module — no jax import, no cwd dependence. The lint
+cache (engine.py) folds those files into its pack fingerprint so a
+schema edit invalidates cached DV204 results.
+"""
+from __future__ import annotations
+
+import ast
+import functools
+import os
+from typing import Dict, List, Optional, Set, Tuple
+
+from deep_vision_tpu.lint.findings import Finding
+from deep_vision_tpu.lint.jitctx import last_name
+
+#: repo root, resolved from this file: deep_vision_tpu/lint/distlint.py
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+#: the one module allowed to compare platform strings (DV201)
+PLATFORM_SANCTIONED = ("deep_vision_tpu/core/backend.py",)
+
+#: the deadline-bounded wrapper modules (DV202): raw multihost_utils
+#: call sites are legal HERE and nowhere else
+COLLECTIVE_SANCTIONED = (
+    "deep_vision_tpu/parallel/multihost.py",
+    "deep_vision_tpu/resilience/rendezvous.py",
+)
+
+#: the knob registry module (DV203): raw DVT_* environ reads are legal
+#: here and nowhere else
+KNOBS_MODULE = "deep_vision_tpu/core/knobs.py"
+
+#: event types a journal emitter may use WITHOUT a check_journal
+#: --strict schema. Deliberately empty: an event worth emitting is
+#: worth validating — add the schema, not an allowlist row.
+DV204_ALLOWLIST: Set[str] = set()
+
+_PLATFORM_STRINGS = ("tpu", "cpu", "gpu")
+
+_HOST_COLLECTIVES = (
+    "sync_global_devices",
+    "process_allgather",
+    "broadcast_one_to_all",
+)
+
+_KNOB_HELPERS = ("get_int", "get_float", "get_flag", "get_choice",
+                 "get_str")
+
+
+def _find(ctx, code: str, node: ast.AST, message: str,
+          severity: str = "error") -> Finding:
+    return Finding(code, message, ctx.relpath, getattr(node, "lineno", 0),
+                   getattr(node, "col_offset", 0), severity,
+                   ctx.symbol_at(node))
+
+
+def _dotted(node: ast.AST) -> List[str]:
+    """['jax', 'experimental', 'multihost_utils', 'sync_global_devices']
+    for a nested Attribute chain; [] when the chain has a non-name
+    root (a call result, a subscript)."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    parts.reverse()
+    return parts
+
+
+def _module_str_constants(tree: ast.Module) -> Dict[str, str]:
+    """Module-level NAME = 'literal' assignments (ENV_SPEC =
+    'DVT_FAULT_SPEC' in resilience/faults.py) so constant-routed env
+    reads resolve like literal ones."""
+    out: Dict[str, str] = {}
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name) and \
+                isinstance(node.value, ast.Constant) and \
+                isinstance(node.value.value, str):
+            out[node.targets[0].id] = node.value.value
+    return out
+
+
+def _resolve_str(node: ast.AST,
+                 consts: Dict[str, str]) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.Name):
+        return consts.get(node.id)
+    return None
+
+
+# -- DV201: hardcoded-platform-check ------------------------------------------
+
+def _is_platform_expr(node: ast.AST) -> bool:
+    """jax.default_backend() / backend.current_platform() /
+    device.platform / bare `platform`."""
+    if isinstance(node, ast.Call):
+        name = last_name(node.func)
+        return name in ("default_backend", "current_platform")
+    if isinstance(node, ast.Attribute):
+        return node.attr == "platform"
+    if isinstance(node, ast.Name):
+        return node.id == "platform"
+    return False
+
+
+def _platform_literals(node: ast.AST) -> List[str]:
+    if isinstance(node, ast.Constant) and node.value in _PLATFORM_STRINGS:
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        return [e.value for e in node.elts
+                if isinstance(e, ast.Constant) and
+                e.value in _PLATFORM_STRINGS]
+    return []
+
+
+def check_dv201(ctx) -> List[Finding]:
+    if ctx.relpath in PLATFORM_SANCTIONED:
+        return []
+    out: List[Finding] = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Compare):
+            continue
+        sides = [node.left] + list(node.comparators)
+        lits = [s for side in sides for s in _platform_literals(side)]
+        if not lits or not any(_is_platform_expr(s) for s in sides):
+            continue
+        out.append(_find(
+            ctx, "DV201", node,
+            f"hardcoded platform check against {lits[0]!r} — platform "
+            "is a routing decision: read a capability off "
+            "core/backend.py get_backend() instead (is_tpu/"
+            "pallas_interpret/BackendProfile)"))
+    return out
+
+
+# -- DV202: unbounded-collective ----------------------------------------------
+
+def check_dv202(ctx) -> List[Finding]:
+    if ctx.relpath in COLLECTIVE_SANCTIONED:
+        return []
+    out: List[Finding] = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        chain = _dotted(node.func)
+        if not chain:
+            continue
+        if "multihost_utils" in chain or chain[-1] in _HOST_COLLECTIVES:
+            out.append(_find(
+                ctx, "DV202", node,
+                f"raw host collective {'.'.join(chain)}() — a jax "
+                "barrier cannot name a dead peer, only hang on it; "
+                "route through the deadline-bounded wrappers in "
+                "parallel/multihost.py (sync_hosts/agree_flag) or "
+                "resilience/rendezvous.py"))
+    return out
+
+
+# -- DV203: unregistered-env-knob ---------------------------------------------
+
+@functools.lru_cache(maxsize=4)
+def _registered_knobs(knobs_path: Optional[str] = None) -> Set[str]:
+    """Knob names declared in core/knobs.py, read via AST (every
+    `_k("DVT_...")` first argument) so linting needs no import of the
+    linted tree. Missing file (fixture repos) -> empty set."""
+    path = knobs_path or os.path.join(_REPO_ROOT, KNOBS_MODULE)
+    try:
+        with open(path, encoding="utf-8") as f:
+            tree = ast.parse(f.read(), filename=path)
+    except (OSError, SyntaxError):
+        return set()
+    names: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and last_name(node.func) == "_k" \
+                and node.args and isinstance(node.args[0], ast.Constant) \
+                and isinstance(node.args[0].value, str):
+            names.add(node.args[0].value)
+    return names
+
+
+def _environ_read_name(node: ast.Call,
+                       consts: Dict[str, str]) -> Optional[str]:
+    """The env-var name of an os.environ.get(...)/os.getenv(...) call,
+    or None when the call is not an environ read."""
+    chain = _dotted(node.func)
+    if not chain or not node.args:
+        return None
+    is_read = (chain[-1] == "getenv"
+               or (chain[-1] == "get" and "environ" in chain))
+    if not is_read:
+        return None
+    return _resolve_str(node.args[0], consts)
+
+
+def check_dv203(ctx) -> List[Finding]:
+    if ctx.relpath == KNOBS_MODULE:
+        return []
+    out: List[Finding] = []
+    consts = _module_str_constants(ctx.tree)
+    registered = _registered_knobs()
+    for node in ast.walk(ctx.tree):
+        # raw reads: os.environ.get / os.getenv / os.environ[...]
+        name = None
+        site = node
+        if isinstance(node, ast.Call):
+            name = _environ_read_name(node, consts)
+            if name is None:
+                # knobs.get_*("DVT_X"): the name must be registered
+                chain = _dotted(node.func)
+                if chain and chain[-1] in _KNOB_HELPERS and node.args:
+                    kname = _resolve_str(node.args[0], consts)
+                    if kname and kname.startswith("DVT_") and \
+                            registered and kname not in registered:
+                        out.append(_find(
+                            ctx, "DV203", node,
+                            f"knob {kname} is not declared in "
+                            "core/knobs.py KNOBS — register it (name, "
+                            "kind, default, doc) before reading it"))
+                continue
+        elif isinstance(node, ast.Subscript) and \
+                isinstance(node.ctx, ast.Load):
+            chain = _dotted(node.value)
+            if chain and chain[-1] == "environ":
+                name = _resolve_str(node.slice, consts)
+        if name and name.startswith("DVT_"):
+            out.append(_find(
+                ctx, "DV203", site,
+                f"raw environ read of {name} — every DVT_* knob goes "
+                "through core/knobs.py (get_int/get_float/get_flag/"
+                "get_choice/get_str): one registry, one mistype-raises "
+                "parse contract"))
+    return out
+
+
+# -- DV204: journal-schema-drift ----------------------------------------------
+
+@functools.lru_cache(maxsize=4)
+def _schema_events(schema_path: Optional[str] = None) -> Set[str]:
+    """Event types with a check_journal --strict schema: the keys of
+    the EVENT_FIELDS dict in tools/check_journal.py, read via AST.
+    Empty set when the file is missing (fixture repos) — the rule then
+    stays silent rather than flagging everything."""
+    path = schema_path or os.path.join(_REPO_ROOT, "tools",
+                                       "check_journal.py")
+    try:
+        with open(path, encoding="utf-8") as f:
+            tree = ast.parse(f.read(), filename=path)
+    except (OSError, SyntaxError):
+        return set()
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name) and \
+                node.targets[0].id == "EVENT_FIELDS" and \
+                isinstance(node.value, ast.Dict):
+            return {k.value for k in node.value.keys
+                    if isinstance(k, ast.Constant) and
+                    isinstance(k.value, str)}
+    return set()
+
+
+def _is_journal_write(ctx, node: ast.Call) -> bool:
+    """journal.write(...) / self.journal.write(...) / _journal.write(...)
+    — plus self.write(...) inside a *Journal class (obs/journal.py's
+    RunJournal emitting its own typed rows)."""
+    if not isinstance(node.func, ast.Attribute) or \
+            node.func.attr != "write":
+        return False
+    recv = last_name(node.func.value)
+    if recv in ("journal", "_journal"):
+        return True
+    if recv == "self":
+        qual = ctx.symbol_at(node)
+        return "Journal" in qual.split(".")[0] if qual else False
+    return False
+
+
+def _forwarding_wrappers(ctx) -> Dict[str, ast.FunctionDef]:
+    """Methods that forward their first event parameter to
+    journal.write (the `def _event(self, event, **fields): ...
+    journal.write(event, ...)` guard idiom in excache/data-service/
+    rendezvous). Their LITERAL call sites are the real emitters — DV204
+    checks those and exempts the wrapper's own dynamic write."""
+    out: Dict[str, ast.FunctionDef] = {}
+    for fn in ast.walk(ctx.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        params = [a.arg for a in fn.args.args if a.arg != "self"]
+        if not params:
+            continue
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call) and \
+                    _is_journal_write(ctx, node) and node.args and \
+                    isinstance(node.args[0], ast.Name) and \
+                    node.args[0].id == params[0]:
+                out[fn.name] = fn
+                break
+    return out
+
+
+def check_dv204(ctx) -> List[Finding]:
+    events = _schema_events()
+    if not events:
+        return []
+    out: List[Finding] = []
+    wrappers = _forwarding_wrappers(ctx)
+    wrapped_writes = {
+        id(node)
+        for fn in wrappers.values()
+        for node in ast.walk(fn)
+        if isinstance(node, ast.Call) and _is_journal_write(ctx, node)
+    }
+    # EVENT_HOST_LOST = "host_lost" module constants count as literal
+    consts = _module_str_constants(ctx.tree)
+
+    def check_event(node: ast.Call, arg: ast.AST) -> None:
+        event = _resolve_str(arg, consts)
+        if event is None:
+            out.append(_find(
+                ctx, "DV204", node,
+                "journal.write with a dynamic event type cannot be "
+                "schema-checked — emit literal event types, or "
+                "suppress with a reason where the dynamism is the "
+                "point"))
+            return
+        if event in events or event in DV204_ALLOWLIST:
+            return
+        out.append(_find(
+            ctx, "DV204", node,
+            f"journal event {event!r} has no tools/check_journal.py "
+            "--strict schema — add an EVENT_FIELDS entry (or a "
+            "DV204_ALLOWLIST row) so drift fails the gate, not a "
+            "post-mortem"))
+
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if _is_journal_write(ctx, node):
+            # the dynamic write INSIDE a recognized forwarding wrapper
+            # is plumbing, not an emitter — its call sites are checked
+            if id(node) in wrapped_writes:
+                continue
+            if node.args:
+                check_event(node, node.args[0])
+            continue
+        # literal call sites of a forwarding wrapper ARE emitters
+        if isinstance(node.func, ast.Attribute) and \
+                node.func.attr in wrappers and node.args:
+            check_event(node, node.args[0])
+    return out
+
+
+# -- DV205: pspec-table-hygiene -----------------------------------------------
+
+@functools.lru_cache(maxsize=4)
+def _mesh_axes(mesh_path: Optional[str] = None) -> Set[str]:
+    """Axis names the curated mesh declares: every module-level
+    `*_AXIS = '...'` constant in parallel/mesh.py."""
+    path = mesh_path or os.path.join(
+        _REPO_ROOT, "deep_vision_tpu", "parallel", "mesh.py")
+    try:
+        with open(path, encoding="utf-8") as f:
+            tree = ast.parse(f.read(), filename=path)
+    except (OSError, SyntaxError):
+        return set()
+    return {v for k, v in _module_str_constants(tree).items()
+            if k.endswith("_AXIS")}
+
+
+class _Unresolvable(Exception):
+    def __init__(self, node: ast.AST):
+        self.node = node
+
+
+def _table_assigns(tree: ast.Module) -> Dict[str, ast.Call]:
+    """NAME -> ShardingRules(...) call for module-level table
+    assignments, so `VIT_RULES.rules` splices resolve."""
+    out: Dict[str, ast.Call] = {}
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name) and \
+                isinstance(node.value, ast.Call) and \
+                last_name(node.value.func) == "ShardingRules":
+            out[node.targets[0].id] = node.value
+    return out
+
+
+def _rules_arg(call: ast.Call) -> Optional[ast.AST]:
+    for kw in call.keywords:
+        if kw.arg == "rules":
+            return kw.value
+    if len(call.args) >= 2:  # ShardingRules(name, rules, ...)
+        return call.args[1]
+    return None
+
+
+def _resolve_rule_pairs(node: ast.AST, tables: Dict[str, ast.Call],
+                        depth: int = 0) -> List[Tuple[ast.AST, ast.AST]]:
+    """-> [(pattern_node, spec_node), ...] with table-reference and
+    tuple-concatenation splicing; raises _Unresolvable at anything
+    the AST cannot prove."""
+    if depth > 8:
+        raise _Unresolvable(node)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        pairs = []
+        for elt in node.elts:
+            if isinstance(elt, (ast.Tuple, ast.List)) and \
+                    len(elt.elts) == 2:
+                pairs.append((elt.elts[0], elt.elts[1]))
+            else:
+                raise _Unresolvable(elt)
+        return pairs
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
+        return (_resolve_rule_pairs(node.left, tables, depth + 1)
+                + _resolve_rule_pairs(node.right, tables, depth + 1))
+    # VIT_RULES.rules — splice another curated table
+    if isinstance(node, ast.Attribute) and node.attr == "rules" and \
+            isinstance(node.value, ast.Name) and \
+            node.value.id in tables:
+        inner = _rules_arg(tables[node.value.id])
+        if inner is None:
+            raise _Unresolvable(node)
+        return _resolve_rule_pairs(inner, tables, depth + 1)
+    raise _Unresolvable(node)
+
+
+def _spec_axes(spec: ast.AST,
+               consts: Dict[str, str]) -> Optional[List[str]]:
+    """Axis names a spec literal uses; None when the spec is not a
+    literal tuple of None/str/axis-constant entries."""
+    if not isinstance(spec, (ast.Tuple, ast.List)):
+        return None
+    axes: List[str] = []
+    for entry in spec.elts:
+        if isinstance(entry, ast.Constant) and entry.value is None:
+            continue
+        s = _resolve_str(entry, consts)
+        if s is not None:
+            axes.append(s)
+            continue
+        if isinstance(entry, (ast.Tuple, ast.List)):
+            for sub in entry.elts:
+                s = _resolve_str(sub, consts)
+                if s is None:
+                    return None
+                axes.append(s)
+            continue
+        return None
+    return axes
+
+
+def _import_aliases(tree: ast.Module) -> Dict[str, str]:
+    """`from deep_vision_tpu.parallel.mesh import DATA_AXIS as D` ->
+    {'D': 'DATA_AXIS'} (identity when unaliased)."""
+    out: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            for alias in node.names:
+                out[alias.asname or alias.name] = alias.name
+    return out
+
+
+@functools.lru_cache(maxsize=4)
+def _mesh_axis_constants(mesh_path: Optional[str] = None) -> Dict[str, str]:
+    path = mesh_path or os.path.join(
+        _REPO_ROOT, "deep_vision_tpu", "parallel", "mesh.py")
+    try:
+        with open(path, encoding="utf-8") as f:
+            tree = ast.parse(f.read(), filename=path)
+    except (OSError, SyntaxError):
+        return {}
+    return {k: v for k, v in _module_str_constants(tree).items()
+            if k.endswith("_AXIS")}
+
+
+def check_dv205(ctx) -> List[Finding]:
+    out: List[Finding] = []
+    tables = _table_assigns(ctx.tree)
+    # names usable inside specs: module string constants plus imported
+    # mesh axis constants (DATA_AXIS/MODEL_AXIS), resolved to their
+    # declared values
+    consts = dict(_module_str_constants(ctx.tree))
+    axis_consts = _mesh_axis_constants()
+    for local, imported in _import_aliases(ctx.tree).items():
+        if imported in axis_consts:
+            consts[local] = axis_consts[imported]
+    declared = _mesh_axes()
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call) or \
+                last_name(node.func) != "ShardingRules":
+            continue
+        rules = _rules_arg(node)
+        if rules is None:
+            continue  # ShardingRules() with no rules refuses at runtime
+        try:
+            pairs = _resolve_rule_pairs(rules, tables)
+        except _Unresolvable as e:
+            out.append(_find(
+                ctx, "DV205", e.node,
+                "sharding table rules are not statically resolvable — "
+                "tables are audited artifacts: literal (pattern, spec) "
+                "tuples (concatenation of other curated tables' "
+                "`.rules` is fine)"))
+            continue
+        last_pattern = None
+        for pat_node, spec_node in pairs:
+            pat = _resolve_str(pat_node, consts)
+            if pat is None:
+                out.append(_find(
+                    ctx, "DV205", pat_node,
+                    "sharding rule pattern is not a string literal — "
+                    "a pattern that cannot be read cannot be "
+                    "reviewed"))
+                continue
+            last_pattern = pat
+            axes = _spec_axes(spec_node, consts)
+            if axes is None:
+                out.append(_find(
+                    ctx, "DV205", spec_node,
+                    f"rule {pat!r}: spec is not a literal tuple of "
+                    "None/axis-name entries"))
+                continue
+            if declared:
+                for axis in axes:
+                    if axis not in declared:
+                        out.append(_find(
+                            ctx, "DV205", spec_node,
+                            f"rule {pat!r} names mesh axis {axis!r} "
+                            "but parallel/mesh.py declares only "
+                            f"{sorted(declared)} — an unknown axis "
+                            "refuses at resolve time on every mesh"))
+        if pairs and last_pattern is not None and last_pattern != "*":
+            out.append(_find(
+                ctx, "DV205", node,
+                f"sharding table has no trailing catch-all: the last "
+                f"rule is {last_pattern!r}, not '*' — a leaf no rule "
+                "covers must be a decision, not an accident"))
+    return out
+
+
+# -- registry -----------------------------------------------------------------
+
+DIST_RULES = {
+    "DV201": ("hardcoded-platform-check", "error", check_dv201,
+              "platform string comparison outside the core/backend.py "
+              "registry"),
+    "DV202": ("unbounded-collective", "error", check_dv202,
+              "raw multihost collective outside the deadline-bounded "
+              "multihost/rendezvous wrappers"),
+    "DV203": ("unregistered-env-knob", "error", check_dv203,
+              "DVT_* env read bypassing (or missing from) the "
+              "core/knobs.py registry"),
+    "DV204": ("journal-schema-drift", "error", check_dv204,
+              "journal event type without a check_journal --strict "
+              "schema"),
+    "DV205": ("pspec-table-hygiene", "error", check_dv205,
+              "sharding table with non-literal patterns, missing "
+              "catch-all, or unknown mesh axis"),
+}

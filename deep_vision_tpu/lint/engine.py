@@ -175,10 +175,12 @@ def iter_python_files(paths: Iterable[str],
 def lint_paths(paths: Iterable[str], root: Optional[str] = None,
                select: Optional[Iterable[str]] = None,
                disable: Optional[Iterable[str]] = None,
-               exclude: Iterable[str] = ()):
+               exclude: Iterable[str] = (),
+               cache=None):
     """-> (findings, suppressed, n_files). Paths in findings are relative
     to `root` (default cwd) with forward slashes, so baselines are
-    machine-portable."""
+    machine-portable. `cache` (lint/cache.py LintCache) short-circuits
+    files whose (content, rule-pack) key already has a verdict."""
     root = os.path.abspath(root or os.getcwd())
     findings: List[Finding] = []
     suppressed: List[Finding] = []
@@ -202,8 +204,14 @@ def lint_paths(paths: Iterable[str], root: Optional[str] = None,
             findings.append(Finding("DV000", f"unreadable: {e}", rel, 0, 0,
                                     "error"))
             continue
-        kept, dropped = lint_source(source, rel, select=select,
-                                    disable=disable)
+        cached = cache.get(rel, source) if cache is not None else None
+        if cached is not None:
+            kept, dropped = cached
+        else:
+            kept, dropped = lint_source(source, rel, select=select,
+                                        disable=disable)
+            if cache is not None:
+                cache.put(rel, source, kept, dropped)
         findings.extend(kept)
         suppressed.extend(dropped)
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.code))

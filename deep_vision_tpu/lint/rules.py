@@ -804,3 +804,10 @@ RULES = {
 from deep_vision_tpu.lint.concur import CONCUR_RULES  # noqa: E402
 
 RULES.update(CONCUR_RULES)
+
+# the DV2xx distributed-correctness pack (lint/distlint.py): platform
+# registry, bounded collectives, env-knob registry, journal schemas,
+# sharding-table hygiene. Same cycle-free import shape as concur.
+from deep_vision_tpu.lint.distlint import DIST_RULES  # noqa: E402
+
+RULES.update(DIST_RULES)

@@ -34,6 +34,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from deep_vision_tpu.core.backend import get_backend
 from deep_vision_tpu.models import register_model
 # the flash routing floor lives with the kernel (shared by this backbone
 # and parallel/ring_attention.py); re-exported here for the historical
@@ -61,7 +62,7 @@ class Attention(nn.Module):
         # (flash_attention.py asserts it), so the guard is t % 1024 == 0 —
         # t % 128 alone would admit 1280/1536-token inputs the kernel rejects
         use_flash = (
-            jax.default_backend() == "tpu"
+            get_backend().pallas_compiled
             and t >= flash_min_tokens()
             and t % 1024 == 0
         )

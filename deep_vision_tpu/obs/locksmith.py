@@ -50,14 +50,15 @@ check instead.
 """
 from __future__ import annotations
 
-import os
 import threading
 import time
 import traceback
 from collections import deque
 from typing import Dict, List, Optional
 
-#: env switch for subprocess runs (chaos-smoke children): any non-empty
+from deep_vision_tpu.core import knobs
+
+#: env switch for subprocess runs (chaos-smoke children): a true flag
 #: value arms at train_cli startup; thresholds override the defaults
 ENV_ARM = "DVT_LOCKSMITH"
 ENV_HOLD_MS = "DVT_LOCKSMITH_HOLD_MS"
@@ -232,6 +233,10 @@ class Sanitizer:
                 except IndexError:
                     break
                 try:
+                    # deferred-flush plumbing: every row was enqueued by
+                    # _queue_row with a literal typed event
+                    # (lock_order_violation / lock_contention)
+                    # jaxlint: disable=DV204 -- typed at _queue_row sites
                     self.journal.write(event, **fields)
                 except Exception:
                     pass  # the sanitizer must never kill what it watches
@@ -447,12 +452,14 @@ def arm(journal=None, registry=None, hold_ms: float = DEFAULT_HOLD_MS,
 
 def arm_from_env(journal=None, registry=None) -> Optional[Sanitizer]:
     """Arm when DVT_LOCKSMITH is set (subprocess smoke runs); no-op and
-    None otherwise."""
-    if not os.environ.get(ENV_ARM):
+    None otherwise. Threshold knobs follow the mistype-raises
+    convention: DVT_LOCKSMITH_HOLD_MS=soon must fail loudly here, not
+    silently sanitize with a garbage threshold (or crash later)."""
+    if not knobs.get_flag(ENV_ARM):
         return None
     return arm(journal=journal, registry=registry,
-               hold_ms=float(os.environ.get(ENV_HOLD_MS, DEFAULT_HOLD_MS)),
-               wait_ms=float(os.environ.get(ENV_WAIT_MS, DEFAULT_WAIT_MS)))
+               hold_ms=knobs.get_float(ENV_HOLD_MS, DEFAULT_HOLD_MS),
+               wait_ms=knobs.get_float(ENV_WAIT_MS, DEFAULT_WAIT_MS))
 
 
 def disarm() -> None:

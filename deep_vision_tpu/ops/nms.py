@@ -18,12 +18,13 @@ forces either (the disable flag for a suspicious-decode triage).
 """
 from __future__ import annotations
 
-import os
 from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 
+from deep_vision_tpu.core import backend as dvt_backend
+from deep_vision_tpu.core import knobs
 from deep_vision_tpu.ops.boxes import broadcast_iou
 
 
@@ -32,15 +33,13 @@ def _resolve_impl(impl: Optional[str]) -> str:
         return impl
     if impl not in (None, "auto"):
         raise ValueError(f"unknown NMS impl {impl!r} (lax|pallas|auto)")
-    env = os.environ.get("DVT_NMS_IMPL")
+    # the disable flag exists for triage — a typo ('LAX', trailing
+    # space) silently running the suspect kernel defeats it, so the
+    # choice knob raises on anything but lax|pallas
+    env = knobs.get_choice("DVT_NMS_IMPL")
     if env:
-        if env not in ("lax", "pallas"):
-            # the disable flag exists for triage — a typo ('LAX', trailing
-            # space) silently running the suspect kernel defeats it
-            raise ValueError(
-                f"DVT_NMS_IMPL={env!r} is not 'lax' or 'pallas'")
         return env
-    return "pallas" if jax.default_backend() == "tpu" else "lax"
+    return dvt_backend.default_nms_impl()
 
 
 def _nms_single(boxes, scores, max_detections: int, iou_threshold: float,

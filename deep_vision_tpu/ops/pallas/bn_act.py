@@ -34,7 +34,6 @@ bench A/B and a suspicious-numerics triage reach for).
 from __future__ import annotations
 
 import functools
-import os
 from typing import Optional
 
 import jax
@@ -42,18 +41,22 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from deep_vision_tpu.core import backend as dvt_backend
+from deep_vision_tpu.core import knobs
+
 _LANES = 128
 _BLOCK_ROWS = 256  # rows of the (R, C) view per grid step
 
 
 def fusion_enabled() -> bool:
-    """Should the fused Pallas path run? TPU: yes unless DVT_PALLAS_FUSED=0;
-    elsewhere: only if DVT_PALLAS_FUSED=1 (tests force it; the default CPU
-    path keeps the exact pre-kernel arithmetic so goldens never drift)."""
-    env = os.environ.get("DVT_PALLAS_FUSED")
-    if env is not None:
-        return env not in ("0", "false", "off")
-    return jax.default_backend() == "tpu"
+    """Should the fused Pallas path run? Pallas-compiled backends: yes
+    unless DVT_PALLAS_FUSED=0; elsewhere: only if DVT_PALLAS_FUSED=1
+    (tests force it; the default CPU path keeps the exact pre-kernel
+    arithmetic so goldens never drift)."""
+    forced = knobs.get_flag("DVT_PALLAS_FUSED")
+    if forced is not None:
+        return forced
+    return dvt_backend.get_backend().pallas_compiled
 
 
 def reference_scale_bias_act(x, scale, bias, residual=None,
@@ -205,7 +208,7 @@ def fused_scale_bias_act(x, scale, bias, residual=None,
     if act not in ("relu", None):
         raise ValueError(f"unsupported act {act!r}")
     if interpret is None:
-        interpret = jax.default_backend() != "tpu"
+        interpret = dvt_backend.pallas_interpret()
     c = x.shape[-1]
     if scale.shape != (c,) or bias.shape != (c,):
         raise ValueError(

@@ -35,13 +35,15 @@ come from that sweep.
 from __future__ import annotations
 
 import functools
-import os
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+
+from deep_vision_tpu.core import backend as dvt_backend
+from deep_vision_tpu.core import knobs
 
 NEG_INF = -1e30
 
@@ -58,18 +60,9 @@ FLASH_MIN_TOKENS = 1024
 
 def flash_min_tokens() -> int:
     """The routing floor, env-overridable; a mistyped value raises
-    instead of silently running the default."""
-    env = os.environ.get("DVT_FLASH_MIN_TOKENS")
-    if env is None:
-        return FLASH_MIN_TOKENS
-    try:
-        return int(env)
-    except ValueError:
-        raise ValueError(
-            f"DVT_FLASH_MIN_TOKENS={env!r} is not an integer token count "
-            f"(default {FLASH_MIN_TOKENS}; lower routes shorter sequences "
-            "onto the flash kernel, higher keeps them on the dense einsum)"
-        ) from None
+    instead of silently running the default (knobs.get_int)."""
+    env = knobs.get_int("DVT_FLASH_MIN_TOKENS", default=None)
+    return FLASH_MIN_TOKENS if env is None else env
 
 
 def _causal_mask(s, qi, ki, block_q, block_k):
@@ -438,7 +431,7 @@ def flash_attention_with_lse(
     if scale is None:
         scale = q.shape[-1] ** -0.5
     if interpret is None:
-        interpret = jax.default_backend() != "tpu"
+        interpret = dvt_backend.pallas_interpret()
     return _flash_lse(q, k, v, causal, float(scale), int(block_q),
                       int(block_k), bool(interpret))
 
@@ -460,6 +453,6 @@ def flash_attention(
     if scale is None:
         scale = q.shape[-1] ** -0.5
     if interpret is None:
-        interpret = jax.default_backend() != "tpu"
+        interpret = dvt_backend.pallas_interpret()
     return _flash(q, k, v, causal, float(scale), int(block_q), int(block_k),
                   bool(interpret))

@@ -30,6 +30,8 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
+from deep_vision_tpu.core import backend as dvt_backend
+
 _LANES = 128
 
 
@@ -93,7 +95,7 @@ def pallas_nms(boxes, scores, max_detections: int, iou_threshold: float,
     offset trick, gathers of boxes/classes stay outside the kernel.
     """
     if interpret is None:
-        interpret = jax.default_backend() != "tpu"
+        interpret = dvt_backend.pallas_interpret()
     b, n, _ = boxes.shape
     np_ = _round_up(max(n, 1), _LANES)
     dp = _round_up(max(max_detections, 1), _LANES)

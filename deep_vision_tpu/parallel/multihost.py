@@ -30,14 +30,15 @@ from typing import Optional, Tuple
 import jax
 import numpy as np
 
+from deep_vision_tpu.core import knobs
 from deep_vision_tpu.parallel.mesh import MeshSpec, create_mesh
 from deep_vision_tpu.resilience.rendezvous import HostLostError, WorldView
 
 #: ceiling for the raw-jax-collective fallback path (no rendezvous
 #: installed): a barrier blocked past this is declared a lost peer. The
 #: rendezvous path detects in ~a lease (seconds); this is the backstop.
-DEFAULT_COLLECTIVE_DEADLINE_S = float(
-    os.environ.get("DVT_COLLECTIVE_DEADLINE_S", "600"))
+DEFAULT_COLLECTIVE_DEADLINE_S = knobs.get_float(
+    "DVT_COLLECTIVE_DEADLINE_S")
 
 # -- the installable world view (resilience/rendezvous.py) --------------------
 

@@ -193,9 +193,10 @@ def _default_use_flash(t_loc: int) -> bool:
     `t % block == 0` — a lowered floor must route a 768-token shard to
     the dense body, not into the kernel's shape assert (the same
     `t % 1024 == 0` guard models/vit.py keeps)."""
+    from deep_vision_tpu.core.backend import get_backend
     from deep_vision_tpu.ops.pallas.flash_attention import flash_min_tokens
 
-    return (jax.default_backend() == "tpu"
+    return (get_backend().pallas_compiled
             and t_loc >= flash_min_tokens()
             and t_loc % 1024 == 0)
 

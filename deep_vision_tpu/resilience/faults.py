@@ -49,6 +49,8 @@ import sys
 import threading
 from typing import List, Optional
 
+from deep_vision_tpu.core import knobs
+
 ENV_SPEC = "DVT_FAULT_SPEC"
 ENV_SEED = "DVT_FAULT_SEED"
 
@@ -300,10 +302,12 @@ def transform(point: str, data: bytes) -> bytes:
 
 
 # spawned worker processes inherit the spec through the environment
-if os.environ.get(ENV_SPEC):
+if knobs.get_str(ENV_SPEC):
     try:
-        install_spec(os.environ[ENV_SPEC],
-                     seed=int(os.environ.get(ENV_SEED, "0") or "0"),
+        install_spec(knobs.get_str(ENV_SPEC),
+                     seed=knobs.get_int(ENV_SEED),
                      export_env=False)
-    except FaultSpecError as e:  # a bad env spec must not break imports
+    # a bad env spec/seed must not break imports (KnobError: garbage
+    # DVT_FAULT_SEED — loud in the parent that exported it, ignored here)
+    except (FaultSpecError, knobs.KnobError) as e:
         sys.stderr.write(f"faults: ignoring {ENV_SPEC}: {e}\n")

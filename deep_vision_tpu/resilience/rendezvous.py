@@ -57,6 +57,8 @@ import threading
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
+from deep_vision_tpu.core import knobs
+
 #: journal event kinds this layer emits (tools/check_journal.py --strict
 #: enforces the schemas; obs/README.md documents them)
 EVENT_HOST_LOST = "host_lost"
@@ -618,8 +620,7 @@ class Rendezvous:
         self._joined_ts = getattr(self, "_joined_ts", time.time())
         self.start_heartbeat()
         if generation is None:
-            env = os.environ.get(ENV_GENERATION)
-            generation = int(env) if env else None
+            generation = knobs.get_int(ENV_GENERATION)
         rec = (self.read_generation(generation) if generation is not None
                else self.latest_generation())
         if rec is None:

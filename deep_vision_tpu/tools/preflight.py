@@ -66,6 +66,7 @@ import time
 from dataclasses import asdict, dataclass
 from typing import Callable, List, Optional, Tuple
 
+from deep_vision_tpu.core import knobs
 from deep_vision_tpu.resilience.elastic import (
     KIND_VERSION_SKEW,
     backend_alive,
@@ -74,7 +75,7 @@ from deep_vision_tpu.resilience.elastic import (
 #: default probe budget: a healthy backend answers a trivial op in
 #: milliseconds (CPU) to ~a second (cold TPU client); a dead tunnel never
 #: does. Env-overridable for slow relays (DVT_PREFLIGHT_BUDGET_S).
-DEFAULT_BUDGET_S = float(os.environ.get("DVT_PREFLIGHT_BUDGET_S", "60"))
+DEFAULT_BUDGET_S = knobs.get_float("DVT_PREFLIGHT_BUDGET_S")
 
 
 @dataclass
@@ -272,6 +273,36 @@ def check_excache(path: str) -> CheckResult:
         f"({n} cached entr{'y' if n == 1 else 'ies'})")
 
 
+def check_sharding_tables() -> CheckResult:
+    """Device-free semantic audit of the curated sharding tables
+    (tools/shard_check.py): every family's table must still clear its
+    coverage floor against an abstract eval_shape state tree. The
+    108 -> 34 MULTICHIP coverage regression fails HERE, before any
+    mesh is built or a single byte is compiled."""
+    from deep_vision_tpu.tools.shard_check import FAMILIES, check_family
+
+    fails: List[str] = []
+    summary: List[str] = []
+    for family in FAMILIES:
+        try:
+            report = check_family(family)
+        except Exception as e:  # a broken table is a FAIL line, never a
+            # traceback breaking preflight's exit-0/1 contract
+            fails.append(f"{family}: {type(e).__name__}: {e}")
+            continue
+        summary.append(f"{family} {report['sharded']}/{report['min_sharded']}")
+        if not report["ok"]:
+            reasons = report["errors"] or [
+                f"coverage {report['sharded']} < floor "
+                f"{report['min_sharded']}"]
+            fails.append(f"{family}: {reasons[0]}")
+    if fails:
+        return CheckResult("sharding_tables", False, "; ".join(fails))
+    return CheckResult(
+        "sharding_tables", True,
+        "coverage floors hold abstractly (" + ", ".join(summary) + ")")
+
+
 def host_versions() -> dict:
     """This host's side of the join-time version exchange: the jax/jaxlib
     client pair plus the backend's platform_version string (on TPU, the
@@ -353,6 +384,7 @@ def run_preflight(data: int = -1, model: int = 1,
                   rendezvous_dir: Optional[str] = None,
                   host_id: Optional[str] = None,
                   excache_dir: Optional[str] = None,
+                  shard_tables: bool = True,
                   journal=None) -> Tuple[bool, List[CheckResult]]:
     """Run every applicable check; returns (all_ok, results).
 
@@ -375,6 +407,10 @@ def run_preflight(data: int = -1, model: int = 1,
 
         run(check_mesh_shape, len(jax.devices()), data=data, model=model,
             expect_devices=expect_devices)
+    if shard_tables:
+        # device-free (pure eval_shape): runs even when the backend
+        # probe failed — a gutted table is reportable regardless
+        run(check_sharding_tables)
     if ckpt_dir:
         run(check_ckpt_dir, ckpt_dir)
     if excache_dir and backend.ok:
@@ -434,6 +470,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                    help="also probe this persistent executable-cache dir "
                         "(writability, AOT round-trip, stale-entry "
                         "refusal — core/excache.py)")
+    p.add_argument("--no-shard-check", action="store_true",
+                   help="skip the device-free sharding-table audit "
+                        "(tools/shard_check.py)")
     p.add_argument("--budget", type=float, default=DEFAULT_BUDGET_S,
                    help="seconds the backend probe may take before the "
                         "tunnel is declared dead")
@@ -446,6 +485,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         budget_s=args.budget, expect_hosts=args.expect_hosts,
         rendezvous_dir=args.rendezvous_dir, host_id=args.host_id,
         excache_dir=args.excache,
+        shard_tables=not args.no_shard_check,
     )
     render(results)
     if args.json:

@@ -1,0 +1,242 @@
+"""Offline semantic validation of the curated sharding tables.
+
+DV205 (lint/distlint.py) proves a ShardingRules table is WELL-FORMED —
+literal patterns, trailing catch-all, declared axes — without running
+anything. This checker proves the table still FITS its model family:
+it builds each family's train state as a pure abstract tree
+(`jax.eval_shape` over `create_train_state` with ShapeDtypeStruct
+inputs — zero device arrays materialized, zero XLA compiles) and
+replays the table's first-match-wins resolution over every leaf:
+
+  - coverage floor: at least `min_sharded` float leaves actually shard
+    on a nominal mesh (the 108 -> 34 MULTICHIP regression, caught
+    before any hardware — a gutted table fails HERE);
+  - first-match shadowing: rules that match leaves but never FIRST
+    (dead by ordering — the rule above them claims every leaf);
+  - dead patterns: non-catch-all rules that match no leaf of the
+    family's tree at all (the table went stale against the model).
+
+Shadowed/dead rules are reported (the table may deliberately carry
+rules for model variants this tiny config does not instantiate);
+coverage-floor violations and resolution errors fail.
+
+Runs standalone (`python tools/shard_check.py`), inside `make lint`,
+and as the `check_sharding_tables` preflight rung. Writes nothing to
+disk; needs no mesh, no devices, no TPU.
+"""
+from __future__ import annotations
+
+import argparse
+import fnmatch
+import json
+import sys
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+#: nominal mesh-axis sizes resolution is audited against: the smallest
+#: shape where both axes are real (the mesh4x2 test topology). A dim an
+#: axis of size 2 cannot divide will not divide size 4 or 8 either.
+NOMINAL_MESH = {"data": 4, "model": 2}
+
+FAMILIES = ("vit", "moe", "resnet")
+
+
+def _abstract_state(family: str):
+    """The family's tiny train state as a tree of ShapeDtypeStruct —
+    built entirely under `jax.eval_shape`, so nothing compiles and no
+    device buffer is ever allocated."""
+    import jax
+
+    from deep_vision_tpu.core.train_state import create_train_state
+    from deep_vision_tpu.train.optimizers import build_optimizer
+
+    if family in ("vit", "moe"):
+        from deep_vision_tpu.models.vit import ViT
+
+        model = ViT(depth=2, dim=16, num_heads=2, patch=8, num_classes=8,
+                    num_experts=2 if family == "moe" else 0)
+        sample = jax.ShapeDtypeStruct((2, 16, 16, 3), np.float32)
+    elif family == "resnet":
+        from deep_vision_tpu.models.resnet import BottleneckBlock, ResNet
+
+        model = ResNet(stage_sizes=(1, 1, 1, 1), block=BottleneckBlock,
+                       width=16, num_classes=64)
+        sample = jax.ShapeDtypeStruct((2, 32, 32, 3), np.float32)
+    else:
+        raise ValueError(f"unknown family {family!r} (one of {FAMILIES})")
+    tx = build_optimizer("sgd", learning_rate=0.05, momentum=0.9)
+    return jax.eval_shape(
+        lambda s: create_train_state(model, tx, s), sample)
+
+
+def check_table(rules, state,
+                mesh_axes: Optional[Dict[str, int]] = None) -> dict:
+    """Replay first-match resolution over an abstract state tree.
+
+    -> report dict: {table, float_leaves, sharded, min_sharded,
+    floor_ok, unmatched, unmatched_paths, rules: {pattern:
+    {first, any}}, shadowed, dead, dropped_dims, ok}.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from deep_vision_tpu.parallel.shardmap import leaf_path, normalize_path
+
+    mesh_axes = dict(mesh_axes or NOMINAL_MESH)
+    pats = [pat for pat, _ in rules.rules]
+    stats = {pat: {"first": 0, "any": 0} for pat in pats}
+    catch_all = pats[-1]
+    report = {
+        "table": rules.name,
+        "mesh": mesh_axes,
+        "float_leaves": 0,
+        "sharded": 0,
+        "replicated": 0,
+        "min_sharded": int(rules.min_sharded),
+        "unmatched": 0,
+        "unmatched_paths": [],
+        "dropped_dims": [],
+        "errors": [],
+    }
+
+    leaves = jax.tree_util.tree_flatten_with_path(state)[0]
+    for key_path, leaf in leaves:
+        path = leaf_path(key_path)
+        norm = normalize_path(path)
+        matches = [pat for pat in pats
+                   if fnmatch.fnmatchcase(norm, pat)]
+        if not matches:  # unreachable with a constructed table
+            report["errors"].append(f"no rule matched {norm!r}")
+            continue
+        for pat in matches:
+            stats[pat]["any"] += 1
+        first = matches[0]
+        stats[first]["first"] += 1
+        spec = dict(rules.rules)[first]
+        shape = tuple(getattr(leaf, "shape", ()))
+        if len(spec) > len(shape):
+            report["errors"].append(
+                f"rule {first!r}: spec {spec!r} has {len(spec)} entries "
+                f"but leaf {path} has rank {len(shape)}")
+            continue
+        # mirror ShardingRules._entry_for: unknown axis refuses, an
+        # axis product that does not divide the dim drops (replicates)
+        sharded_dims = 0
+        for d, entry in enumerate(spec):
+            if entry is None:
+                continue
+            axes = (entry,) if isinstance(entry, str) else tuple(entry)
+            unknown = [a for a in axes if a not in mesh_axes]
+            if unknown:
+                report["errors"].append(
+                    f"rule {first!r}: unknown mesh axis {unknown[0]!r} "
+                    f"at leaf {path}")
+                continue
+            size = int(np.prod([mesh_axes[a] for a in axes]))
+            if size <= 1:
+                continue
+            if int(shape[d]) % size != 0:
+                report["dropped_dims"].append(
+                    {"path": path, "rule": first, "dim": int(shape[d]),
+                     "axes": list(axes)})
+                continue
+            sharded_dims += 1
+        dtype = getattr(leaf, "dtype", None)
+        if dtype is not None and jnp.issubdtype(dtype, jnp.floating):
+            report["float_leaves"] += 1
+            if first == catch_all:
+                report["unmatched"] += 1
+                report["unmatched_paths"].append(path)
+            if sharded_dims:
+                report["sharded"] += 1
+            else:
+                report["replicated"] += 1
+
+    report["rules"] = stats
+    report["shadowed"] = [pat for pat in pats[:-1]
+                          if stats[pat]["any"] and not stats[pat]["first"]]
+    report["dead"] = [pat for pat in pats[:-1] if not stats[pat]["any"]]
+    report["floor_ok"] = report["sharded"] >= report["min_sharded"]
+    report["ok"] = report["floor_ok"] and not report["errors"]
+    return report
+
+
+def check_family(family: str,
+                 mesh_axes: Optional[Dict[str, int]] = None,
+                 rules=None) -> dict:
+    """Build the family's abstract state and audit its curated table
+    (or an injected `rules` — the gutted-table test path)."""
+    from deep_vision_tpu.parallel.shardmap import FAMILY_RULES
+
+    table = rules if rules is not None else FAMILY_RULES[family]
+    report = check_table(table, _abstract_state(family),
+                         mesh_axes=mesh_axes)
+    report["family"] = family
+    return report
+
+
+def render_report(report: dict) -> str:
+    line = (f"shard_check[{report['family']}]: "
+            f"{'PASS' if report['ok'] else 'FAIL'} "
+            f"{report['sharded']}/{report['min_sharded']} sharded "
+            f"(float_leaves={report['float_leaves']}, "
+            f"unmatched={report['unmatched']}, "
+            f"dropped_dims={len(report['dropped_dims'])})")
+    extra = []
+    for err in report["errors"]:
+        extra.append(f"  error: {err}")
+    for pat in report["shadowed"]:
+        extra.append(f"  shadowed (never first match): {pat!r}")
+    for pat in report["dead"]:
+        extra.append(f"  dead (matches no leaf): {pat!r}")
+    if not report["floor_ok"]:
+        extra.append(
+            f"  coverage floor violated: {report['sharded']} < "
+            f"{report['min_sharded']} — the table no longer fits the "
+            "model (the 108->34 regression shape)")
+    return "\n".join([line] + extra)
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python tools/shard_check.py",
+        description="device-free semantic audit of the curated "
+                    "VIT/MOE/RESNET sharding tables",
+    )
+    p.add_argument("--family", default=None, choices=FAMILIES,
+                   help="audit one family (default: all)")
+    p.add_argument("--format", choices=("human", "json"),
+                   default="human")
+    args = p.parse_args(argv)
+
+    families = (args.family,) if args.family else FAMILIES
+    reports: List[dict] = []
+    failed = False
+    for family in families:
+        try:
+            report = check_family(family)
+        except Exception as e:  # never a traceback: a broken table is
+            # a finding, not a crash
+            report = {"family": family, "ok": False,
+                      "errors": [f"{type(e).__name__}: {e}"],
+                      "sharded": 0, "min_sharded": 0, "float_leaves": 0,
+                      "unmatched": 0, "dropped_dims": [],
+                      "shadowed": [], "dead": [], "floor_ok": False}
+        reports.append(report)
+        failed = failed or not report["ok"]
+
+    if args.format == "json":
+        print(json.dumps({"version": 1, "reports": reports,
+                          "failed": failed}, indent=2, default=str))
+    else:
+        for report in reports:
+            print(render_report(report))
+        tail = (f"shard_check: {len(reports)} table(s), "
+                f"{'FAIL' if failed else 'ok'}")
+        print(tail, file=sys.stderr if failed else sys.stdout)
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

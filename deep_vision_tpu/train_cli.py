@@ -642,14 +642,15 @@ def _make_telemetry(args, journal, flight, discovery_dir,
     telemetry plane must never kill the run it observes."""
     port = args.telemetry_port
     if port is None:
-        env = os.environ.get("DVT_TELEMETRY", "").strip()
-        if env:
-            try:
-                port = int(env)
-            except ValueError:
-                print(f"warning: DVT_TELEMETRY={env!r} is not a port; "
-                      "telemetry disabled", file=_sys.stderr)
-                return None
+        from deep_vision_tpu.core import knobs
+
+        try:
+            port = knobs.get_int("DVT_TELEMETRY")
+        except knobs.KnobError as e:
+            # degrade, don't raise: the telemetry plane must never kill
+            # the run it observes — not even at parse time
+            print(f"warning: {e}; telemetry disabled", file=_sys.stderr)
+            return None
     if port is None:
         return None
     from deep_vision_tpu.obs.registry import get_registry
@@ -993,9 +994,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     # persistent compilation cache installed BEFORE anything compiles
     # (preflight's probe op would otherwise be the first, uncached one)
     if not args.executable_cache:
+        from deep_vision_tpu.core import knobs
         from deep_vision_tpu.core.excache import EXCACHE_ENV
 
-        args.executable_cache = os.environ.get(EXCACHE_ENV) or None
+        args.executable_cache = knobs.get_str(EXCACHE_ENV)
     if args.executable_cache:
         from deep_vision_tpu.core.excache import install_jax_compilation_cache
 

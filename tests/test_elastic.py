@@ -367,7 +367,8 @@ class TestPreflight:
                                        budget_s=60.0, journal=j)
         assert ok, [(r.name, r.detail) for r in results if not r.ok]
         assert [r.name for r in results] == [
-            "client_versions", "backend", "mesh_shape", "ckpt_dir"]
+            "client_versions", "backend", "mesh_shape",
+            "sharding_tables", "ckpt_dir"]
         assert any(r["event"] == "note" and r.get("note") == "preflight"
                    for r in j.rows)
 
@@ -377,7 +378,8 @@ class TestPreflight:
         def dead():
             raise RuntimeError("socket closed: UNAVAILABLE")
 
-        ok, results = pf.run_preflight(probe=dead, budget_s=10.0)
+        ok, results = pf.run_preflight(probe=dead, budget_s=10.0,
+                                       shard_tables=False)
         assert not ok
         assert [r.name for r in results] == ["client_versions", "backend"]
 
@@ -387,8 +389,10 @@ class TestPreflight:
         assert pf.main(["--ckpt-dir", str(tmp_path / "ck"), "--json"]) == 0
         line = capsys.readouterr().out.strip().splitlines()[-1]
         doc = json.loads(line)
-        assert doc["ok"] and len(doc["checks"]) == 4
-        assert pf.main(["--expect-devices", "999"]) == 1
+        assert doc["ok"] and len(doc["checks"]) == 5
+        assert "sharding_tables" in [c["name"] for c in doc["checks"]]
+        assert pf.main(["--expect-devices", "999",
+                        "--no-shard-check"]) == 1
 
 
 # -- SIGTERM escalation: checkpoint-now-and-requeue ---------------------------

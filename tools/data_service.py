@@ -101,13 +101,12 @@ def main(argv=None) -> int:
 
     tele_port = args.telemetry_port
     if tele_port is None:
-        env = os.environ.get("DVT_TELEMETRY", "").strip()
-        if env:
-            try:
-                tele_port = int(env)
-            except ValueError:
-                print(f"warning: DVT_TELEMETRY={env!r} is not a port; "
-                      "telemetry disabled", file=sys.stderr)
+        from deep_vision_tpu.core import knobs
+
+        try:
+            tele_port = knobs.get_int("DVT_TELEMETRY")
+        except knobs.KnobError as e:
+            print(f"warning: {e}; telemetry disabled", file=sys.stderr)
     telemetry = None
     if tele_port is not None:
         from deep_vision_tpu.obs.registry import get_registry

@@ -46,6 +46,7 @@ from typing import Dict, List, Optional
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, ROOT)
 
+from deep_vision_tpu.core import knobs  # noqa: E402
 from tools.smoke_util import read_jsonl  # noqa: E402
 
 HOSTS = 3
@@ -71,7 +72,7 @@ DETECT_BOUND_S = 30.0
 def worker_main(args) -> int:
     host = f"h{args.host}"
     workdir = args.workdir
-    if os.environ.get("DVT_HOST_SMOKE_DEBUG"):
+    if knobs.get_flag("DVT_HOST_SMOKE_DEBUG"):
         import faulthandler
 
         faulthandler.dump_traceback_later(
@@ -92,7 +93,7 @@ def worker_main(args) -> int:
         heartbeat_s=HEARTBEAT_S, lease_s=LEASE_S, poll_s=0.02,
         client_version="host-smoke-1",  # identical fleet: handshake passes
     )
-    attached = os.environ.get(ENV_GENERATION) is not None
+    attached = knobs.get_int(ENV_GENERATION) is not None
     if attached:
         view = rdzv.attach(timeout_s=300)
     else:

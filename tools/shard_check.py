@@ -1,0 +1,21 @@
+#!/usr/bin/env python
+"""Standalone entry point for the sharding-table semantic checker.
+
+Thin wrapper over deep_vision_tpu.tools.shard_check so the audit can
+run from a checkout without installing the package:
+
+    python tools/shard_check.py [--family vit|moe|resnet] [--format json]
+
+Exit 0: every audited table passes its coverage floor with no
+resolution errors. Exit 1: at least one table failed (gutted table,
+unknown mesh axis, rank-mismatched spec).
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from deep_vision_tpu.tools.shard_check import main  # noqa: E402
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -218,6 +218,7 @@ def _gutted_phase(f: Failures):
             and "ViTBlock" in err,
             "gutted-table failure NAMES the replicated leaf paths")
     try:
+        # jaxlint: disable=DV205 -- deliberately malformed test subject
         ShardingRules(name="bad", rules=(
             ("*.Attention_*.qkv.kernel", (None, None, "model", None)),))
         f.check(False, "missing catch-all refused at construction")
