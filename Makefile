@@ -56,7 +56,7 @@ lint-baseline:
 # obs-smoke and chaos-smoke — the telemetry artifacts must validate and
 # the resilience contracts must hold before the tests count
 verify: SHELL := /bin/bash
-verify: lint preflight perf-smoke obs-smoke chaos-smoke data-smoke host-smoke serve-smoke fleet-smoke cache-smoke shard-smoke perf-gate live-smoke
+verify: lint preflight perf-smoke obs-smoke chaos-smoke data-smoke host-smoke serve-smoke fleet-smoke fleetnet-smoke cache-smoke shard-smoke perf-gate live-smoke
 	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
 
 # environment preflight: backend liveness + libtpu/client version
@@ -114,6 +114,22 @@ serve-smoke:
 # journals pass check_journal --strict; no stray flight bundles
 fleet-smoke:
 	JAX_PLATFORMS=cpu python tools/loadgen.py --workdir artifacts/fleet_smoke
+
+# front-door smoke: the socket transport + process-replica fleet
+# (tools/fleetnet_smoke.py) — N spawned replica PROCESSES (each its
+# own engine, HTTP endpoint, and rendezvous lease) behind the parent's
+# HTTP front door; every replica warms at ZERO backend compiles off
+# the parent-seeded executable cache; a mid-traffic SIGKILL fails only
+# the dead process's in-flight requests (typed ReplicaLost behind
+# retryable 503s) and the respawn rebirths from cache; a canary
+# PROCESS serves shadow weights and promote hot-swaps the whole fleet
+# over /control/promote; an overload blast gets real 429s with
+# Retry-After that a retrying client honors; offered == ok+err+shed
+# holds across client, transport ledger, and journal; strict
+# check_journal on parent + every surviving child journal, with the
+# SIGKILLed incarnation's journal flagged as the forensic record
+fleetnet-smoke:
+	JAX_PLATFORMS=cpu python tools/fleetnet_smoke.py --workdir artifacts/fleetnet_smoke
 
 # cold-path smoke: the persistent executable cache + int8 quantization
 # contracts (tools/cache_smoke.py) — run A compiles and populates the
@@ -252,4 +268,4 @@ ps:
 native:
 	$(MAKE) -C native
 
-.PHONY: train resume train-fg test lint lint-baseline verify preflight obs-smoke chaos-smoke data-smoke host-smoke serve-smoke fleet-smoke cache-smoke shard-smoke perf-gate live-smoke perf-smoke bench bench-evidence roofline demo demo-gan demo-real dryrun tb ps native
+.PHONY: train resume train-fg test lint lint-baseline verify preflight obs-smoke chaos-smoke data-smoke host-smoke serve-smoke fleet-smoke fleetnet-smoke cache-smoke shard-smoke perf-gate live-smoke perf-smoke bench bench-evidence roofline demo demo-gan demo-real dryrun tb ps native

@@ -116,6 +116,14 @@ KNOBS: Dict[str, Knob] = {k.name: k for k in (
     _k("DVT_TELEMETRY", "int", None,
        "Telemetry HTTP port used when --telemetry-port is absent; "
        "0 binds a free port."),
+    _k("DVT_TRANSPORT_DEADLINE_MS", "float", 0.0,
+       "Default request deadline (milliseconds) the serving front door "
+       "(serve/transport.py) applies to requests that carry no "
+       "X-DVT-Deadline-Ms header; 0 means no default deadline."),
+    _k("DVT_TRANSPORT_RETRY_AFTER_MS", "float", 50.0,
+       "Retry-After hint (milliseconds) the front door attaches to 429/"
+       "503 shed responses; the loadgen socket client sleeps exactly "
+       "this before retrying."),
 )}
 
 _UNSET = object()

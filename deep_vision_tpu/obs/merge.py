@@ -157,9 +157,15 @@ def trace_timelines(events: Sequence[dict]) -> List[dict]:
 
     Events without a trace_id (steps, checkpoints, ...) are untraced
     background and simply don't participate. Ordering within a timeline
-    is (ts, parent-before-child) — two hops of one request can share a
-    rounded ts across the wire, and the parent/child span link breaks
-    the tie causally rather than arbitrarily.
+    is causal first, clock second: each hop's effective ts is clamped to
+    max(own ts, parent's effective ts) — journal timestamps are rounded
+    to 1 ms, so a child hop can be stamped in an EARLIER millisecond
+    bucket than its parent (a server journals its reply before the
+    client journals the receipt, and the rounding boundary can fall
+    between the two writes). The parent-link depth then breaks exact
+    ties deterministically (root spans first), so a parent always
+    renders before its children regardless of which side of a rounding
+    boundary their wall clocks landed on.
     """
     by_trace: Dict[str, List[dict]] = {}
     for e in events:
@@ -169,20 +175,32 @@ def trace_timelines(events: Sequence[dict]) -> List[dict]:
     timelines: List[dict] = []
     for tid, hops in by_trace.items():
         parents = {e.get("span_id") for e in hops}
+        by_span = {h.get("span_id"): h for h in hops}
 
-        def depth(e, _parents=parents, _hops=hops):
+        def depth(e, _parents=parents, _by_span=by_span):
             # root spans (parent absent or unknown) sort first at a tie
             p = e.get("parent_span_id")
             d = 0
             seen = set()
-            by_span = {h.get("span_id"): h for h in _hops}
             while p in _parents and p not in seen:
                 seen.add(p)
                 d += 1
-                p = by_span.get(p, {}).get("parent_span_id")
+                p = _by_span.get(p, {}).get("parent_span_id")
             return d
 
-        hops.sort(key=lambda e: (e.get("ts") or 0.0, depth(e)))
+        # the causal clamp: walking in depth order guarantees a hop's
+        # parent has its effective ts settled first (a cycle in the
+        # links caps depth via the seen-set, and the parent lookup then
+        # simply falls back to the hop's own ts)
+        eff: Dict[int, float] = {}
+        for e in sorted(hops, key=depth):
+            ts = e.get("ts") or 0.0
+            parent = by_span.get(e.get("parent_span_id"))
+            if parent is not None and id(parent) in eff:
+                ts = max(ts, eff[id(parent)])
+            eff[id(e)] = ts
+
+        hops.sort(key=lambda e: (eff[id(e)], depth(e)))
         tss = [e["ts"] for e in hops if e.get("ts") is not None]
         timelines.append({
             "trace_id": tid,

@@ -72,6 +72,13 @@ POINTS = (
                       # RetryPolicy must absorb) and its worker body
                       # (env-inherited: crash = a worker process SIGKILLed,
                       # the data_worker_lost/respawn path)
+    "serve.transport",  # the HTTP front door's request boundary
+                      # (serve/transport.py: io_error = mid-frame
+                      # connection reset, corrupt = truncated/garbage
+                      # request body via transform(), crash = the
+                      # serving process dies mid-request) — a torn
+                      # request must fail exactly one response and
+                      # never wedge an acceptor thread
 )
 KINDS = ("io_error", "crash", "crash_after_write", "corrupt")
 

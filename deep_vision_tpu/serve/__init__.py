@@ -65,9 +65,21 @@ from deep_vision_tpu.serve.quantize import (
     quantize_variables,
     quantized_fn,
 )
-from deep_vision_tpu.serve.queue import BatchingQueue, QueueClosed, Request
+from deep_vision_tpu.serve.procpool import ProcReplicaPool
+from deep_vision_tpu.serve.queue import (
+    BatchingQueue,
+    DeadlineExceeded,
+    QueueClosed,
+    Request,
+)
 from deep_vision_tpu.serve.router import Server, ServerClosed
 from deep_vision_tpu.serve.slo import SHED_REASONS, SLOTracker
+from deep_vision_tpu.serve.transport import (
+    DEADLINE_HEADER,
+    STATUS_BY_REASON,
+    TRANSPORT_OUTCOMES,
+    Transport,
+)
 from deep_vision_tpu.serve.swap import SWAP_OUTCOMES, SWAP_PHASES, SwapController
 
 __all__ = [
@@ -77,6 +89,9 @@ __all__ = [
     "Engine",
     "ModelEntry",
     "QuantizationRejected",
+    "DEADLINE_HEADER",
+    "DeadlineExceeded",
+    "ProcReplicaPool",
     "QuantizedModel",
     "QueueClosed",
     "REPLICA_STATES",
@@ -84,6 +99,7 @@ __all__ = [
     "ReplicaPool",
     "Request",
     "SHED_REASONS",
+    "STATUS_BY_REASON",
     "SLOTracker",
     "SWAP_OUTCOMES",
     "SWAP_PHASES",
@@ -92,7 +108,9 @@ __all__ = [
     "ServerClosed",
     "ShedError",
     "SwapController",
+    "TRANSPORT_OUTCOMES",
     "TokenBucket",
+    "Transport",
     "bucket_for",
     "calibrate_and_quantize",
     "normalize_buckets",

@@ -282,7 +282,8 @@ class ReplicaPool:
 
     # -- the front door ------------------------------------------------------
 
-    def submit(self, model: str, image) -> Future:
+    def submit(self, model: str, image,
+               deadline_ms: Optional[float] = None) -> Future:
         """Admit, route, enqueue. Raises ShedError synchronously when
         policy rejects — admission budgets, or the pool draining
         (shutdown is an overload of size infinity: reason `draining`) —
@@ -320,7 +321,8 @@ class ReplicaPool:
                     f"no serving replicas for {model!r} "
                     f"({self.replica_states()})")
             try:
-                fut = slot.server.submit(model, image)
+                fut = slot.server.submit(model, image,
+                                         deadline_ms=deadline_ms)
             except QueueClosed:
                 self._dec_inflight(slot, model)
                 if attempt == 0:

@@ -1,0 +1,846 @@
+"""ProcReplicaPool: the serving fleet as real processes, not threads.
+
+serve/pool.py's replicas share one interpreter — a "crash" there is a
+simulated state flip. This module lifts the same supervision story onto
+spawned PROCESSES (one warmed Engine per process, forced single-device
+CPU worlds in the smokes; per-device on a real mesh), so process death
+is an actual SIGKILL and the recovery claims are load-bearing:
+
+- each replica child runs `_replica_main`: build the engine from a
+  picklable builder, warm through core/excache (a warm cache means
+  ZERO backend compiles — the respawn rebirth is a disk read), start a
+  `serve.Server` + its own `serve/transport.py` HTTP endpoint on
+  127.0.0.1:0, and join the serving generation via
+  `resilience/rendezvous.py` (member lease + heartbeat; the first
+  cohort assembles the generation with `join`, a respawn re-enters it
+  with `attach`);
+- the parent routes requests to replicas over real sockets
+  (`submit(model, image, deadline_ms=) -> Future`, same contract as
+  ReplicaPool, so one Transport fronts either), with admission control
+  at the parent edge and the W3C traceparent riding every proxied hop;
+- death is detected TWICE: connection loss at request time (the dead
+  process's in-flight requests — and only those — fail with a typed,
+  retryable `ReplicaLost`) and lease expiry in the monitor thread (a
+  hung process stops heartbeating and is declared dead without a
+  request having to die first). Both paths journal `replica_lost`,
+  respawn a fresh process (same rid, attempt+1), and journal
+  `replica_recovered` with the child's warmup stats — the smoke
+  asserts `backend_compiles == 0` on the rebirth;
+- `SwapController` drives a canary across PROCESSES unchanged: the
+  parent holds a warmed template engine (`primary_engine()`), the
+  shadow's weights ship to a spawned canary process via a pickle under
+  the run dir, `promote_variables` POSTs `/control/promote` to every
+  base replica (each hot-swaps via `Engine.set_variables`, zero
+  recompiles), and `remove_canary` tears the canary process down.
+
+The parent's ledger holds `accepted == completed + errors + cancelled`
+with sheds and refusals counted beside it (`ledger()`), and each child
+holds the same invariant at its own edge — the fleetnet smoke
+crosschecks client, parent, children, and journal.
+"""
+from __future__ import annotations
+
+import json
+import http.client
+import os
+import pickle
+import threading
+import time
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Callable, Dict, List, Optional
+
+from deep_vision_tpu.obs import locksmith, propagate
+from deep_vision_tpu.serve.admission import ShedError
+from deep_vision_tpu.serve.engine import Engine, ServeError
+from deep_vision_tpu.serve.pool import ReplicaLost
+from deep_vision_tpu.serve.queue import DeadlineExceeded
+from deep_vision_tpu.serve.slo import SLOTracker
+
+READY_SUFFIX = ".ready.json"
+
+#: a replica process's lifecycle states (the thread pool's vocabulary,
+#: minus "warming" being observable only through the ready-file wait)
+PROC_STATES = ("spawning", "serving", "draining", "dead")
+
+
+def _atomic_json(path: str, payload: dict) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(payload, f)
+    os.replace(tmp, path)
+
+
+# -- the child process ---------------------------------------------------------
+
+def _replica_main(spec: dict) -> None:
+    """Entry point of one replica process (multiprocessing spawn target;
+    everything it needs rides the picklable `spec` dict). The child is
+    a complete single-device serving node: engine + router + HTTP
+    transport + membership lease, draining cleanly on SIGTERM."""
+    rid = spec["rid"]
+    run_dir = spec["run_dir"]
+    # membership FIRST (stdlib-only, no jax import yet): the lease must
+    # exist while the child pays its jax import + warmup, or the parent
+    # would read a slow warmup as a corpse
+    from deep_vision_tpu.resilience.rendezvous import Rendezvous
+
+    rdzv = Rendezvous(spec["rdzv_root"], host=rid,
+                      heartbeat_s=spec.get("heartbeat_s", 0.5))
+    generation = spec.get("generation")
+    try:
+        if generation is None:
+            view = rdzv.join(expect_hosts=spec["expect_hosts"],
+                             timeout_s=spec.get("join_timeout_s", 60.0))
+        else:
+            view = rdzv.attach(generation=generation,
+                               timeout_s=spec.get("join_timeout_s", 60.0))
+    except Exception:
+        rdzv.leave()
+        raise
+    from deep_vision_tpu.obs.journal import RunJournal
+    from deep_vision_tpu.obs.registry import Registry
+    from deep_vision_tpu.resilience import faults
+    from deep_vision_tpu.serve.router import Server
+    from deep_vision_tpu.serve.transport import Transport
+
+    registry = Registry()
+    journal = RunJournal(os.path.join(
+        run_dir, f"replica-{rid}-a{spec['attempt']}.jsonl"), kind="serve")
+    excache = None
+    if spec.get("excache_dir"):
+        from deep_vision_tpu.core.excache import ExecutableCache
+
+        excache = ExecutableCache(spec["excache_dir"], journal=journal,
+                                  registry=registry)
+    builder = spec["builder"]
+    engine = builder(journal=journal, registry=registry, excache=excache,
+                     **(spec.get("builder_kwargs") or {}))
+    stats = engine.warmup()
+    overlay = spec.get("variables_path")
+    if overlay:
+        # a canary child (or a respawn after a promote) serves the
+        # shipped weights, not the builder's: same aval-validated
+        # hot-swap path a live promote uses
+        with open(overlay, "rb") as f:
+            variables_by_model = pickle.load(f)
+        for name, variables in variables_by_model.items():
+            if name in engine.models:
+                engine.set_variables(name, variables)
+    server = Server(engine, journal=journal, registry=registry,
+                    max_wait_ms=spec.get("max_wait_ms", 2.0),
+                    slo_ms=spec.get("slo_ms"),
+                    health_policy=spec.get("health_policy", "warn"),
+                    tags={"replica": rid}).start()
+    backend = _ChildBackend(server)
+    transport = Transport(backend, port=0, journal=journal,
+                          registry=registry,
+                          controls={"promote": backend.promote}).start()
+    _atomic_json(os.path.join(run_dir, f"replica-{rid}{READY_SUFFIX}"), {
+        "rid": rid, "attempt": spec["attempt"], "pid": os.getpid(),
+        "port": transport.port, "generation": view.generation,
+        "warmup": {k: stats[k] for k in
+                   ("models", "pairs", "backend_compiles", "cache_hits")},
+        "ts": time.time(),
+    })
+    server.install_sigterm()
+    server.wait_for_stop()
+    # SIGTERM (or a parent-driven drain): flush in-flight, drop the
+    # lease cleanly so the monitor sees a departure, not a corpse
+    transport.close()
+    server.drain("sigterm")
+    rdzv.leave()
+    journal.close()
+    # faults kept imported so the env-inherited spec (DVT_FAULT_SPEC)
+    # is armed in this process from the first request on
+    del faults
+
+
+class _ChildBackend:
+    """The replica child's view of its own Server: fires the
+    `serve.replica` fault at the request boundary (the `crash` kind now
+    kills a REAL process) and hosts the promote control verb."""
+
+    def __init__(self, server):
+        self.server = server
+        self.engine = server.engine
+
+    def submit(self, model, image, deadline_ms=None):
+        from deep_vision_tpu.resilience import faults
+
+        faults.fire("serve.replica")
+        return self.server.submit(model, image, deadline_ms=deadline_ms)
+
+    def healthz(self):
+        return self.server.healthz()
+
+    def queue_depth(self, model):
+        return self.server.queue_depth(model)
+
+    def counts(self):
+        return self.server.counts()
+
+    def telemetry_status(self):
+        return self.server.telemetry_status()
+
+    def promote(self, payload: dict) -> dict:
+        """POST /control/promote {"path": <pickle>}: hot-swap the
+        shipped weights into this process's engine (aval-validated,
+        zero recompiles — Engine.set_variables)."""
+        with open(payload["path"], "rb") as f:
+            variables_by_model = pickle.load(f)
+        swapped = []
+        for name, variables in variables_by_model.items():
+            if name in self.engine.models:
+                self.engine.set_variables(name, variables)
+                swapped.append(name)
+        return {"models": sorted(swapped)}
+
+
+# -- the parent-side pool ------------------------------------------------------
+
+class _ProcSlot:
+    """Parent-side record of one replica process."""
+
+    __slots__ = ("rid", "proc", "port", "attempt", "state", "warmup",
+                 "canary", "completed", "errors", "latencies_by_model",
+                 "generation")
+
+    def __init__(self, rid: str, canary: bool = False):
+        self.rid = rid
+        self.proc = None
+        self.port: Optional[int] = None
+        self.attempt = 0
+        self.state = "spawning"
+        self.warmup: Optional[dict] = None
+        self.canary = canary
+        self.completed = 0
+        self.errors = 0
+        self.latencies_by_model: Dict[str, List[float]] = {}
+        self.generation: Optional[int] = None
+
+
+class ProcReplicaPool:
+    """N replica PROCESSES behind one submit() — the ReplicaPool
+    contract over real sockets.
+
+    Wire-up (what tools/fleetnet_smoke.py does)::
+
+        pool = ProcReplicaPool(builder, replicas=3, run_dir=run_dir,
+                               excache_dir=cache_dir, journal=journal,
+                               admission=AdmissionController(...))
+        pool.start()                      # spawn + wait ready
+        fut = pool.submit("toy", image)   # proxied over HTTP
+        ...
+        pool.drain("close")               # SIGTERM children, fold ledgers
+
+    `builder(journal=, registry=, excache=, **kwargs) -> Engine` must be
+    a MODULE-LEVEL callable (spawn pickles it by reference); the parent
+    calls it too, for the warmed template engine that seeds the
+    executable cache (children then warm at zero backend compiles) and
+    gives SwapController its `primary_engine()`.
+    """
+
+    def __init__(self, builder: Callable, replicas: int = 2,
+                 run_dir: str = ".", excache_dir: Optional[str] = None,
+                 journal=None, registry=None, admission=None,
+                 builder_kwargs: Optional[dict] = None,
+                 slo_ms: Optional[float] = None,
+                 max_wait_ms: float = 2.0,
+                 heartbeat_s: float = 0.5,
+                 ready_timeout_s: float = 90.0,
+                 max_respawns: int = 2,
+                 monitor_poll_s: float = 0.25,
+                 request_timeout_s: float = 30.0,
+                 max_inflight: int = 64):
+        if replicas < 1:
+            raise ValueError(f"replicas must be >= 1, got {replicas}")
+        self.builder = builder
+        self.builder_kwargs = dict(builder_kwargs or {})
+        self.n_replicas = int(replicas)
+        self.run_dir = run_dir
+        self.rdzv_root = os.path.join(run_dir, "rdzv")
+        self.excache_dir = excache_dir
+        self.journal = journal
+        self.admission = admission
+        self.slo_ms = slo_ms
+        self.max_wait_ms = float(max_wait_ms)
+        self.heartbeat_s = float(heartbeat_s)
+        self.ready_timeout_s = float(ready_timeout_s)
+        self.max_respawns = int(max_respawns)
+        self.monitor_poll_s = float(monitor_poll_s)
+        self.request_timeout_s = float(request_timeout_s)
+        if registry is None:
+            from deep_vision_tpu.obs.registry import get_registry
+
+            registry = get_registry()
+        self.registry = registry
+        self.slo = SLOTracker(registry=registry, slo_ms=slo_ms)
+        self._lock = locksmith.lock("serve.procpool")
+        self._slots: Dict[str, _ProcSlot] = {}
+        self._canary: Optional[_ProcSlot] = None
+        self._canary_pct = 0
+        self._rr = 0
+        self._seq = 0
+        self.accepted = 0
+        self.completed = 0
+        self.errors = 0
+        self.cancelled = 0
+        self.sheds = 0
+        self.refused = 0
+        self._started = False
+        self._draining = False
+        self._stop = threading.Event()
+        self._monitor: Optional[threading.Thread] = None
+        self._pool = ThreadPoolExecutor(
+            max_workers=int(max_inflight), thread_name_prefix="procpool")
+        self._template: Optional[Engine] = None
+        self._promoted_path: Optional[str] = None
+        # a read-only rendezvous handle: the parent never writes a
+        # member lease, it only reads the children's
+        from deep_vision_tpu.resilience.rendezvous import Rendezvous
+
+        self._rdzv = Rendezvous(self.rdzv_root, host="fleet-parent",
+                                heartbeat_s=self.heartbeat_s)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "ProcReplicaPool":
+        if self._started:
+            return self
+        os.makedirs(self.rdzv_root, exist_ok=True)
+        # the template engine warms FIRST: with an excache attached it
+        # populates the cache, so every child (and every respawn) warms
+        # at zero backend compiles — the parent pays the one compile
+        excache = None
+        if self.excache_dir:
+            from deep_vision_tpu.core.excache import ExecutableCache
+
+            excache = ExecutableCache(self.excache_dir,
+                                      journal=self.journal,
+                                      registry=self.registry)
+        self._template = self.builder(journal=self.journal,
+                                      registry=self.registry,
+                                      excache=excache,
+                                      **self.builder_kwargs)
+        self.template_warmup = self._template.warmup()
+        for i in range(self.n_replicas):
+            rid = f"p{i}"
+            slot = _ProcSlot(rid)
+            self._slots[rid] = slot
+            self._spawn(slot, generation=None)
+        deadline = time.monotonic() + self.ready_timeout_s
+        for slot in self._slots.values():
+            self._wait_ready(slot, deadline)
+        self._started = True
+        self._monitor = threading.Thread(target=self._monitor_loop,
+                                         name="procpool-monitor",
+                                         daemon=True)
+        self._monitor.start()
+        return self
+
+    def _spawn(self, slot: _ProcSlot, generation: Optional[int]) -> None:
+        import multiprocessing as mp
+
+        slot.attempt += 1
+        slot.state = "spawning"
+        slot.port = None
+        # a stale ready file from the previous incarnation must never
+        # be mistaken for the new one's
+        try:
+            os.remove(self._ready_path(slot.rid))
+        except OSError:
+            pass
+        spec = {
+            "rid": slot.rid, "attempt": slot.attempt,
+            "run_dir": self.run_dir, "rdzv_root": self.rdzv_root,
+            "excache_dir": self.excache_dir, "builder": self.builder,
+            "builder_kwargs": self.builder_kwargs,
+            "heartbeat_s": self.heartbeat_s,
+            "expect_hosts": self.n_replicas,
+            "generation": generation,
+            "slo_ms": self.slo_ms, "max_wait_ms": self.max_wait_ms,
+            "variables_path": self._promoted_path,
+        }
+        if slot.canary:
+            # a canary never joins the base generation — it forms a
+            # one-member world under its OWN rendezvous root (joining
+            # the shared root would leave it waiting to be adopted by a
+            # resize the base fleet never runs)
+            spec["generation"] = None
+            spec["expect_hosts"] = 1
+            spec["rdzv_root"] = self.rdzv_root + "-canary"
+            os.makedirs(spec["rdzv_root"], exist_ok=True)
+        ctx = mp.get_context("spawn")
+        slot.proc = ctx.Process(target=_replica_main, args=(spec,),
+                                name=f"replica-{slot.rid}", daemon=True)
+        slot.proc.start()
+
+    def _ready_path(self, rid: str) -> str:
+        return os.path.join(self.run_dir, f"replica-{rid}{READY_SUFFIX}")
+
+    def _wait_ready(self, slot: _ProcSlot, deadline: float) -> None:
+        path = self._ready_path(slot.rid)
+        while time.monotonic() < deadline:
+            rec = None
+            try:
+                with open(path) as f:
+                    rec = json.load(f)
+            except (OSError, ValueError):
+                rec = None
+            if rec and rec.get("attempt") == slot.attempt:
+                slot.port = int(rec["port"])
+                slot.warmup = rec.get("warmup")
+                slot.generation = rec.get("generation")
+                slot.state = "serving"
+                return
+            if slot.proc is not None and not slot.proc.is_alive():
+                raise ServeError(
+                    f"replica {slot.rid} died during warmup "
+                    f"(exitcode={slot.proc.exitcode})")
+            time.sleep(0.05)
+        raise ServeError(
+            f"replica {slot.rid} not ready within "
+            f"{self.ready_timeout_s:.0f}s")
+
+    # -- request path ------------------------------------------------------
+
+    def submit(self, model: str, image,
+               deadline_ms: Optional[float] = None) -> Future:
+        """Admit at the parent edge, pick a replica, proxy over its
+        socket. ShedError is synchronous (no Future on shed, the
+        ReplicaPool contract); everything request-scoped — including a
+        SIGKILLed replica mid-request — comes back on the Future."""
+        if not self._started:
+            raise ServeError("submit() before start(): no replicas are up")
+        self.slo.offered(model)
+        with self._lock:
+            if self._draining:
+                reason: Optional[str] = "draining"
+            elif self.admission is not None:
+                reason = self.admission.admit(model, self._pool._work_queue
+                                              .qsize())
+            else:
+                reason = None
+            slot = None if reason is not None else self._route()
+            if reason is None and slot is None:
+                self.refused += 1
+            if reason is None and slot is not None:
+                self.accepted += 1
+        if reason is not None:
+            self.sheds += 1
+            self.slo.shed(model, reason)
+            if self.journal is not None:
+                self.journal.write("serve_shed", model=model, reason=reason)
+            raise ShedError(model, reason)
+        if slot is None:
+            self.slo.refused(model)
+            raise ServeError(
+                f"no serving replicas for {model!r} "
+                f"({self.replica_states()})")
+        ctx = propagate.current()
+        fut: Future = Future()
+        self._pool.submit(self._proxy_call, slot, model, image,
+                          deadline_ms, ctx, fut,
+                          time.perf_counter())
+        return fut
+
+    def _route(self) -> Optional[_ProcSlot]:
+        """Round-robin over serving base replicas; the canary takes its
+        diverted percentage first (deterministic modulo — the verdict
+        sample accrues at the configured rate, not by luck)."""
+        self._seq += 1
+        # (seq*pct) % 100 < pct spreads the diverted requests EVENLY
+        # through the stream (pct=50 -> every other request) instead of
+        # taking the first pct of every hundred as one burst
+        if (self._canary is not None and self._canary.state == "serving"
+                and self._canary_pct > 0
+                and (self._seq * self._canary_pct) % 100 < self._canary_pct):
+            return self._canary
+        serving = [s for s in self._slots.values()
+                   if s.state == "serving" and not s.canary]
+        if not serving:
+            return None
+        self._rr = (self._rr + 1) % len(serving)
+        return serving[self._rr]
+
+    def _proxy_call(self, slot: _ProcSlot, model: str, image,
+                    deadline_ms: Optional[float], ctx, fut: Future,
+                    t0: float) -> None:
+        """One proxied request on a worker thread; resolves `fut` with
+        the child's answer or the typed failure. Runs the whole
+        status-code contract in reverse: the child's HTTP verdict maps
+        back onto the exceptions in-process callers already handle."""
+        if not fut.set_running_or_notify_cancel():
+            self._account(slot, model, "cancelled", t0)
+            return
+        try:
+            row = self._http_infer(slot, model, image, deadline_ms, ctx)
+        except Exception as e:
+            self._account(slot, model, "error", t0)
+            fut.set_exception(e)
+            if isinstance(e, ReplicaLost):
+                self._suspect(slot)
+            return
+        self._account(slot, model, "ok", t0)
+        fut.set_result(row)
+
+    def _account(self, slot: _ProcSlot, model: str, outcome: str,
+                 t0: float) -> None:
+        latency_ms = (time.perf_counter() - t0) * 1e3
+        with self._lock:
+            if outcome == "ok":
+                self.completed += 1
+                slot.completed += 1
+                slot.latencies_by_model.setdefault(model, []).append(
+                    latency_ms)
+            elif outcome == "cancelled":
+                self.cancelled += 1
+            else:
+                self.errors += 1
+                slot.errors += 1
+        self.slo.request_done(model, latency_ms, outcome)
+
+    def _http_infer(self, slot: _ProcSlot, model: str, image,
+                    deadline_ms: Optional[float], ctx) -> dict:
+        body = json.dumps(
+            {"image": image.tolist() if hasattr(image, "tolist")
+             else image}).encode("utf-8")
+        headers = {"Content-Type": "application/json"}
+        if deadline_ms is not None:
+            headers["X-DVT-Deadline-Ms"] = f"{deadline_ms:.3f}"
+        if ctx is not None:
+            headers["traceparent"] = ctx.to_traceparent()
+        conn = http.client.HTTPConnection(
+            "127.0.0.1", slot.port, timeout=self.request_timeout_s)
+        try:
+            try:
+                conn.request("POST", f"/v1/{model}", body=body,
+                             headers=headers)
+                resp = conn.getresponse()
+                payload = json.loads(resp.read().decode("utf-8"))
+            except (OSError, http.client.HTTPException, ValueError) as e:
+                # connection loss IS the death signal for in-flight
+                # requests: typed, retryable, scoped to this request
+                raise ReplicaLost(
+                    f"replica {slot.rid} connection lost mid-request "
+                    f"({type(e).__name__}: {e})")
+            if resp.status == 200:
+                return payload.get("outputs", payload)
+            reason = payload.get("reason")
+            if resp.status in (429, 503) and reason:
+                raise ShedError(model, reason)
+            if resp.status == 504:
+                raise DeadlineExceeded(
+                    f"deadline shed at {payload.get('stage', '?')} on "
+                    f"replica {slot.rid}")
+            raise ServeError(
+                f"replica {slot.rid} answered {resp.status}: "
+                f"{payload.get('detail', payload)}")
+        finally:
+            conn.close()
+
+    # -- death detection + respawn ----------------------------------------
+
+    def _suspect(self, slot: _ProcSlot) -> None:
+        """Request-path death report (connection loss): flip the slot
+        out of the routing set NOW; the monitor confirms and respawns."""
+        with self._lock:
+            if slot.state == "serving":
+                slot.state = "dead"
+
+    def _monitor_loop(self) -> None:
+        while not self._stop.wait(self.monitor_poll_s):
+            for slot in list(self._slots.values()):
+                if slot.state not in ("serving", "dead"):
+                    continue
+                dead = slot.state == "dead"
+                if not dead and slot.proc is not None \
+                        and not slot.proc.is_alive():
+                    dead = True  # the waitpid truth: connection loss's
+                    # parent-side twin
+                if not dead:
+                    gap = self._rdzv.lease_gap(slot.rid)
+                    if gap is not None and gap > self._rdzv.lease_s:
+                        dead = True  # lease expiry: a HUNG process
+                        # stops heartbeating long before it stops
+                        # holding its socket open
+                if not dead:
+                    continue
+                with self._lock:
+                    slot.state = "dead"
+                self._handle_lost(slot)
+            if self._draining:
+                return
+
+    def _handle_lost(self, slot: _ProcSlot) -> None:
+        if self.journal is not None:
+            self.journal.write("replica_lost", replica=slot.rid,
+                              attempt=slot.attempt)
+        self.registry.counter("serve_replica_lost_total",
+                              "replica processes lost",
+                              labels={"replica": slot.rid}).inc()
+        if slot.canary or self._draining \
+                or slot.attempt > self.max_respawns:
+            return
+        try:
+            self._spawn(slot, generation=slot.generation)
+            self._wait_ready(slot,
+                             time.monotonic() + self.ready_timeout_s)
+        except Exception as e:
+            with self._lock:
+                slot.state = "dead"
+            if self.journal is not None:
+                self.journal.write("note", note="respawn_failed",
+                                  replica=slot.rid,
+                                  error=f"{type(e).__name__}: {e}"[:200])
+            return
+        if self.journal is not None:
+            self.journal.write("replica_recovered", replica=slot.rid,
+                              attempt=slot.attempt, **(slot.warmup or {}))
+
+    # -- fleet introspection ----------------------------------------------
+
+    def primary_engine(self) -> Engine:
+        """The parent's warmed template engine — SwapController's
+        reference for aval validation, shadow cloning, and probes."""
+        if self._template is None:
+            raise ServeError("primary_engine() before start()")
+        return self._template
+
+    def replica_states(self) -> Dict[str, str]:
+        with self._lock:
+            out = {rid: s.state for rid, s in self._slots.items()}
+            if self._canary is not None:
+                out[self._canary.rid] = self._canary.state
+            return out
+
+    def warmup_stats(self) -> Dict[str, dict]:
+        """Per-replica warmup reports from the ready files (the
+        zero-compile respawn assertion reads backend_compiles here)."""
+        with self._lock:
+            return {rid: dict(s.warmup or {})
+                    for rid, s in self._slots.items()}
+
+    def healthz(self):
+        states = self.replica_states()
+        serving = sum(1 for s in states.values() if s == "serving")
+        ok = self._started and not self._draining and serving > 0
+        return ok, {"replicas": states, "serving": serving,
+                    "draining": self._draining}
+
+    def telemetry_status(self) -> dict:
+        out = dict(self.counts())
+        out["sheds"] = self.sheds
+        out["refused"] = self.refused
+        out["replicas"] = self.replica_states()
+        try:
+            out["slo"] = self.slo.report()
+        except Exception:
+            pass
+        return out
+
+    def counts(self) -> dict:
+        with self._lock:
+            return {"accepted": self.accepted, "completed": self.completed,
+                    "errors": self.errors, "cancelled": self.cancelled}
+
+    def ledger(self) -> dict:
+        """The fleet ledger + its invariant: every offered request is
+        accepted, shed, or refused, and every accepted one lands in
+        exactly one of completed/errors/cancelled."""
+        with self._lock:
+            counts = {"accepted": self.accepted,
+                      "completed": self.completed, "errors": self.errors,
+                      "cancelled": self.cancelled, "shed": self.sheds,
+                      "refused": self.refused}
+        counts["pending"] = (counts["accepted"] - counts["completed"]
+                             - counts["errors"] - counts["cancelled"])
+        counts["balanced"] = counts["pending"] >= 0
+        return counts
+
+    def queue_depth(self, model: str) -> int:
+        """Admission input when a Transport fronts this pool directly:
+        parent-side in-flight dispatch backlog."""
+        return self._pool._work_queue.qsize()
+
+    # -- canary swap across processes (SwapController's surface) -----------
+
+    def add_canary(self, engine: Engine, pct: int) -> str:
+        """Mount a canary PROCESS serving `engine`'s weights for `pct`%
+        of traffic. The engine is the SwapController's shadow (parent-
+        side); its variables ship to the spawned child via a pickle
+        under the run dir and load through the same aval-validated
+        set_variables path a promote uses."""
+        if not 0 < pct <= 100:
+            raise ValueError(f"canary pct must be in (0, 100], got {pct}")
+        with self._lock:
+            if self._canary is not None:
+                raise ServeError("a canary is already mounted")
+        path = os.path.join(self.run_dir, "canary-variables.pkl")
+        variables_by_model = {name: engine.entry(name).variables
+                              for name in engine.models}
+        with open(path, "wb") as f:
+            pickle.dump(variables_by_model, f)
+        slot = _ProcSlot("canary", canary=True)
+        prev_promoted = self._promoted_path
+        self._promoted_path = path
+        try:
+            self._spawn(slot, generation=None)
+            self._wait_ready(slot,
+                             time.monotonic() + self.ready_timeout_s)
+        finally:
+            self._promoted_path = prev_promoted
+        with self._lock:
+            self._canary = slot
+            self._canary_pct = int(pct)
+        return slot.rid
+
+    def canary_status(self) -> Optional[dict]:
+        with self._lock:
+            slot = self._canary
+        if slot is None:
+            return None
+        state = slot.state
+        if slot.proc is not None and not slot.proc.is_alive():
+            state = "dead"
+        with self._lock:
+            lat = {m: sorted(v)
+                   for m, v in slot.latencies_by_model.items()}
+            out = {"replica": slot.rid, "state": state,
+                   "accepted": slot.completed + slot.errors,
+                   "completed": slot.completed, "errors": slot.errors,
+                   "cancelled": 0}
+        out["slo"] = {
+            m: {"p99_ms": v[min(len(v) - 1, int(0.99 * len(v)))]}
+            for m, v in lat.items() if v}
+        return out
+
+    def remove_canary(self) -> Optional[dict]:
+        with self._lock:
+            slot, self._canary = self._canary, None
+            self._canary_pct = 0
+        if slot is None:
+            return None
+        slot.state = "draining"
+        summary = self._terminate(slot)
+        slot.state = "dead"
+        return summary
+
+    def promote_variables(self, variables_by_model: dict) -> None:
+        """Ship the new weights to every base replica process (POST
+        /control/promote -> Engine.set_variables: zero recompiles) and
+        to the parent template; a replica respawned later loads the
+        same pickle, so the promoted weights survive process death."""
+        path = os.path.join(self.run_dir, "promoted-variables.pkl")
+        with open(path, "wb") as f:
+            pickle.dump(variables_by_model, f)
+        self._promoted_path = path
+        for name, variables in variables_by_model.items():
+            self._template.set_variables(name, variables)
+        failures = []
+        with self._lock:
+            slots = [s for s in self._slots.values()
+                     if s.state == "serving"]
+        for slot in slots:
+            try:
+                self._control(slot, "promote", {"path": path})
+            except Exception as e:
+                failures.append(f"{slot.rid}: {type(e).__name__}: {e}")
+        if failures:
+            raise ServeError(
+                f"promote failed on {len(failures)} replica(s): "
+                + "; ".join(failures))
+
+    def _control(self, slot: _ProcSlot, verb: str, payload: dict) -> dict:
+        conn = http.client.HTTPConnection(
+            "127.0.0.1", slot.port, timeout=self.request_timeout_s)
+        try:
+            conn.request("POST", f"/control/{verb}",
+                         body=json.dumps(payload).encode("utf-8"),
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            out = json.loads(resp.read().decode("utf-8"))
+            if resp.status != 200 or not out.get("ok"):
+                raise ServeError(
+                    f"control {verb} on {slot.rid} answered "
+                    f"{resp.status}: {out}")
+            return out
+        finally:
+            conn.close()
+
+    # -- drain / shutdown --------------------------------------------------
+
+    def _terminate(self, slot: _ProcSlot,
+                   timeout_s: float = 15.0) -> Optional[dict]:
+        """SIGTERM one child (its Server drains in-process), reap it,
+        return its final edge ledger when reachable."""
+        summary = None
+        try:
+            summary = self._ledgerz(slot)
+        except Exception:
+            pass
+        proc = slot.proc
+        if proc is not None and proc.is_alive():
+            proc.terminate()
+            proc.join(timeout=timeout_s)
+            if proc.is_alive():
+                proc.kill()
+                proc.join(timeout=5.0)
+        return summary
+
+    def _ledgerz(self, slot: _ProcSlot) -> dict:
+        conn = http.client.HTTPConnection("127.0.0.1", slot.port,
+                                          timeout=5.0)
+        try:
+            conn.request("GET", "/ledgerz")
+            return json.loads(conn.getresponse().read().decode("utf-8"))
+        finally:
+            conn.close()
+
+    def child_ledgers(self) -> Dict[str, dict]:
+        """Each live child's transport ledger (the smoke's cross-process
+        crosscheck input)."""
+        out = {}
+        with self._lock:
+            slots = [s for s in self._slots.values()
+                     if s.state == "serving"]
+        for slot in slots:
+            try:
+                out[slot.rid] = self._ledgerz(slot)
+            except Exception:
+                pass
+        return out
+
+    def drain(self, reason: str = "close") -> dict:
+        """Stop admitting, drain every child (SIGTERM -> in-process
+        flush), fold the fleet ledger into one journaled summary."""
+        with self._lock:
+            if self._draining:
+                return getattr(self, "_drain_summary", {})
+            self._draining = True
+        self._stop.set()
+        if self._monitor is not None:
+            self._monitor.join(timeout=5.0)
+        if self._canary is not None:
+            self.remove_canary()
+        for slot in self._slots.values():
+            if slot.state == "serving":
+                slot.state = "draining"
+            self._terminate(slot)
+            slot.state = "dead"
+        self._pool.shutdown(wait=True)
+        counts = self.counts()
+        pending = (counts["accepted"] - counts["completed"]
+                   - counts["errors"] - counts["cancelled"])
+        summary = {"reason": reason,
+                   "outcome": "flushed" if pending == 0 else "timeout",
+                   **counts, "pending": max(0, pending),
+                   "shed": self.sheds, "refused": self.refused,
+                   "replicas": len(self._slots)}
+        if self.journal is not None:
+            self.journal.write("serve_drain", scope="pool", **summary)
+        self._drain_summary = summary
+        return summary
+
+    def close(self) -> dict:
+        return self.drain("close")

@@ -28,6 +28,13 @@ class QueueClosed(RuntimeError):
     """submit() after close(): the server is draining or stopped."""
 
 
+class DeadlineExceeded(RuntimeError):
+    """The request's deadline passed before dispatch: it was shed, not
+    executed (serve/router.py checks at batch pickup; the front door —
+    serve/transport.py — maps this to HTTP 504). Retrying is pointless
+    by definition: the CLIENT's budget expired, not the server."""
+
+
 class Request:
     """One in-flight request: the payload, its promise, and its clock.
 
@@ -40,9 +47,15 @@ class Request:
     the submitting thread to the dispatcher thread — the ambient
     thread-local slot cannot make that hop, so the context rides the
     request object itself.
+
+    `deadline_ts` (perf_counter seconds, or None) is the client's
+    budget: the dispatcher sheds the request instead of executing it
+    when pickup happens past this instant — work whose answer nobody
+    will read must not occupy a batch slot.
     """
 
-    __slots__ = ("model", "image", "future", "t_submit", "accounted", "ctx")
+    __slots__ = ("model", "image", "future", "t_submit", "accounted", "ctx",
+                 "deadline_ts")
 
     def __init__(self, model: str, image):
         self.model = model
@@ -51,6 +64,7 @@ class Request:
         self.t_submit = time.perf_counter()
         self.accounted = False
         self.ctx = None
+        self.deadline_ts = None
 
 
 class BatchingQueue:

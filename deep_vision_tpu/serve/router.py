@@ -38,7 +38,12 @@ from deep_vision_tpu.obs import locksmith, propagate
 from deep_vision_tpu.obs.trace import span
 from deep_vision_tpu.serve.buckets import bucket_for, pad_batch, split_rows
 from deep_vision_tpu.serve.engine import Engine, ServeError
-from deep_vision_tpu.serve.queue import BatchingQueue, QueueClosed, Request
+from deep_vision_tpu.serve.queue import (
+    BatchingQueue,
+    DeadlineExceeded,
+    QueueClosed,
+    Request,
+)
 from deep_vision_tpu.serve.slo import SLOTracker
 
 DRAIN_REASONS = ("close", "sigterm")
@@ -136,7 +141,8 @@ class Server:
 
     # -- request ingestion ---------------------------------------------------
 
-    def submit(self, model: str, image) -> Future:
+    def submit(self, model: str, image,
+               deadline_ms: Optional[float] = None) -> Future:
         """Enqueue one image for `model`; returns a Future resolving to
         the model's per-request output dict (padded rows already gone).
 
@@ -144,11 +150,18 @@ class Server:
         model, or an I/O error at the decode boundary (the `data.read`
         fault-injection point) resolves this future with the exception
         and the server keeps serving everyone else.
+
+        `deadline_ms` (optional) is the client's remaining budget from
+        NOW: a request still queued when it expires is shed at dispatch
+        (`DeadlineExceeded` on the future) instead of executed — the
+        front door's deadline header lands here.
         """
         if not self._started:
             raise ServeError("submit() before start(): no dispatchers are "
                              "running to answer it")
         req = Request(model, image)
+        if deadline_ms is not None and deadline_ms > 0:
+            req.deadline_ts = req.t_submit + float(deadline_ms) / 1e3
         # request ingress mints the trace context: a caller that already
         # carries one (a traced client thread) makes this hop its child,
         # anyone else roots a fresh trace — either way every serve_request
@@ -226,6 +239,12 @@ class Server:
             out["tags"] = dict(self.tags)
         return out
 
+    def queue_depth(self, model: str) -> int:
+        """Current queue depth for `model` — the admission controller's
+        input when a Transport fronts a bare Server."""
+        q = self._queues.get(model)
+        return q.depth if q is not None else 0
+
     def counts(self) -> dict:
         """One consistent snapshot of the request ledger (the drain
         invariant's four buckets) — a ReplicaPool folds these into its
@@ -296,6 +315,23 @@ class Server:
 
     def _run_batch(self, model: str, batch: List[Request]) -> None:
         entry = self.engine.entry(model)
+        t_pickup = time.perf_counter()
+        # deadline enforcement AT DISPATCH: a request whose budget ran
+        # out while it sat in the queue is shed here, not executed —
+        # its answer has no reader, and executing it would tax every
+        # co-batched request that still has time left
+        expired = [r for r in batch
+                   if r.deadline_ts is not None and t_pickup > r.deadline_ts]
+        if expired:
+            for req in expired:
+                late_ms = (t_pickup - req.deadline_ts) * 1e3
+                self._fail_request(req, DeadlineExceeded(
+                    f"deadline passed {late_ms:.1f} ms before dispatch "
+                    f"of {model!r}"))
+            batch = [r for r in batch if r.deadline_ts is None
+                     or t_pickup <= r.deadline_ts]
+            if not batch:
+                return
         bucket = bucket_for(len(batch), entry.buckets)
         t_dispatch = time.perf_counter()
         queue_wait_ms = (t_dispatch

@@ -478,6 +478,58 @@ class TestDataServicePropagation:
         assert other["trace_id"] != root.trace_id
 
 
+# -- timeline hop ordering is causal, not clock-trusting ----------------------
+
+class TestTraceTimelineOrdering:
+    """Regression for the ~25% flake in the cross-process propagation
+    test: journal ts is rounded to 1 ms, and the server journals its
+    reply BEFORE the client journals the receipt — so the child hop can
+    land in an earlier millisecond bucket than its parent. The causal
+    clamp in trace_timelines must put the parent first anyway."""
+
+    TID = "ab" * 16
+
+    def _hop(self, role, ts, span, parent):
+        return {"event": "data_service", "role": role, "op": "get",
+                "ts": ts, "run_id": f"run-{role}", "trace_id": self.TID,
+                "span_id": span, "parent_span_id": parent}
+
+    def test_tied_ts_breaks_by_parent_link_depth(self):
+        from deep_vision_tpu.obs.merge import trace_timelines
+
+        client = self._hop("client", 100.000, "c" * 16, "0" * 15 + "1")
+        server = self._hop("server", 100.000, "d" * 16, "c" * 16)
+        # server listed first: input order must not decide the tie
+        tls = trace_timelines([server, client])
+        assert len(tls) == 1
+        assert [h["role"] for h in tls[0]["hops"]] == ["client", "server"]
+
+    def test_child_in_earlier_ms_bucket_still_sorts_after_parent(self):
+        from deep_vision_tpu.obs.merge import trace_timelines
+
+        # the flake's exact shape: server's write raced one rounding
+        # boundary ahead, stamping the CHILD 1 ms before its parent
+        client = self._hop("client", 100.001, "c" * 16, "0" * 15 + "1")
+        server = self._hop("server", 100.000, "d" * 16, "c" * 16)
+        tls = trace_timelines([server, client])
+        assert len(tls) == 1
+        tl = tls[0]
+        assert [h["role"] for h in tl["hops"]] == ["client", "server"]
+        # duration still reads from the raw stamps (clamping orders, it
+        # does not rewrite the stored timestamps)
+        assert tl["duration_ms"] == 1.0
+
+    def test_grandchild_chain_clamps_transitively(self):
+        from deep_vision_tpu.obs.merge import trace_timelines
+
+        root = self._hop("client", 100.005, "a" * 16, None)
+        mid = self._hop("server", 100.003, "b" * 16, "a" * 16)
+        leaf = self._hop("worker", 100.004, "e" * 16, "b" * 16)
+        tls = trace_timelines([leaf, mid, root])
+        assert [h["span_id"] for h in tls[0]["hops"]] == \
+            ["a" * 16, "b" * 16, "e" * 16]
+
+
 # -- journal schema + drift guards --------------------------------------------
 
 class TestSchema:

@@ -88,6 +88,8 @@ EVENT_FIELDS = {
     "exit": ("status",),
     "crash": ("reason",),
     "telemetry_server": ("host", "port", "outcome"),
+    "transport_request": ("status", "deadline_ms", "outcome"),
+    "transport_server": ("host", "port", "outcome"),
     "perf_profile": ("name", "collective_count", "collective_bytes"),
     "perf_collective": ("name", "kind", "dtype", "ops", "bytes"),
     "perf_regression": ("metric", "baseline", "observed", "threshold"),
@@ -128,6 +130,12 @@ EXCACHE_INVALID_REASONS = {"version_skew", "topology_skew", "corrupt",
 # live telemetry plane (obs/telemetry.py TELEMETRY_OUTCOMES, kept in
 # sync by tests/test_telemetry.py)
 TELEMETRY_SERVER_OUTCOMES = {"started", "stopped", "failed"}
+# serve/transport.py TRANSPORT_OUTCOMES / TRANSPORT_SERVER_OUTCOMES
+# (kept in sync by tests/test_transport.py): the front door's per-request
+# verdicts and the endpoint's lifecycle
+TRANSPORT_OUTCOMES = {"ok", "error", "shed", "deadline", "bad_request",
+                      "torn"}
+TRANSPORT_SERVER_OUTCOMES = {"started", "stopped", "failed"}
 # perf attribution plane (obs/costmodel.py COLLECTIVE_KINDS, kept in
 # sync by tests/test_perfwatch.py): the HLO collective opcodes the
 # inventory parser recognizes
@@ -294,6 +302,29 @@ def check_journal(path: str, require_exit: bool = False,
                               f"outcome {row.get('outcome')!r}")
             if not isinstance(row.get("port"), int):
                 errors.append(f"{path}:{i}: telemetry_server port must be "
+                              f"an int, got {row.get('port')!r}")
+        if ev == "transport_request":
+            if row.get("outcome") not in TRANSPORT_OUTCOMES:
+                errors.append(f"{path}:{i}: unknown transport_request "
+                              f"outcome {row.get('outcome')!r}")
+            # status 0 = no response ever hit the wire (a torn frame
+            # closes the connection instead of answering)
+            if not isinstance(row.get("status"), int) \
+                    or row.get("status", -1) < 0:
+                errors.append(f"{path}:{i}: transport_request status must "
+                              f"be a non-negative int HTTP status, got "
+                              f"{row.get('status')!r}")
+            if not isinstance(row.get("deadline_ms"), (int, float)) \
+                    or row.get("deadline_ms", -1) < 0:
+                errors.append(f"{path}:{i}: transport_request deadline_ms "
+                              f"must be non-negative (0 = none), got "
+                              f"{row.get('deadline_ms')!r}")
+        if ev == "transport_server":
+            if row.get("outcome") not in TRANSPORT_SERVER_OUTCOMES:
+                errors.append(f"{path}:{i}: unknown transport_server "
+                              f"outcome {row.get('outcome')!r}")
+            if not isinstance(row.get("port"), int):
+                errors.append(f"{path}:{i}: transport_server port must be "
                               f"an int, got {row.get('port')!r}")
         if ev == "perf_profile":
             # compiled-artifact introspection (obs/perfwatch.py): name is

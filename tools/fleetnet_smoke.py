@@ -1,0 +1,405 @@
+"""The front-door smoke: a process fleet behind real sockets survives
+SIGKILL, sheds by policy with real 429s, and swaps weights live.
+
+    PYTHONPATH=. JAX_PLATFORMS=cpu python tools/fleetnet_smoke.py \
+        [--workdir artifacts/fleetnet_smoke] [--replicas 3] [--rps 120]
+
+The CI teeth behind serve/transport.py + serve/procpool.py
+(`make fleetnet-smoke`, a `make verify` prerequisite after
+fleet-smoke). Where fleet-smoke exercises the THREAD fleet in one
+interpreter, this drives N spawned replica PROCESSES — each with its
+own engine, HTTP endpoint, and rendezvous membership lease — through
+the parent's socket front door, with every request a real HTTP
+round trip:
+
+  1. warmup     parent template compiles once and seeds the executable
+                cache; every replica process warms at ZERO backend
+                compiles (cache_hits == pairs, read from ready files).
+  2. death      sustained seeded RPS over the socket; one replica gets
+                a REAL SIGKILL mid-traffic. Exactly the dead process's
+                in-flight requests fail — typed (ReplicaLost behind a
+                retryable 503), bounded, never the stream — the journal
+                carries replica_lost/replica_recovered, the respawn
+                warms from the cache at zero compiles, and a follow-up
+                run's p99 proves the fleet recovered.
+  3. promote    SwapController canaries new weights ACROSS PROCESSES
+                (a spawned canary process serves the shadow weights for
+                half the stream), auto-promotes, and every replica
+                process hot-swaps via /control/promote; responses over
+                the wire prove the new weights serve.
+  4. shed       admission tightened at the front door, then an overload
+                blast: excess traffic gets REAL 429s with Retry-After,
+                a retrying client paces itself by the header, and
+                offered == ok + err + shed holds across the client, the
+                transport ledger, AND the journal.
+  5. drain      clean close: the fleet ledger balances
+                (accepted == completed + errors + cancelled), parent +
+                every child journal pass check_journal --strict,
+                obs_report renders the fleet-edge section, locksmith
+                (armed the whole run) reports zero violations, and the
+                flight dir is empty.
+
+Exit status 0 = every contract held; 1 = something broke.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import threading
+import time
+from typing import List, Optional
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+from tools.loadgen import (  # noqa: E402
+    BUCKETS,
+    IMG,
+    SLO_MS,
+    Failures,
+    HttpLoadClient,
+    LoadGen,
+    crosscheck_varz,
+    fleet_builder,
+    toy_fn,
+    toy_variables,
+)
+from tools.smoke_util import read_jsonl  # noqa: E402
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--workdir", default="artifacts/fleetnet_smoke")
+    p.add_argument("--replicas", type=int, default=3)
+    p.add_argument("--rps", type=float, default=120.0)
+    p.add_argument("--requests", type=int, default=120,
+                   help="requests in the sustained-load episode")
+    args = p.parse_args(argv)
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from deep_vision_tpu.core.checkpoint import CheckpointManager
+    from deep_vision_tpu.obs import (
+        FlightRecorder,
+        RunJournal,
+        locksmith,
+        propagate,
+        set_flight,
+    )
+    from deep_vision_tpu.obs.registry import Registry
+    from deep_vision_tpu.obs.telemetry import TelemetryServer
+    from deep_vision_tpu.resilience import RetryPolicy
+    from deep_vision_tpu.serve import (
+        AdmissionController,
+        ProcReplicaPool,
+        ReplicaLost,
+        ShedError,
+        SwapController,
+        Transport,
+    )
+
+    work = os.path.abspath(args.workdir)
+    shutil.rmtree(work, ignore_errors=True)
+    os.makedirs(work)
+    f = Failures()
+    j_path = os.path.join(work, "journal.jsonl")
+    flight_dir = os.path.join(work, "flight")
+
+    journal = RunJournal(j_path, kind="serve")
+    journal.manifest(config={"name": "fleetnet_smoke", "task": "serving"})
+    flight = FlightRecorder(flight_dir, run_id=journal.run_id)
+    flight.attach(journal)
+    set_flight(flight)
+    locksmith.arm(journal=journal)
+    registry = Registry()
+    tele = TelemetryServer(port=0, role="serve", registry=registry,
+                           journal=journal, flight=flight,
+                           discovery_dir=work)
+    tele.start()
+
+    # -- phase 1: process fleet up, zero-compile children ---------------
+    print(f"phase 1: {args.replicas} replica PROCESSES warm from the "
+          "parent-seeded executable cache")
+    pool = ProcReplicaPool(fleet_builder, replicas=args.replicas,
+                           run_dir=work,
+                           excache_dir=os.path.join(work, "excache"),
+                           journal=journal, registry=registry,
+                           slo_ms=SLO_MS, heartbeat_s=0.4,
+                           ready_timeout_s=180.0)
+    pool.start()
+    f.check(pool.template_warmup["backend_compiles"] == 2 * len(BUCKETS),
+            "parent template paid exactly one compile per unique "
+            f"(model, bucket) pair "
+            f"({pool.template_warmup['backend_compiles']})")
+    ws = pool.warmup_stats()
+    f.check(all(w["backend_compiles"] == 0 and w["cache_hits"] == w["pairs"]
+                for w in ws.values()),
+            f"every replica process warmed at ZERO backend compiles "
+            f"({ {r: w['backend_compiles'] for r, w in ws.items()} }, "
+            "cache_hits == pairs)")
+    tp = Transport(pool, journal=journal, registry=registry)
+    tp.start()
+    f.check(tp.port > 0, f"front door listening at {tp.address}")
+    # one traced request end to end: client socket -> parent -> child
+    ctx = propagate.new_trace()
+    probe_img = np.random.RandomState(9).rand(*IMG).astype(np.float32)
+    with propagate.use(ctx):
+        c0 = HttpLoadClient("127.0.0.1", tp.port, registry=registry)
+        row = c0.submit("toy", probe_img).result(timeout=60)
+    c0.close()
+    f.check("scores" in row, "a request crossed both sockets")
+
+    # -- phase 2: sustained RPS + mid-traffic SIGKILL -------------------
+    print("phase 2: mid-traffic SIGKILL is request-scoped; respawn is a "
+          "disk read")
+    # NO retries here: the client must OBSERVE the typed failures the
+    # death causes, not paper over them
+    noretry = HttpLoadClient(
+        "127.0.0.1", tp.port,
+        retry=RetryPolicy(name="fleetnet.noretry", max_attempts=1))
+    victim = pool._slots["p1"]
+    killed_at = {}
+
+    def killer():
+        time.sleep(0.3)  # let the stream establish
+        killed_at["pid"] = victim.proc.pid
+        os.kill(victim.proc.pid, signal.SIGKILL)
+
+    kt = threading.Thread(target=killer, daemon=True)
+    kt.start()
+    gen = LoadGen(noretry.submit, ["toy", "aux"], rps=args.rps,
+                  n_requests=args.requests, seed=42)
+    stats = gen.run()
+    kt.join()
+    noretry.close()
+    print(f"  load: {stats}  (SIGKILL pid {killed_at.get('pid')})")
+    f.check(stats["ok"] + stats["errors"] + stats["shed"]
+            + stats["refused"] == stats["offered"],
+            "every offered request accounted over the wire "
+            f"(ok={stats['ok']} err={stats['errors']} "
+            f"shed={stats['shed']})")
+    f.check(1 <= stats["errors"] <= 25,
+            f"only the dead process's in-flight window failed "
+            f"({stats['errors']} errors; the stream survived)")
+    # the failures were TYPED: every error outcome at the edge names
+    # ReplicaLost (a retryable 503), never an anonymous 500
+    edge_errs = [e for e in read_jsonl(j_path)
+                 if e.get("event") == "transport_request"
+                 and e.get("outcome") == "error"]
+    f.check(bool(edge_errs)
+            and all(e.get("status") == 503
+                    and "ReplicaLost" in e.get("error", "")
+                    for e in edge_errs),
+            f"all {len(edge_errs)} edge errors are typed ReplicaLost "
+            "behind retryable 503s")
+    deadline = time.time() + 60
+    while time.time() < deadline and not all(
+            s == "serving" for s in pool.replica_states().values()):
+        time.sleep(0.1)
+    f.check(all(s == "serving" for s in pool.replica_states().values()),
+            f"fleet back to full strength ({pool.replica_states()})")
+    recs = [e for e in read_jsonl(j_path)
+            if e.get("event") == "replica_recovered"]
+    f.check(len(recs) == 1 and recs[0].get("backend_compiles") == 0
+            and recs[0].get("cache_hits") == recs[0].get("pairs"),
+            "respawned process warmed ENTIRELY from the executable "
+            "cache (zero backend compiles, "
+            f"{recs[0].get('cache_hits') if recs else '?'}"
+            f"/{recs[0].get('pairs') if recs else '?'} pairs cache-hit)")
+    # post-respawn health: a second seeded run over the full fleet
+    # holds the SLO — the fleet RECOVERED, it did not limp on
+    c2 = HttpLoadClient("127.0.0.1", tp.port, registry=registry)
+    stats2 = LoadGen(c2.submit, ["toy", "aux"], rps=args.rps,
+                     n_requests=60, seed=43).run()
+    c2.close()
+    print(f"  post-respawn: {stats2}")
+    f.check(stats2["errors"] == 0 and stats2["ok"] == stats2["offered"],
+            "post-respawn stream is clean (no errors, no sheds)")
+    f.check(stats2["p99_ms"] <= SLO_MS,
+            f"post-respawn p99 recovered "
+            f"({stats2['p99_ms']:.1f}ms <= {SLO_MS:g}ms)")
+    xc = crosscheck_varz(stats2, tele.host, tele.port, ["toy", "aux"])
+    f.check(len(xc["checked"]) == 2,
+            "client p50+p99 cross-checked against /varz over the wire "
+            f"({len(xc['skewed'])} skew warning(s))")
+
+    # -- phase 3: canary swap across processes --------------------------
+    print("phase 3: canary process serves new weights; promote hot-swaps "
+          "every replica")
+    ckpt_dir = os.path.join(work, "ckpt")
+    mgr = CheckpointManager(ckpt_dir, journal=journal)
+    new_toy = {"toy": toy_variables(scale=2.0, seed=7)}
+    mgr.save_tree(1, new_toy)
+    mgr.wait()
+    ref = jax.device_get(
+        toy_fn(new_toy["toy"], jnp.asarray(probe_img[None])))
+    ctraffic = HttpLoadClient("127.0.0.1", tp.port, registry=registry)
+    stop = threading.Event()
+
+    def traffic(seed: int):
+        rng = np.random.RandomState(seed)
+        while not stop.is_set():
+            try:
+                ctraffic.submit("toy", rng.rand(*IMG).astype(np.float32))
+            except Exception:
+                pass
+            time.sleep(0.004)
+
+    t = threading.Thread(target=traffic, args=(3,), daemon=True)
+    t.start()
+    swapper = SwapController(pool, journal=journal, canary_pct=50,
+                             min_canary_requests=6, slo_ms=SLO_MS,
+                             canary_timeout_s=90.0)
+    verdict = swapper.swap(mgr, step=1, models=("toy",))
+    stop.set()
+    t.join(timeout=10)
+    ctraffic.close()
+    f.check(verdict["outcome"] == "promoted",
+            "new weights promoted across the process fleet ("
+            + " -> ".join(f"{t_['phase']}:{t_['outcome']}"
+                          for t_ in verdict["timeline"]) + ")")
+    cp = HttpLoadClient("127.0.0.1", tp.port, registry=registry)
+    got = np.asarray(cp.submit("toy", probe_img).result(timeout=60)
+                     ["scores"])
+    cp.close()
+    f.check(bool(np.allclose(got, ref["scores"][0], rtol=1e-4)),
+            "responses over the wire serve the PROMOTED weights")
+
+    # -- phase 4: overload sheds with real 429s -------------------------
+    print("phase 4: overload gets real 429s; Retry-After paces the client")
+    led_before = tp.ledger()
+    tp.admission = AdmissionController(max_queue_depth=16,
+                                       rate_per_s=0.0, burst=20)
+    blast_client = HttpLoadClient(
+        "127.0.0.1", tp.port,
+        retry=RetryPolicy(name="fleetnet.blast", max_attempts=1))
+    blast = LoadGen(blast_client.submit, ["toy"], rps=None,
+                    n_requests=100, seed=77)
+    bstats = blast.run()
+    blast_client.close()
+    print(f"  blast: {bstats}")
+    f.check(bstats["shed"] >= 70 and bstats["ok"] <= 25,
+            f"token budget admitted <= 25 of 100 over the wire, shed "
+            f"the rest (shed={bstats['shed']})")
+    f.check(bstats["ok"] + bstats["errors"] + bstats["shed"]
+            + bstats["refused"] == bstats["offered"],
+            "overload accounting balances at the client")
+    led = tp.ledger()
+    shed_delta = led["shed"] - led_before["shed"]
+    ok_delta = led["ok"] - led_before["ok"]
+    f.check(shed_delta == bstats["shed"] and ok_delta == bstats["ok"],
+            f"client and transport ledgers agree across the wire "
+            f"(shed {bstats['shed']}=={shed_delta}, "
+            f"ok {bstats['ok']}=={ok_delta})")
+    f.check(led["by_status"].get("429", 0) >= 70,
+            f"sheds were REAL 429s on the wire "
+            f"(429 x{led['by_status'].get('429', 0)})")
+    # a retrying client must come back and land: the bucket has no
+    # refill, so widen it just enough for the retry to get through
+    tp.admission = AdmissionController(max_queue_depth=16,
+                                       rate_per_s=50.0, burst=1)
+    rc = HttpLoadClient("127.0.0.1", tp.port, registry=registry)
+    rows = [rc.submit("toy", probe_img) for _ in range(3)]
+    ok_after_retry = sum(1 for r in rows
+                         if r.result(timeout=60) is not None)
+    f.check(ok_after_retry == 3 and rc.counts["retries"] >= 1
+            and rc.counts["retry_after_honored"] >= 1,
+            f"retrying client honored Retry-After and recovered "
+            f"({rc.counts['retries']} retries, "
+            f"{rc.counts['retry_after_honored']} paced by the header)")
+    rc.close()
+    tp.admission = None
+
+    # -- phase 5: drain + artifacts -------------------------------------
+    print("phase 5: clean drain; strict journals everywhere; zero "
+          "violations")
+    led = tp.ledger()
+    f.check(led["balanced"],
+            f"transport ledger balances: offered {led['offered']} == "
+            "ok + error + shed + deadline + bad_request + torn")
+    # journal vs ledger: every wire request journaled exactly one verdict
+    jreq = [e for e in read_jsonl(j_path)
+            if e.get("event") == "transport_request"]
+    f.check(len(jreq) == led["offered"],
+            f"journal carries one transport_request per offered request "
+            f"({len(jreq)} == {led['offered']})")
+    tp.close()
+    summary = pool.drain("close")
+    f.check(summary["outcome"] == "flushed" and summary["pending"] == 0,
+            f"fleet drained everything ({summary})")
+    f.check(summary["accepted"] == summary["completed"]
+            + summary["errors"] + summary["cancelled"],
+            "fleet ledger balances across death, swap, and shed "
+            f"(accepted={summary['accepted']})")
+    lock_report = locksmith.report()
+    f.check(not lock_report["violations"],
+            "locksmith: zero lock-order violations across the fleet "
+            "lifecycle"
+            + ("" if not lock_report["violations"]
+               else f" ({lock_report['violations'][0]})"))
+    locksmith.disarm()
+    mgr.close()
+    tele.close()
+    flight.close()
+    set_flight(None)
+    journal.close()
+    f.check(not os.listdir(flight_dir) if os.path.isdir(flight_dir)
+            else True, "clean run left no flight bundle")
+
+    env = dict(os.environ, PYTHONPATH=ROOT)
+    child_journals = sorted(
+        os.path.join(work, p) for p in os.listdir(work)
+        if p.startswith("replica-") and p.endswith(".jsonl"))
+    f.check(len(child_journals) >= args.replicas + 1,
+            f"each replica incarnation left a journal "
+            f"({len(child_journals)} files: base fleet + respawn + "
+            "canary)")
+    # the SIGKILLed incarnation's journal is the one file that MUST
+    # fail strict — a murdered process never writes its terminal event,
+    # and that missing line is the forensic record of the kill
+    killed = {f"replica-{e['replica']}-a{e['attempt']}.jsonl"
+              for e in read_jsonl(j_path)
+              if e.get("event") == "replica_lost"}
+    strict_ok, killed_flagged = True, True
+    for path in [j_path] + child_journals:
+        r = subprocess.run(
+            [sys.executable, os.path.join(ROOT, "tools",
+                                          "check_journal.py"),
+             path, "--strict"], cwd=ROOT, env=env)
+        if os.path.basename(path) in killed:
+            killed_flagged = killed_flagged and r.returncode != 0
+        else:
+            strict_ok = strict_ok and r.returncode == 0
+    f.check(strict_ok, "check_journal --strict accepts the parent AND "
+            f"every surviving child journal "
+            f"({1 + len(child_journals) - len(killed)} files)")
+    f.check(len(killed) == 1 and killed_flagged,
+            "strict mode flags exactly the SIGKILLed incarnation's "
+            f"journal as terminated without a terminal event ({killed})")
+    rep = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "obs_report.py"),
+         j_path],
+        cwd=ROOT, env=env, stdout=subprocess.PIPE, text=True)
+    f.check(rep.returncode == 0 and "fleet edge" in rep.stdout
+            and "429x" in rep.stdout,
+            "obs_report renders the fleet-edge section (status ledger "
+            "with the 429s)")
+
+    if f.errors:
+        print(f"\nfleetnet-smoke: {len(f.errors)} contract(s) BROKEN "
+              f"(artifacts in {work})")
+        return 1
+    print(f"\nfleetnet-smoke: all front-door contracts held "
+          f"(artifacts in {work})")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -145,6 +145,11 @@ class LoadGen:
                 fut.result(timeout=max(0.1, deadline - time.perf_counter()))
                 t_done = done_at.get(i, time.perf_counter())
                 ok_lat.append((t_done - t_sub) * 1e3)
+            except ShedError:
+                # socket mode sheds ASYNCHRONOUSLY: the verdict rides
+                # the response (429/503), not the submit call — it is
+                # still a shed, not an error
+                shed += 1
             except Exception:
                 errors += 1
         wall_s = time.perf_counter() - t0
@@ -209,6 +214,187 @@ def crosscheck_varz(stats: dict, host: str, port: int, models,
                   f"+/-{tol_abs_ms:g}ms — clock or histogram skew "
                   f"(server {entry['server_ms']})", flush=True)
     return out
+
+
+# -- real-socket mode ---------------------------------------------------------
+
+class HttpLoadClient:
+    """LoadGen's front door over a REAL socket: POST /v1/<model> against
+    a serve/transport.py endpoint, `submit(model, image) -> Future`.
+
+    Retries ride `resilience.RetryPolicy` primitives — transient
+    failures (connection loss, 429, 503) back off and go again, and a
+    429/503 response's `Retry-After` header is HONORED: the client
+    sleeps at least that long before the retry, whatever the policy's
+    own schedule says. Terminal verdicts surface typed: ShedError when
+    the budget runs out on sheds, DeadlineExceeded on 504 (never
+    retried — the CLIENT's budget expired, retrying cannot help),
+    ServeError otherwise. `counts` tracks retries and how often
+    Retry-After set the pace, so a smoke can assert the header actually
+    steered the client.
+    """
+
+    def __init__(self, host: str, port: int,
+                 deadline_ms: Optional[float] = None,
+                 retry=None, journal=None, registry=None,
+                 max_inflight: int = 32, timeout_s: float = 30.0):
+        from concurrent.futures import ThreadPoolExecutor
+
+        from deep_vision_tpu.resilience import RetryPolicy
+        from deep_vision_tpu.serve import ReplicaLost, ShedError
+
+        self.host = host
+        self.port = int(port)
+        self.deadline_ms = deadline_ms
+        self.timeout_s = float(timeout_s)
+        # what is worth another try over the wire: sheds (the server
+        # said "later", and told us when) and lost connections — NOT
+        # DeadlineExceeded (the client's own budget expired) and NOT
+        # application errors
+        self.retry = retry or RetryPolicy(
+            name="loadgen.http", max_attempts=4, base_delay_s=0.02,
+            multiplier=2.0, max_delay_s=0.5, jitter=0.25,
+            retry_on=(ShedError, ReplicaLost, ConnectionError,
+                      TimeoutError),
+            journal=journal, registry=registry)
+        self._pool = ThreadPoolExecutor(max_workers=int(max_inflight),
+                                        thread_name_prefix="loadgen-http")
+        self._lock = threading.Lock()
+        self.counts = {"offered": 0, "ok": 0, "shed": 0, "deadline": 0,
+                       "error": 0, "retries": 0, "retry_after_honored": 0}
+
+    def close(self) -> None:
+        self._pool.shutdown(wait=True)
+
+    def submit(self, model: str, image):
+        from concurrent.futures import Future
+
+        fut: Future = Future()
+        with self._lock:
+            self.counts["offered"] += 1
+        self._pool.submit(self._run_one, model, image, fut)
+        return fut
+
+    def _bump(self, key: str) -> None:
+        with self._lock:
+            self.counts[key] += 1
+
+    def _run_one(self, model: str, image, fut) -> None:
+        if not fut.set_running_or_notify_cancel():
+            return
+        attempt = 0
+        while True:
+            try:
+                fut.set_result(self._post(model, image))
+                self._bump("ok")
+                return
+            except Exception as e:
+                attempt += 1
+                retry_after_s = getattr(e, "retry_after_s", None)
+                if not self.retry.should_retry(attempt, e):
+                    self.retry.note(attempt, e, "gave_up")
+                    self._bump(self._outcome_key(e))
+                    fut.set_exception(e)
+                    return
+                # the server's Retry-After is a FLOOR under the
+                # policy's own backoff: the server knows its queue
+                delay = self.retry.delay(attempt)
+                if retry_after_s is not None and retry_after_s > delay:
+                    delay = retry_after_s
+                    self._bump("retry_after_honored")
+                self.retry.note(attempt, e, "retrying", delay_s=delay)
+                self._bump("retries")
+                if delay > 0:
+                    time.sleep(delay)
+
+    @staticmethod
+    def _outcome_key(e: Exception) -> str:
+        from deep_vision_tpu.serve import DeadlineExceeded, ShedError
+
+        if isinstance(e, ShedError):
+            return "shed"
+        if isinstance(e, DeadlineExceeded):
+            return "deadline"
+        return "error"
+
+    def _post(self, model: str, image) -> dict:
+        import http.client
+
+        from deep_vision_tpu.obs import propagate
+        from deep_vision_tpu.serve import (
+            DeadlineExceeded,
+            ReplicaLost,
+            ServeError,
+            ShedError,
+        )
+
+        body = json.dumps(
+            {"image": image.tolist() if hasattr(image, "tolist")
+             else image}).encode("utf-8")
+        headers = {"Content-Type": "application/json"}
+        if self.deadline_ms is not None:
+            headers["X-DVT-Deadline-Ms"] = f"{self.deadline_ms:.3f}"
+        ctx = propagate.current()
+        if ctx is not None:
+            headers["traceparent"] = ctx.to_traceparent()
+        conn = http.client.HTTPConnection(self.host, self.port,
+                                          timeout=self.timeout_s)
+        try:
+            try:
+                conn.request("POST", f"/v1/{model}", body=body,
+                             headers=headers)
+                resp = conn.getresponse()
+                raw = resp.read()
+            except (OSError, http.client.HTTPException) as e:
+                raise ReplicaLost(
+                    f"connection to {self.host}:{self.port} lost "
+                    f"({type(e).__name__}: {e})")
+            try:
+                payload = json.loads(raw.decode("utf-8"))
+            except ValueError:
+                raise ReplicaLost(
+                    f"torn response from {self.host}:{self.port} "
+                    f"({len(raw)} bytes, not JSON)")
+            if resp.status == 200:
+                return payload.get("outputs", payload)
+            retry_after = resp.getheader("Retry-After")
+            if resp.status in (429, 503):
+                reason = payload.get("reason")
+                # a reason names a POLICY shed; a reasonless 503 is a
+                # fleet failure (ReplicaLost behind the front door) —
+                # typed differently so client ledgers never conflate
+                # "turned away" with "died under me"
+                e = (ShedError(model, reason) if reason
+                     else ReplicaLost(payload.get("detail")
+                                      or "fleet error behind the edge"))
+                if retry_after is not None:
+                    try:
+                        e.retry_after_s = float(retry_after)
+                    except ValueError:
+                        pass
+                raise e
+            if resp.status == 504:
+                raise DeadlineExceeded(
+                    f"deadline shed at {payload.get('stage', '?')}")
+            raise ServeError(
+                f"{self.host}:{self.port} answered {resp.status}: "
+                f"{payload.get('detail', payload)}")
+        finally:
+            conn.close()
+
+
+def fleet_builder(journal=None, registry=None, excache=None):
+    """Module-level engine builder (spawn pickles it BY REFERENCE, so it
+    must live at module scope): the two-toy-model engine every
+    ProcReplicaPool child — and the parent's template — builds."""
+    from deep_vision_tpu.serve import Engine
+
+    eng = Engine(journal=journal, registry=registry, excache=excache)
+    eng.register("toy", toy_fn, toy_variables(), input_shape=IMG,
+                 buckets=BUCKETS)
+    eng.register("aux", aux_fn, aux_variables(), input_shape=IMG,
+                 buckets=BUCKETS)
+    return eng
 
 
 # -- the fleet-smoke scenario -------------------------------------------------

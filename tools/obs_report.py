@@ -94,6 +94,9 @@ def summarize_run(events: List[dict]) -> dict:
     serving = summarize_serving(events)
     if serving:
         out["serving"] = serving
+    fleet_edge = summarize_fleet_edge(events)
+    if fleet_edge:
+        out["fleet_edge"] = fleet_edge
     data_plane = summarize_data_plane(events)
     if data_plane:
         out["data_plane"] = data_plane
@@ -201,6 +204,64 @@ def summarize_serving(events: List[dict]) -> Optional[dict]:
     fleet = summarize_fleet(requests, sheds, swaps, lost, recovered)
     if fleet:
         out["fleet"] = fleet
+    return out
+
+
+def summarize_fleet_edge(events: List[dict]) -> Optional[dict]:
+    """The front door's view (serve/transport.py journal events): the
+    status-code ledger across every transport_request, outcome counts
+    with the offered == sum-of-outcomes balance verdict, deadline sheds
+    split by stage (admission vs dispatch — WHERE the budget died), the
+    latency tail of the 200s recomputed exactly, and each endpoint's
+    lifecycle. None when the journal carries no transport events —
+    in-process serving reports render byte-unchanged."""
+    requests = [e for e in events if e.get("event") == "transport_request"]
+    servers = [e for e in events if e.get("event") == "transport_server"]
+    if not (requests or servers):
+        return None
+    out: dict = {}
+    if requests:
+        by_status: Dict[str, int] = {}
+        outcomes: Dict[str, int] = {}
+        deadline_stages: Dict[str, int] = {}
+        latencies: List[float] = []
+        for e in requests:
+            st = e.get("status")
+            by_status[str(st)] = by_status.get(str(st), 0) + 1
+            oc = str(e.get("outcome", "?"))
+            outcomes[oc] = outcomes.get(oc, 0) + 1
+            if oc == "deadline":
+                stage = str(e.get("stage", "?"))
+                deadline_stages[stage] = deadline_stages.get(stage, 0) + 1
+            if st == 200 and isinstance(e.get("latency_ms"), (int, float)):
+                latencies.append(float(e["latency_ms"]))
+        out["requests"] = {
+            "offered": len(requests),
+            "by_status": {k: by_status[k] for k in sorted(by_status)},
+            "outcomes": {k: outcomes[k] for k in sorted(outcomes)},
+            # every journaled request carries exactly one outcome, so
+            # the wire ledger balances by construction — a False here
+            # means a truncated/hand-edited journal
+            "balanced": len(requests) == sum(outcomes.values()),
+        }
+        if deadline_stages:
+            out["deadline_stages"] = deadline_stages
+        if latencies:
+            out["latency"] = {
+                "n": len(latencies),
+                "p50_ms": _percentile(latencies, 0.5),
+                "p99_ms": _percentile(latencies, 0.99),
+            }
+    if servers:
+        eps: Dict[str, dict] = {}
+        for e in servers:
+            key = f"{e.get('host', '?')}:{e.get('port', '?')}"
+            row = eps.setdefault(key, {"started": 0, "stopped": 0,
+                                       "failed": 0})
+            oc = e.get("outcome")
+            if oc in row:
+                row[oc] += 1
+        out["servers"] = eps
     return out
 
 
@@ -578,6 +639,45 @@ def render(summary: dict) -> str:
             rows.append(("serve drain",
                          f"{drain.get('reason')} -> {drain.get('outcome')} "
                          f"({parts} pending={drain.get('pending')})"))
+    # the fleet edge (serve/transport.py): what the WIRE saw — the
+    # status-code ledger, where deadlines died, and the socket tail
+    fleet_edge = summary.get("fleet_edge")
+    if fleet_edge:
+        req = fleet_edge.get("requests")
+        if req:
+            codes = " ".join(f"{k}x{v}"
+                             for k, v in req["by_status"].items())
+            rows.append(("fleet edge",
+                         f"{req['offered']} request(s) over the wire "
+                         f"[{codes}]"
+                         + ("" if req.get("balanced")
+                            else "  LEDGER IMBALANCED")))
+            oc = req.get("outcomes", {})
+            shedlike = {k: v for k, v in oc.items()
+                        if k in ("shed", "deadline", "torn", "bad_request")
+                        and v}
+            if shedlike:
+                rows.append(("  edge outcomes",
+                             " ".join(f"{k}={v}"
+                                      for k, v in sorted(
+                                          shedlike.items()))))
+        stages = fleet_edge.get("deadline_stages")
+        if stages:
+            rows.append(("  deadline shed",
+                         " ".join(f"{k}={v}" for k, v in
+                                  sorted(stages.items()))
+                         + "  (admission = never queued; dispatch = "
+                         "queued but expired before its batch)"))
+        lat = fleet_edge.get("latency")
+        if lat:
+            rows.append(("  edge latency",
+                         f"p50 {lat['p50_ms']:.1f}ms  "
+                         f"p99 {lat['p99_ms']:.1f}ms  (n={lat['n']})"))
+        for ep, r in sorted(fleet_edge.get("servers", {}).items()):
+            life = f"started x{r['started']}, stopped x{r['stopped']}"
+            if r.get("failed"):
+                life += f", FAILED x{r['failed']}"
+            rows.append((f"  endpoint {ep}", life))
     # data plane (data/snapshot.py + data/service.py): service
     # throughput/reconnects, worker death history, and the resume
     # verdict — whether the input stream continued where the model did
