@@ -71,6 +71,32 @@ def _k(name: str, kind: str, default, doc: str,
 #: name outside this module, and any helper call naming a knob that is
 #: not declared here.
 KNOBS: Dict[str, Knob] = {k.name: k for k in (
+    _k("DVT_ALERT_BURN", "float", 2.0,
+       "Burn-rate multiplier for obs/alerts.py rules: an error budget "
+       "is 'burning' when the bad ratio exceeds budget * this in both "
+       "the fast and slow windows."),
+    _k("DVT_ALERT_ERROR_BUDGET", "float", 0.01,
+       "Serving error budget (fraction of transport_request rows that "
+       "may be 5xx/torn) the serve_error_burn rule guards."),
+    _k("DVT_ALERT_FAST_S", "float", 5.0,
+       "Fast window (seconds of event time) for burn-rate alert rules "
+       "(obs/alerts.py) — the page-quickly half of the pair."),
+    _k("DVT_ALERT_GOODPUT_FLOOR", "float", 0.0,
+       "Goodput floor: mean goodput_frac over the slow window below "
+       "this fires the goodput_floor alert; 0 disables the rule."),
+    _k("DVT_ALERT_LATENCY_BUDGET_MS", "float", 0.0,
+       "Serving latency budget (ms): ok-request p95 over the slow "
+       "window above this fires serve_latency_budget; 0 disables."),
+    _k("DVT_ALERT_RECOMPILE_BURST", "int", 8,
+       "Recompile burst bound: more than this many new recompiles "
+       "within the slow window fires recompile_burst; 0 disables."),
+    _k("DVT_ALERT_SLOW_S", "float", 60.0,
+       "Slow window (seconds of event time) for alert rules "
+       "(obs/alerts.py) — the don't-page-on-a-blip half."),
+    _k("DVT_ALERT_STARVATION_FRAC", "float", 0.0,
+       "Data-starvation bound: fraction of steps in the slow window "
+       "with data_wait_ms > dispatch_ms above this fires "
+       "data_starvation; 0 disables the rule."),
     _k("DVT_COLLECTIVE_DEADLINE_S", "float", 600.0,
        "Deadline (seconds) for the raw-jax fallback collectives in "
        "parallel/multihost.py; a barrier blocked past this declares a "
@@ -88,6 +114,9 @@ KNOBS: Dict[str, Knob] = {k.name: k for k in (
        "Flash-attention routing floor: sequences at least this many "
        "tokens route onto the Pallas kernel (ops/pallas/"
        "flash_attention.py); lower routes shorter sequences onto it."),
+    _k("DVT_GOODPUT_INTERVAL_S", "float", 30.0,
+       "Cadence (seconds) of the live GoodputMeter's goodput_interval "
+       "journal events (obs/goodput.py)."),
     _k("DVT_HOST_SMOKE_DEBUG", "flag", False,
        "Arm faulthandler periodic stack dumps in tools/host_smoke.py "
        "worker processes (hang triage)."),

@@ -53,11 +53,38 @@ records the reference's instrumentation as one examples/sec print):
   disabled (`locksmith.lock`, `locksmith.arm`, `locksmith.report`). The
   static half is lint/concur.py (jaxlint DV101-DV104).
 
+- `goodput`: the wall-clock attribution ledger — every second of a run
+  lands in exactly one typed bucket (productive_step, data_wait,
+  compile, checkpoint, host_loss_recovery, replica_respawn,
+  rendezvous_wait, drain, overhead) with `sum(buckets) == wall_clock`
+  by construction; live tap (`GoodputMeter`) and offline replay
+  (`attribute_journal`) run the same accountant, and `goodput_frac`
+  feeds the perf ledger's MAD gate.
+- `alerts`: multi-window burn-rate SLO rules over the journal stream —
+  serving error/latency budgets and training budgets (goodput floor,
+  recompile bursts, starvation) evaluated at event time, live on
+  `/alertz` and offline over merged journals, with typed
+  `alert_fired`/`alert_resolved` events (`AlertEngine`,
+  `evaluate_journal`).
+
 Metric/journal/trace writers are process-0-only in single-process runs;
 multi-process runs write per-host `.pN` files (registry.process_suffix)
 that `tools/obs_merge.py` stitches back into one timeline.
 """
+from deep_vision_tpu.obs.alerts import (
+    AlertEngine,
+    default_rules,
+    default_serving_rules,
+    default_training_rules,
+    evaluate_journal,
+)
 from deep_vision_tpu.obs.autoprof import AutoProfiler
+from deep_vision_tpu.obs.goodput import (
+    GOODPUT_BUCKETS,
+    GoodputAccountant,
+    GoodputMeter,
+    attribute_journal,
+)
 from deep_vision_tpu.obs.flight import (
     FlightRecorder,
     get_flight,
@@ -95,14 +122,19 @@ from deep_vision_tpu.obs.registry import (
 )
 from deep_vision_tpu.obs.stepclock import (
     StepClock,
+    compile_seconds,
     hbm_bytes_in_use,
     hbm_stats,
     recompile_count,
 )
 
 __all__ = [
+    "AlertEngine",
     "AutoProfiler",
     "Counter",
+    "GOODPUT_BUCKETS",
+    "GoodputAccountant",
+    "GoodputMeter",
     "FlightRecorder",
     "Gauge",
     "HealthMonitor",
@@ -114,7 +146,13 @@ __all__ = [
     "TraceContext",
     "Tracer",
     "TrainingHealthError",
+    "attribute_journal",
+    "compile_seconds",
+    "default_rules",
+    "default_serving_rules",
+    "default_training_rules",
     "dump_all_stacks",
+    "evaluate_journal",
     "from_traceparent",
     "get_flight",
     "get_registry",

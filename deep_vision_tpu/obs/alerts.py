@@ -1,0 +1,392 @@
+"""Burn-rate SLO alerting: multi-window rules over the journal stream.
+
+The serving front door (serve/transport.py) and the training loop
+journal every outcome; this module turns those rows into pages. The
+core rule shape is the SRE multi-window burn rate: an error budget
+(say 1% of requests may fail) is "burning too fast" when the failure
+ratio exceeds `budget * burn` in BOTH a fast window (seconds — catches
+the incident quickly, the slow window alone would lag) and a slow
+window (the guard against paging on a single unlucky blip). Training
+budgets ride the same engine as single-window threshold rules:
+goodput floor (obs/goodput.py `goodput_interval` rows), recompile
+bursts and data starvation (`step` rows).
+
+Determinism contract — live and offline MUST agree: the engine is a
+pure state machine over **event time**. It advances only on journal-row
+timestamps (`ts`), never on the wall clock, so replaying a journal
+through `evaluate_journal` reproduces the exact `alert_fired`/
+`alert_resolved` pairs the live tap produced while the run was up —
+the fleetnet smoke asserts this literally. The price is honest: an
+alert cannot resolve while no rows flow, which is also true of the
+offline replay, so the two views never diverge.
+
+Wiring:
+
+- **live** — `AlertEngine.observe` is tap-compatible
+  (`journal.add_tap(engine.observe)`); every row ingests + evaluates,
+  transitions write typed `alert_fired`/`alert_resolved` events.
+  `TelemetryServer.set_alerts(engine)` serves `/alertz` and fails the
+  "alerts" health source while a page-severity alert is active.
+- **offline** — `evaluate_journal(events, rules)` replays any journal
+  (merged journals included) through a fresh engine; `pairs()` is the
+  fired->resolved timeline tools/obs_report.py renders.
+
+Every threshold is a `DVT_ALERT_*` knob (core/knobs.py registry); the
+defaults keep a clean run silent — the acceptance bar is literally
+"a clean run fires zero alerts".
+
+jax-free at import.
+"""
+from __future__ import annotations
+
+from collections import deque
+from typing import Callable, Dict, List, Optional
+
+from deep_vision_tpu.core import knobs
+from deep_vision_tpu.obs import locksmith
+from deep_vision_tpu.obs.goodput import _num
+
+#: alert_fired / alert_resolved severity enum — mirrored in
+#: tools/check_journal.py (ALERT_SEVERITIES), pinned by a drift test.
+ALERT_SEVERITIES = ("page", "ticket")
+
+#: The engine's OWN verdict rows, skipped on ingestion so the tap
+#: observing its own write cannot recurse. Deliberately narrower than
+#: goodput.OWN_EVENTS: goodput_interval rows are the goodput plane's
+#: output but this engine's *signal* — the goodput_floor rule reads
+#: them (tests/test_alerts.py pins that they are ingested).
+ENGINE_OWN_EVENTS = ("alert_fired", "alert_resolved")
+
+
+def _percentile(xs: List[float], q: float) -> float:
+    ys = sorted(xs)
+    if not ys:
+        return 0.0
+    idx = min(len(ys) - 1, max(0, int(round(q * (len(ys) - 1)))))
+    return ys[idx]
+
+
+class BurnRateRule:
+    """One SRE-style multi-window burn-rate rule over a good/bad row
+    classifier. Fires when the bad ratio exceeds `budget * burn` in
+    both windows with at least `min_count` samples (and one bad) in
+    the fast window."""
+
+    kind = "burn_rate"
+
+    def __init__(self, name: str, *, classify: Callable[[dict],
+                                                        Optional[bool]],
+                 budget: float, burn: float, fast_s: float, slow_s: float,
+                 min_count: int = 4, severity: str = "page") -> None:
+        assert severity in ALERT_SEVERITIES
+        self.name = name
+        self.severity = severity
+        self.budget = float(budget)
+        self.burn = float(burn)
+        self.fast_s = float(fast_s)
+        self.slow_s = float(slow_s)
+        self.min_count = int(min_count)
+        self._classify = classify
+        self._samples: deque = deque(maxlen=65536)  # (ts, bad)
+
+    def ingest(self, ts: float, row: dict) -> None:
+        verdict = self._classify(row)
+        if verdict is None:
+            return
+        self._samples.append((ts, bool(verdict)))
+
+    def firing(self, now: float) -> Optional[dict]:
+        """The (value, threshold) verdict dict when burning, else None."""
+        while self._samples and self._samples[0][0] <= now - self.slow_s:
+            self._samples.popleft()
+        slow = self._samples
+        if not slow:
+            return None
+        bad_slow = sum(1 for _, bad in slow if bad)
+        fast = [(t, bad) for t, bad in slow if t > now - self.fast_s]
+        bad_fast = sum(1 for _, bad in fast if bad)
+        threshold = self.budget * self.burn
+        if (len(fast) >= self.min_count and bad_fast >= 1
+                and bad_fast / len(fast) > threshold
+                and bad_slow / len(slow) > threshold):
+            return {"value": round(bad_fast / len(fast), 4),
+                    "threshold": round(threshold, 4),
+                    "window_s": self.fast_s}
+        return None
+
+    def describe(self) -> dict:
+        return {"name": self.name, "kind": self.kind,
+                "severity": self.severity, "budget": self.budget,
+                "burn": self.burn, "fast_s": self.fast_s,
+                "slow_s": self.slow_s}
+
+
+class WindowRule:
+    """One single-window threshold rule: aggregate a per-row value over
+    `window_s` of event time and compare against `bound`. `agg` is one
+    of mean / max / p95 / delta (max - min — the shape a cumulative
+    counter burst takes); `direction` "above" fires when agg > bound,
+    "below" when agg < bound (goodput floor)."""
+
+    kind = "threshold"
+
+    def __init__(self, name: str, *, value: Callable[[dict],
+                                                     Optional[float]],
+                 bound: float, window_s: float, agg: str = "mean",
+                 direction: str = "above", min_count: int = 2,
+                 severity: str = "ticket") -> None:
+        assert agg in ("mean", "max", "p95", "delta")
+        assert direction in ("above", "below")
+        assert severity in ALERT_SEVERITIES
+        self.name = name
+        self.severity = severity
+        self.bound = float(bound)
+        self.window_s = float(window_s)
+        self.agg = agg
+        self.direction = direction
+        self.min_count = int(min_count)
+        self._value = value
+        self._samples: deque = deque(maxlen=65536)  # (ts, value)
+
+    def ingest(self, ts: float, row: dict) -> None:
+        v = self._value(row)
+        if v is None:
+            return
+        self._samples.append((ts, float(v)))
+
+    def firing(self, now: float) -> Optional[dict]:
+        while self._samples and self._samples[0][0] <= now - self.window_s:
+            self._samples.popleft()
+        xs = [v for _, v in self._samples]
+        if len(xs) < self.min_count:
+            return None
+        if self.agg == "mean":
+            value = sum(xs) / len(xs)
+        elif self.agg == "max":
+            value = max(xs)
+        elif self.agg == "p95":
+            value = _percentile(xs, 0.95)
+        else:  # delta
+            value = max(xs) - min(xs)
+        hot = (value > self.bound if self.direction == "above"
+               else value < self.bound)
+        if hot:
+            return {"value": round(value, 4),
+                    "threshold": round(self.bound, 4),
+                    "window_s": self.window_s}
+        return None
+
+    def describe(self) -> dict:
+        return {"name": self.name, "kind": self.kind,
+                "severity": self.severity, "bound": self.bound,
+                "window_s": self.window_s, "agg": self.agg,
+                "direction": self.direction}
+
+
+# -- the stock classifiers / value extractors ----------------------------------
+
+def _transport_bad(row: dict) -> Optional[bool]:
+    """transport_request rows: a 5xx / torn socket burns the error
+    budget; sheds, deadline refusals, and client errors are policy,
+    not budget burn."""
+    if row.get("event") != "transport_request":
+        return None
+    status = _num(row, "status") or 0
+    return row.get("outcome") in ("error", "torn") or status >= 500
+
+
+def _transport_ok_latency(row: dict) -> Optional[float]:
+    if row.get("event") != "transport_request":
+        return None
+    if row.get("outcome") != "ok":
+        return None
+    return _num(row, "latency_ms")
+
+
+def _goodput_frac(row: dict) -> Optional[float]:
+    if row.get("event") != "goodput_interval":
+        return None
+    return _num(row, "goodput_frac")
+
+
+def _step_recompiles(row: dict) -> Optional[float]:
+    if row.get("event") != "step":
+        return None
+    return _num(row, "recompiles")
+
+
+def _step_starved(row: dict) -> Optional[float]:
+    if row.get("event") != "step":
+        return None
+    wait = _num(row, "data_wait_ms")
+    dispatch = _num(row, "dispatch_ms")
+    if wait is None or dispatch is None:
+        return None
+    return 1.0 if wait > dispatch else 0.0
+
+
+# -- stock rule sets (knob-tuned; a zero/negative budget disables) -------------
+
+def default_serving_rules() -> List[object]:
+    fast = knobs.get_float("DVT_ALERT_FAST_S")
+    slow = knobs.get_float("DVT_ALERT_SLOW_S")
+    rules: List[object] = [BurnRateRule(
+        "serve_error_burn", classify=_transport_bad,
+        budget=knobs.get_float("DVT_ALERT_ERROR_BUDGET"),
+        burn=knobs.get_float("DVT_ALERT_BURN"),
+        fast_s=fast, slow_s=slow, severity="page")]
+    latency_ms = knobs.get_float("DVT_ALERT_LATENCY_BUDGET_MS")
+    if latency_ms > 0:
+        rules.append(WindowRule(
+            "serve_latency_budget", value=_transport_ok_latency,
+            bound=latency_ms, window_s=slow, agg="p95",
+            direction="above", severity="ticket"))
+    return rules
+
+
+def default_training_rules() -> List[object]:
+    slow = knobs.get_float("DVT_ALERT_SLOW_S")
+    rules: List[object] = []
+    floor = knobs.get_float("DVT_ALERT_GOODPUT_FLOOR")
+    if floor > 0:
+        rules.append(WindowRule(
+            "goodput_floor", value=_goodput_frac, bound=floor,
+            window_s=slow, agg="mean", direction="below",
+            min_count=1, severity="ticket"))
+    burst = knobs.get_int("DVT_ALERT_RECOMPILE_BURST")
+    if burst > 0:
+        rules.append(WindowRule(
+            "recompile_burst", value=_step_recompiles, bound=float(burst),
+            window_s=slow, agg="delta", direction="above",
+            severity="ticket"))
+    starve = knobs.get_float("DVT_ALERT_STARVATION_FRAC")
+    if starve > 0:
+        rules.append(WindowRule(
+            "data_starvation", value=_step_starved, bound=starve,
+            window_s=slow, agg="mean", direction="above",
+            min_count=4, severity="ticket"))
+    return rules
+
+
+def default_rules() -> List[object]:
+    return default_training_rules() + default_serving_rules()
+
+
+class AlertEngine:
+    """Evaluate a rule set over the journal stream; journal the
+    transitions. `observe` is tap-compatible; all evaluation happens at
+    event time (the row's ts), which is what makes the live engine and
+    an offline replay bit-identical."""
+
+    def __init__(self, rules: List[object], journal=None,
+                 registry=None) -> None:
+        self.journal = journal
+        self._lock = locksmith.lock("obs.alerts")
+        self._rules = list(rules)
+        self._fired: Dict[str, dict] = {}     # name -> active verdict
+        self._history: List[dict] = []        # fired->resolved pairs
+        self._now: Optional[float] = None
+        self._g_active = (registry.gauge("alerts_active",
+                                         "alert rules currently firing")
+                          if registry is not None else None)
+
+    # -- ingestion ---------------------------------------------------------
+
+    def observe(self, row: dict) -> None:
+        """Fold one journal row in and evaluate at its timestamp.
+        Tap-compatible. The engine's own output events are skipped —
+        they are verdicts, not signals, and skipping them bounds the
+        tap recursion a transition's write re-enters with."""
+        if not isinstance(row, dict) or row.get("event") in ENGINE_OWN_EVENTS:
+            return
+        ts = _num(row, "ts")
+        if ts is None:
+            return
+        with self._lock:
+            self._now = ts if self._now is None else max(self._now, ts)
+            for rule in self._rules:
+                rule.ingest(ts, row)
+            transitions = self._evaluate_locked(self._now)
+        self._emit(transitions)
+
+    def evaluate(self) -> List[dict]:
+        """Re-evaluate at the last observed event time (no-op on an
+        empty stream) and return the active alerts."""
+        with self._lock:
+            if self._now is None:
+                return []
+            transitions = self._evaluate_locked(self._now)
+        self._emit(transitions)
+        return self.active()
+
+    def _evaluate_locked(self, now: float) -> List[dict]:
+        transitions = []
+        for rule in self._rules:
+            verdict = rule.firing(now)
+            was = self._fired.get(rule.name)
+            if verdict is not None and was is None:
+                active = {"rule": rule.name, "severity": rule.severity,
+                          "fired_ts": now, **verdict}
+                self._fired[rule.name] = active
+                self._history.append(dict(active, resolved_ts=None))
+                transitions.append(("alert_fired", dict(active)))
+            elif verdict is None and was is not None:
+                del self._fired[rule.name]
+                for h in reversed(self._history):
+                    if h["rule"] == rule.name and h["resolved_ts"] is None:
+                        h["resolved_ts"] = now
+                        break
+                transitions.append(("alert_resolved", {
+                    "rule": rule.name, "severity": rule.severity,
+                    "dur_s": round(now - was["fired_ts"], 3)}))
+        if self._g_active is not None:
+            self._g_active.set(len(self._fired))
+        return transitions
+
+    def _emit(self, transitions: List[tuple]) -> None:
+        if self.journal is None:
+            return
+        for event, fields in transitions:
+            if event == "alert_fired":  # literal event types for DV204
+                self.journal.write("alert_fired", **fields)
+            else:
+                self.journal.write("alert_resolved", **fields)
+
+    # -- reading -----------------------------------------------------------
+
+    def active(self) -> List[dict]:
+        with self._lock:
+            return [dict(v) for v in self._fired.values()]
+
+    def has_active_page(self) -> bool:
+        with self._lock:
+            return any(v["severity"] == "page"
+                       for v in self._fired.values())
+
+    def pairs(self) -> List[dict]:
+        """The fired->resolved timeline: one dict per firing with
+        `resolved_ts` None while still active. The fleetnet smoke
+        compares this list (by rule name + order) between the live
+        engine and the offline replay."""
+        with self._lock:
+            return [dict(h) for h in self._history]
+
+    def alertz(self) -> dict:
+        """The /alertz body (obs/telemetry.py route)."""
+        with self._lock:
+            return {"now": self._now,
+                    "active": [dict(v) for v in self._fired.values()],
+                    "history": [dict(h) for h in self._history],
+                    "rules": [r.describe() for r in self._rules]}
+
+
+def evaluate_journal(events: List[dict],
+                     rules: Optional[List[object]] = None) -> AlertEngine:
+    """Offline evaluation: replay journal rows through a fresh engine
+    built from the same knob-tuned rule set the live side used. Returns
+    the engine; read `pairs()` / `active()` off it."""
+    engine = AlertEngine(default_rules() if rules is None else rules)
+    for row in events:
+        if isinstance(row, dict):
+            engine.observe(row)
+    return engine

@@ -1,0 +1,399 @@
+"""Goodput plane: attribute every wall-clock second to one typed bucket.
+
+The repo instruments every failure mode — host loss (resilience/
+rendezvous.py), replica SIGKILL (serve/procpool.py), compiles
+(core/excache.py + the stepclock compile listener), data waits
+(obs/stepclock.py), checkpoint spans (train/trainer.py) — but until
+now no ledger said what fraction of wall-clock was *productive*. This
+module is that ledger: a partition of the run's wall clock into the
+`GOODPUT_BUCKETS`, carrying the repo's signature accounting invariant
+
+    sum(buckets) == wall_clock        (exact, by construction)
+
+because every gap between consecutive journal rows is fully attributed
+before the cursor advances — the invariant cannot drift, only the
+*labeling* of seconds can be wrong, and the smokes pin the labeling
+(host-smoke: the SIGKILL recovery window lands in `host_loss_recovery`;
+fleetnet-smoke: the respawn window lands in `replica_respawn`).
+
+Two consumers, one accountant:
+
+- **live** — `GoodputMeter` rides `RunJournal.add_tap`, folds each row
+  into a `GoodputAccountant`, emits a typed `goodput_interval` event
+  every `DVT_GOODPUT_INTERVAL_S` seconds and a terminal
+  `goodput_summary` on close, and exposes `telemetry_status()` as a
+  TelemetryServer status source (the obs_poll "gp NN%" column).
+- **offline** — `attribute_journal(events)` replays any journal
+  (including one stitched across re-execs, where no live meter could
+  survive) through the same accountant, so post-mortem attribution and
+  the live gauges can never disagree about the algorithm.
+
+The `goodput_frac` scalar (productive_step / wall) is the one number
+ROADMAP item 5 asks for; the smokes land it as a gated row in
+`artifacts/perf_ledger.jsonl` so the MAD gate (tools/perf_gate.py)
+watches it across PRs.
+
+How seconds are labeled (the attribution rules):
+
+- `step` rows split their preceding gap using the StepClock splits:
+  `data_wait_ms` -> data_wait, the `compile_ms` delta -> compile, the
+  remaining step wall -> productive_step, leftover -> the ambient
+  bucket. A step row also *closes* a host-loss recovery window —
+  recovery is not over until training steps again.
+- `host_lost` opens `host_loss_recovery`; `world_resized` carves its
+  `rendezvous_wait_s` stamp into rendezvous_wait and leaves the window
+  open until the first post-resize step.
+- `replica_lost`/`replica_recovered` (procpool) bracket
+  `replica_respawn`; `serve_drain` rows carve their `drain_s` stamp
+  into drain.
+- `checkpoint` rows carve their `save_ms` stamp (and the resume note's
+  `restore_ms`) into checkpoint.
+- `excache_miss` -> `excache_store`/`excache_hit` windows are compile
+  time; the step-row compile delta is credited against them so a
+  cache-missed warmup compile is never counted twice (see
+  `_compile_credit`).
+- `transport_request` rows with outcome "ok" carve their `latency_ms`
+  into productive_step — serving's productive second is a served
+  request.
+- Whatever no rule claims lands in `overhead` — the honest unknown.
+
+jax-free at import (data workers and the serve parent use it).
+"""
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+from deep_vision_tpu.core import knobs
+from deep_vision_tpu.obs import locksmith
+
+#: The exhaustive wall-clock partition. tools/check_journal.py mirrors
+#: this tuple (GOODPUT_BUCKETS) for --strict validation; a drift-guard
+#: test pins the two copies together. `overhead` is the catch-all for
+#: seconds no rule claims — the "unknown" bucket the smokes assert the
+#: failure windows do NOT land in.
+GOODPUT_BUCKETS = (
+    "productive_step",
+    "data_wait",
+    "compile",
+    "checkpoint",
+    "host_loss_recovery",
+    "replica_respawn",
+    "rendezvous_wait",
+    "drain",
+    "overhead",
+)
+
+#: Events the goodput/alert plane itself emits — the accountant treats
+#: them as plain rows (their gaps are ambient time), but the live meter
+#: must never re-emit while observing one, or a tap would recurse.
+OWN_EVENTS = ("goodput_interval", "goodput_summary",
+              "alert_fired", "alert_resolved")
+
+DEFAULT_INTERVAL_S = 30.0
+
+
+def _num(row: dict, key: str) -> Optional[float]:
+    v = row.get(key)
+    if isinstance(v, (int, float)) and not isinstance(v, bool):
+        return float(v)
+    return None
+
+
+class GoodputAccountant:
+    """The pure attribution state machine: feed it journal rows in file
+    order via `observe`, read `buckets`. Not thread-safe — GoodputMeter
+    wraps it in a lock for the live tap; offline replay is single-
+    threaded by nature."""
+
+    def __init__(self) -> None:
+        self.buckets: Dict[str, float] = {b: 0.0 for b in GOODPUT_BUCKETS}
+        self._t0: Optional[float] = None
+        self._cursor: Optional[float] = None
+        # window state: which bucket owns otherwise-unclaimed seconds
+        self._recovering = False        # host_lost .. first step after
+        self._respawning = 0            # replica_lost depth (overlapping)
+        self._compile_open = False      # excache_miss .. store/hit
+        # compile seconds already attributed via an excache window since
+        # the last step row — credited against that step's compile_ms
+        # delta so a warmup compile is not double-counted
+        self._compile_credit = 0.0
+
+    # -- the ledger --------------------------------------------------------
+
+    def wall_s(self) -> float:
+        if self._t0 is None or self._cursor is None:
+            return 0.0
+        return self._cursor - self._t0
+
+    def total_s(self) -> float:
+        return sum(self.buckets.values())
+
+    def imbalance_frac(self) -> float:
+        """|sum(buckets) - wall| / wall — ~0 by construction; the smokes
+        assert <= 2% so any future attribution rule that breaks the
+        partition fails loudly."""
+        wall = self.wall_s()
+        if wall <= 0.0:
+            return 0.0
+        return abs(self.total_s() - wall) / wall
+
+    def goodput_frac(self) -> float:
+        wall = self.wall_s()
+        if wall <= 0.0:
+            return 0.0
+        return self.buckets["productive_step"] / wall
+
+    def snapshot(self) -> dict:
+        return {"wall_s": round(self.wall_s(), 3),
+                "goodput_frac": round(self.goodput_frac(), 4),
+                "imbalance_frac": round(self.imbalance_frac(), 4),
+                "buckets": {b: round(v, 3)
+                            for b, v in self.buckets.items()}}
+
+    # -- attribution -------------------------------------------------------
+
+    def _ambient(self) -> str:
+        if self._recovering:
+            return "host_loss_recovery"
+        if self._respawning > 0:
+            return "replica_respawn"
+        if self._compile_open:
+            return "compile"
+        return "overhead"
+
+    def advance(self, now: float) -> None:
+        """Attribute the gap from the cursor to `now` to the ambient
+        bucket (interval emission / end-of-run flush)."""
+        if self._t0 is None:
+            self._t0 = self._cursor = now
+            return
+        gap = now - float(self._cursor)
+        if gap <= 0.0:
+            return
+        self.buckets[self._ambient()] += gap
+        self._cursor = now
+
+    def observe(self, row: dict) -> None:
+        """Fold one journal row in: fully attribute the gap since the
+        previous row, then update the window state."""
+        ts = _num(row, "ts")
+        if ts is None:
+            return
+        if self._t0 is None:
+            self._t0 = self._cursor = ts
+            gap = 0.0
+        else:
+            gap = max(0.0, ts - float(self._cursor))
+            self._cursor = max(float(self._cursor), ts)
+        event = row.get("event")
+        if event == "step":
+            self._observe_step(row, gap)
+            return
+        if event in ("checkpoint", "preempt_checkpoint"):
+            self._carve(gap, "checkpoint", _num(row, "save_ms"), scale=1e-3)
+            return
+        if event == "note" and row.get("note") == "resumed":
+            self._carve(gap, "checkpoint", _num(row, "restore_ms"),
+                        scale=1e-3)
+            return
+        if event == "host_lost":
+            self.buckets[self._ambient()] += gap
+            self._recovering = True
+            return
+        if event == "world_resized":
+            rdzv = _num(row, "rendezvous_wait_s") or 0.0
+            take = min(gap, max(0.0, rdzv))
+            self.buckets["rendezvous_wait"] += take
+            self.buckets[self._ambient()] += gap - take
+            return
+        if event == "replica_lost":
+            self.buckets[self._ambient()] += gap
+            self._respawning += 1
+            return
+        if event == "replica_recovered":
+            self.buckets["replica_respawn"] += gap
+            self._respawning = max(0, self._respawning - 1)
+            return
+        if event == "excache_miss":
+            self.buckets[self._ambient()] += gap
+            self._compile_open = True
+            return
+        if event in ("excache_store", "excache_hit", "excache_invalid"):
+            if self._compile_open:
+                self.buckets["compile"] += gap
+                self._compile_credit += gap
+                self._compile_open = False
+            else:
+                self.buckets[self._ambient()] += gap
+            return
+        if event == "serve_drain":
+            self._carve(gap, "drain", _num(row, "drain_s"), scale=1.0)
+            return
+        if event == "transport_request":
+            lat = _num(row, "latency_ms")
+            if row.get("outcome") == "ok" and lat is not None:
+                take = min(gap, max(0.0, lat * 1e-3))
+                self.buckets["productive_step"] += take
+                gap -= take
+            self.buckets[self._ambient()] += gap
+            return
+        self.buckets[self._ambient()] += gap
+
+    def _carve(self, gap: float, bucket: str, dur: Optional[float],
+               scale: float) -> None:
+        """Attribute min(gap, dur) to `bucket`, the rest ambient; rows
+        without a duration stamp (older journals) claim the whole gap —
+        they directly follow the work they describe."""
+        take = gap if dur is None else min(gap, max(0.0, dur * scale))
+        self.buckets[bucket] += take
+        self.buckets[self._ambient()] += gap - take
+
+    def _observe_step(self, row: dict, gap: float) -> None:
+        data_wait = min(gap, max(0.0, (_num(row, "data_wait_ms") or 0.0)
+                                 * 1e-3))
+        rest = gap - data_wait
+        compile_s = max(0.0, (_num(row, "compile_ms") or 0.0) * 1e-3
+                        - self._compile_credit)
+        compile_take = min(rest, compile_s)
+        rest -= compile_take
+        step_wall = max(0.0, (_num(row, "step_time_ms") or 0.0) * 1e-3)
+        productive = min(rest, max(0.0, step_wall - data_wait
+                                   - compile_take))
+        rest -= productive
+        self.buckets["data_wait"] += data_wait
+        self.buckets["compile"] += compile_take
+        self.buckets["productive_step"] += productive
+        self.buckets[self._ambient()] += rest
+        # a step closes every training-side window: recovery is over,
+        # any open compile window resolved into this step's delta
+        self._recovering = False
+        self._compile_open = False
+        self._compile_credit = 0.0
+
+
+def attribute_journal(events: List[dict]) -> GoodputAccountant:
+    """Offline attribution: replay journal rows (read_journal order —
+    append order, which is time order per writer) through a fresh
+    accountant. The same code path the live meter runs, so live and
+    post-mortem numbers cannot diverge algorithmically."""
+    acc = GoodputAccountant()
+    for row in events:
+        if isinstance(row, dict):
+            acc.observe(row)
+    return acc
+
+
+class GoodputMeter:
+    """The live half: a journal tap feeding a GoodputAccountant, with
+    periodic `goodput_interval` events, a terminal `goodput_summary`,
+    registry gauges, and a TelemetryServer status source.
+
+    Construction installs the tap; `close()` flushes the terminal
+    summary (idempotent — safe under both Trainer.close and atexit
+    ordering). The tap is re-entrancy-safe: emitting an interval row
+    re-invokes the tap with that row, which is observed like any other
+    but can never trigger a second emission (OWN_EVENTS guard)."""
+
+    def __init__(self, journal=None, registry=None,
+                 interval_s: Optional[float] = None,
+                 time_fn=time.time) -> None:
+        self.journal = journal
+        self.registry = registry
+        self.interval_s = (knobs.get_float("DVT_GOODPUT_INTERVAL_S")
+                           if interval_s is None else float(interval_s))
+        self._time = time_fn
+        self._lock = locksmith.lock("obs.goodput")
+        self._acc = GoodputAccountant()
+        self._last_emit: Optional[float] = None
+        self._last_buckets: Dict[str, float] = {b: 0.0
+                                                for b in GOODPUT_BUCKETS}
+        self._closed = False
+        if registry is not None:
+            self._g_frac = registry.gauge(
+                "goodput_frac", "productive fraction of wall clock")
+            self._g_bucket = {
+                b: registry.gauge("goodput_seconds_total",
+                                  "wall-clock seconds by goodput bucket",
+                                  labels={"bucket": b})
+                for b in GOODPUT_BUCKETS}
+        else:
+            self._g_frac = None
+            self._g_bucket = {}
+        if journal is not None:
+            journal.add_tap(self.tap)
+            # closers run before the terminal exit row, so every
+            # journal'd run ends with a goodput_summary even when the
+            # owner never calls close() explicitly
+            journal.add_closer(self.close)
+
+    # -- the journal tap ---------------------------------------------------
+
+    def tap(self, row: dict) -> None:
+        """RunJournal tap: called with every written row, outside the
+        journal lock. Folds the row in; every `interval_s` seconds of
+        event time, emits one `goodput_interval` delta row."""
+        emit = None
+        with self._lock:
+            if self._closed:
+                return
+            self._acc.observe(row)
+            now = _num(row, "ts")
+            if now is None:
+                return
+            if self._last_emit is None:
+                self._last_emit = now
+            elif (row.get("event") not in OWN_EVENTS
+                  and now - self._last_emit >= self.interval_s):
+                emit = self._interval_row(now)
+        if emit is not None and self.journal is not None:
+            self.journal.write("goodput_interval", **emit)
+
+    def _interval_row(self, now: float) -> dict:
+        """Build one interval delta row; caller holds the lock."""
+        delta = {}
+        for b in GOODPUT_BUCKETS:
+            delta[b] = round(self._acc.buckets[b] - self._last_buckets[b], 3)
+            self._last_buckets[b] = self._acc.buckets[b]
+        dur = now - float(self._last_emit)
+        self._last_emit = now
+        self._update_gauges()
+        return {"dur_s": round(dur, 3), "buckets": delta,
+                "goodput_frac": round(self._acc.goodput_frac(), 4)}
+
+    def _update_gauges(self) -> None:
+        if self._g_frac is not None:
+            self._g_frac.set(self._acc.goodput_frac())
+        for b, g in self._g_bucket.items():
+            g.set(round(self._acc.buckets[b], 3))
+
+    # -- reading -----------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return self._acc.snapshot()
+
+    def telemetry_status(self) -> dict:
+        """TelemetryServer status source ("goodput" section of /statusz;
+        obs_poll renders goodput_frac as the gp column)."""
+        snap = self.snapshot()
+        return {"goodput_frac": snap["goodput_frac"],
+                "wall_s": snap["wall_s"],
+                "imbalance_frac": snap["imbalance_frac"],
+                "buckets": snap["buckets"]}
+
+    # -- terminal ----------------------------------------------------------
+
+    def close(self) -> Optional[dict]:
+        """Advance to now, write the terminal `goodput_summary`, update
+        the gauges one last time. Idempotent; returns the summary."""
+        with self._lock:
+            if self._closed:
+                return None
+            self._closed = True
+            self._acc.advance(self._time())
+            self._update_gauges()
+            snap = self._acc.snapshot()
+        if self.journal is not None:
+            self.journal.write("goodput_summary", **snap)
+        return snap

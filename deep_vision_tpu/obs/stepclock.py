@@ -32,6 +32,7 @@ from deep_vision_tpu.obs.registry import Registry, get_registry
 
 _compile_lock = threading.Lock()
 _compile_events = 0
+_compile_seconds = 0.0
 _listener_installed = False
 
 
@@ -45,10 +46,11 @@ def _install_compile_listener() -> None:
         import jax
 
         def _on_duration(event: str, duration: float, **kw) -> None:
-            global _compile_events
+            global _compile_events, _compile_seconds
             if "backend_compile" in event:
                 with _compile_lock:
                     _compile_events += 1
+                    _compile_seconds += float(duration)
 
         jax.monitoring.register_event_duration_secs_listener(_on_duration)
         _listener_installed = True
@@ -59,6 +61,18 @@ def recompile_count() -> int:
     installed (first StepClock construction or first explicit call)."""
     _install_compile_listener()
     return _compile_events
+
+
+def compile_seconds() -> float:
+    """Wall seconds the process spent in backend compiles, from the same
+    monitoring listener as `recompile_count`. The goodput plane's
+    compile feed: each step journal row carries the delta since the
+    previous committed step as `compile_ms`, so offline attribution
+    (obs/goodput.py) can carve compile time out of step gaps without a
+    live listener."""
+    _install_compile_listener()
+    with _compile_lock:
+        return _compile_seconds
 
 
 def hbm_stats(device=None) -> "tuple[Optional[int], Optional[int]]":
@@ -120,6 +134,10 @@ class StepClock:
         self._last_data_wait_ms = 0.0
         self._recompiles_at_start: Optional[int] = None
         _install_compile_listener()
+        # compile-seconds high-water at construction: step rows carry the
+        # delta since the previous committed step, so a clock built after
+        # another run's compiles never re-attributes them
+        self._compile_s_last = compile_seconds()
 
         r = self.registry
         self._g_data_wait = r.gauge(f"{name}_data_wait_ms",
@@ -149,7 +167,16 @@ class StepClock:
     # -- data-wait side ----------------------------------------------------
 
     def iter_data(self, data: Iterable) -> Iterator:
-        """Wrap a batch iterable, timing each next() as data wait."""
+        """Wrap a batch iterable, timing each next() as data wait.
+
+        With device_prefetch armed the iterable is the prefetcher's
+        consumer side: next() blocks only until a device-placed batch is
+        queued, so the producer thread's device_put time — overlapped
+        with the previous step's compute — is hidden from this timer by
+        construction. That is the goodput contract: those seconds are
+        already inside the overlapped step's `step_time_ms`
+        (productive), never double-counted as data_wait
+        (tests/test_goodput.py pins this with a depth-2 prefetcher)."""
         it = iter(data)
         while True:
             t0 = time.perf_counter()
@@ -186,6 +213,10 @@ class StepClock:
             self._g_eps.set(rec.examples_per_sec)
         if rec.data_wait_ms > rec.dispatch_ms:
             self._c_starved.inc()
+        cs = compile_seconds()
+        if cs > self._compile_s_last:
+            rec.compile_ms = (cs - self._compile_s_last) * 1e3
+            self._compile_s_last = cs
         if rec.sampled:
             self._sync_samples += 1
             n = recompile_count()
@@ -230,6 +261,7 @@ class _StepRecord:
         self.step_time_ms = 0.0
         self.examples_per_sec: Optional[float] = None
         self.recompiles: Optional[int] = None
+        self.compile_ms: Optional[float] = None
         self.hbm_bytes: Optional[int] = None
         self.hbm_peak_bytes: Optional[int] = None
         self._t0 = 0.0
@@ -293,6 +325,8 @@ class _StepRecord:
             out["sync_ms"] = round(self.sync_ms, 3)
         if self.recompiles is not None:
             out["recompiles"] = self.recompiles
+        if self.compile_ms is not None:
+            out["compile_ms"] = round(self.compile_ms, 3)
         if self.hbm_bytes is not None:
             out["hbm_bytes"] = self.hbm_bytes
         if self.hbm_peak_bytes is not None:

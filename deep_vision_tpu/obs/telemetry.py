@@ -14,6 +14,10 @@ watched (trainer, serve router/pool, data service), serving:
                    per-source status sections (step/epoch, generation,
                    replica states), excache ledger, last N journal
                    events from the flight recorder's ring
+    GET /alertz    JSON state of the attached obs/alerts.py AlertEngine
+                   (set_alerts): active alerts, fired->resolved
+                   history, rule inventory — empty lists when no
+                   engine is attached
 
 Discovery: the server binds port 0 by default (auto-assign), journals
 the bound port as a typed `telemetry_server` event, and writes a
@@ -87,6 +91,7 @@ class TelemetryServer:
         self._lock = locksmith.lock("obs.telemetry")
         self._health: Dict[str, HealthSource] = {}
         self._status: Dict[str, StatusSource] = {}
+        self._alerts = None  # AlertEngine (obs/alerts.py) via set_alerts
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         self._discovery_path: Optional[str] = None
@@ -110,6 +115,37 @@ class TelemetryServer:
         with self._lock:
             self._health.pop(str(name), None)
             self._status.pop(str(name), None)
+
+    def set_alerts(self, engine) -> None:
+        """Attach an obs/alerts.py AlertEngine: `/alertz` serves its
+        state, and the "alerts" health source fails while any
+        page-severity alert is firing — a burning error budget flips
+        /healthz exactly like a failing readiness probe. Idempotent by
+        the same replace-on-respawn story as add_health."""
+        with self._lock:
+            self._alerts = engine
+        self.add_health("alerts", self._alerts_health)
+
+    def _alerts_health(self) -> Tuple[bool, dict]:
+        with self._lock:
+            engine = self._alerts
+        if engine is None:
+            return True, {"active": 0}
+        active = engine.active()
+        paging = [a["rule"] for a in active
+                  if a.get("severity") == "page"]
+        return (not paging,
+                {"active": len(active), "paging": paging})
+
+    def alertz(self) -> dict:
+        """The /alertz body: the engine's event-time state (active
+        alerts, fired->resolved history, rule inventory). An endpoint
+        with no engine answers with empty lists — pollable either way."""
+        with self._lock:
+            engine = self._alerts
+        if engine is None:
+            return {"now": None, "active": [], "history": [], "rules": []}
+        return _jsonable(engine.alertz())
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -311,6 +347,8 @@ class _Handler(BaseHTTPRequestHandler):
             elif route == "/healthz":
                 ok, body = tele.healthz()
                 self._send_json(200 if ok else 503, body)
+            elif route == "/alertz":
+                self._send_json(200, tele.alertz())
             elif route == "/statusz":
                 body = tele.statusz()
                 fmt = parse_qs(parsed.query).get("format", ["json"])[0]
@@ -321,7 +359,8 @@ class _Handler(BaseHTTPRequestHandler):
                     self._send_json(200, body)
             elif route == "/":
                 self._send(200, "text/plain",
-                           "endpoints: /metrics /varz /healthz /statusz\n")
+                           "endpoints: /metrics /varz /healthz /statusz "
+                           "/alertz\n")
             else:
                 self._send(404, "text/plain", f"no such page: {route}\n")
         except Exception as e:

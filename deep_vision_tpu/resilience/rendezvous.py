@@ -899,7 +899,9 @@ class HostSupervisor:
         caller decides how to re-enter it (reexec, or rebuild in place
         when no jax distributed world exists)."""
         old = self.rdzv.view
+        t0 = time.monotonic()
         view = self.rdzv.resize()
+        rendezvous_wait_s = time.monotonic() - t0
         old_hosts = set(old.hosts) if old is not None else set()
         for h in sorted(set(view.hosts) - old_hosts):
             if h != view.host:
@@ -914,12 +916,17 @@ class HostSupervisor:
                              view.world_size)
         except Exception:
             pass
+        # rendezvous_wait_s: the goodput plane (obs/goodput.py) carves
+        # exactly the re-rendezvous portion of the host_lost ->
+        # world_resized gap into its rendezvous_wait bucket; the rest of
+        # the recovery window stays host_loss_recovery
         self._write(
             EVENT_WORLD_RESIZED,
             **{"from": len(old_hosts) if old_hosts else 0,
                "to": view.world_size, "generation": view.generation,
                "resume_step": int(resume_step)
-               if resume_step is not None else -1})
+               if resume_step is not None else -1,
+               "rendezvous_wait_s": round(rendezvous_wait_s, 3)})
         return view
 
     def journal_data_reshard(self, view: WorldView, from_hosts: int) -> None:

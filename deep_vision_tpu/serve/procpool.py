@@ -818,6 +818,7 @@ class ProcReplicaPool:
             if self._draining:
                 return getattr(self, "_drain_summary", {})
             self._draining = True
+        t0 = time.monotonic()
         self._stop.set()
         if self._monitor is not None:
             self._monitor.join(timeout=5.0)
@@ -832,11 +833,15 @@ class ProcReplicaPool:
         counts = self.counts()
         pending = (counts["accepted"] - counts["completed"]
                    - counts["errors"] - counts["cancelled"])
+        # drain_s feeds the goodput plane's drain bucket: offline
+        # attribution (obs/goodput.py) carves exactly this much of the
+        # gap before the serve_drain row out of overhead
         summary = {"reason": reason,
                    "outcome": "flushed" if pending == 0 else "timeout",
                    **counts, "pending": max(0, pending),
                    "shed": self.sheds, "refused": self.refused,
-                   "replicas": len(self._slots)}
+                   "replicas": len(self._slots),
+                   "drain_s": round(time.monotonic() - t0, 3)}
         if self.journal is not None:
             self.journal.write("serve_drain", scope="pool", **summary)
         self._drain_summary = summary

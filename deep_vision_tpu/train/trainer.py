@@ -30,6 +30,8 @@ from deep_vision_tpu.core.metrics import MetricLogger
 from deep_vision_tpu.core.train_state import TrainState, create_train_state
 from deep_vision_tpu.data.device_prefetch import DevicePrefetcher, PlacedBatch
 from deep_vision_tpu.obs import perfwatch
+from deep_vision_tpu.obs.alerts import AlertEngine, default_training_rules
+from deep_vision_tpu.obs.goodput import GoodputMeter
 from deep_vision_tpu.obs.stepclock import StepClock
 from deep_vision_tpu.obs.trace import span
 from deep_vision_tpu.parallel.mesh import (
@@ -132,6 +134,20 @@ class Trainer:
             registry=registry, journal=journal, name="train",
             sample_every=telemetry_sample_every,
         )
+        # goodput plane (obs/goodput.py): a journal tap attributing every
+        # wall-clock second to a typed bucket, with periodic
+        # goodput_interval events and a terminal goodput_summary (flushed
+        # by a journal closer); alert engine (obs/alerts.py) evaluates
+        # the knob-tuned training budgets over the same stream
+        self.goodput = (GoodputMeter(journal=journal,
+                                     registry=self.clock.registry)
+                        if journal is not None else None)
+        self.alerts = (AlertEngine(default_training_rules(),
+                                   journal=journal,
+                                   registry=self.clock.registry)
+                       if journal is not None else None)
+        if self.alerts is not None:
+            journal.add_tap(self.alerts.observe)
         self._lr_schedule = lr_schedule
         self.logger = logger or MetricLogger(
             name="train", registry=self.clock.registry, journal=journal)
@@ -371,6 +387,14 @@ class Trainer:
             # last perf-gate verdict / trace digest
             perfwatch.set_quantile_source(self._step_time_quantiles)
             telemetry.add_status("perf", perfwatch.telemetry_status)
+            # the goodput plane's live face: bucket fractions + the
+            # goodput_frac scalar (obs_poll's "gp NN%" column), and the
+            # alert engine behind /alertz + the "alerts" health source
+            if self.goodput is not None:
+                telemetry.add_status("goodput",
+                                     self.goodput.telemetry_status)
+            if self.alerts is not None:
+                telemetry.set_alerts(self.alerts)
             if self.health is not None:
                 telemetry.add_health("train", self.health.healthz)
             if self.hosts is not None:
@@ -817,6 +841,10 @@ class Trainer:
             self.ckpt.wait()
         if self._ema_ckpt is not None:
             self._ema_ckpt.wait()
+        if self.goodput is not None:
+            # terminal goodput_summary (idempotent — the journal closer
+            # covers runs that never reach Trainer.close)
+            self.goodput.close()
 
     def evaluate(self, eval_data: Iterable, epoch: int = 0) -> dict:
         with span("eval", epoch=epoch):
@@ -964,6 +992,7 @@ class Trainer:
         return self.state
 
     def _save_checkpoint(self, epoch: int, val_summary=None) -> bool:
+        t0 = time.perf_counter()
         with span("checkpoint/save", epoch=epoch,
                   step=int(self.state.step)):
             host_state = {
@@ -987,8 +1016,13 @@ class Trainer:
                     host_state=self.ema.state_dict(),
                 )
         if self.journal is not None:
+            # save_ms is the goodput plane's checkpoint feed: offline
+            # attribution (obs/goodput.py) carves exactly this much of
+            # the gap before this row into the checkpoint bucket
             self.journal.write("checkpoint", step=int(self.state.step),
-                               epoch=epoch, saved=bool(saved))
+                               epoch=epoch, saved=bool(saved),
+                               save_ms=round(
+                                   (time.perf_counter() - t0) * 1e3, 3))
         return bool(saved)
 
     def _rebuild_after_backend_loss(self, fallback_epoch: int) -> int:
@@ -1355,14 +1389,18 @@ class Trainer:
         metadata the save recorded — a preempted run resumes on whatever
         slice the scheduler gives back."""
         assert self.ckpt is not None, "no CheckpointManager configured"
+        t0 = time.perf_counter()
         with span("checkpoint/restore", step=step if step is not None
                   else -1):
             self.state, host_state = self.ckpt.restore(self.state, step,
                                                        mesh=self.mesh)
         if self.journal is not None:
+            # restore_ms: the goodput plane's restore feed — the gap
+            # before this note lands in the checkpoint bucket
             self.journal.write(
                 "note", note="resumed", step=int(self.state.step),
-                host_state_found=host_state is not None)
+                host_state_found=host_state is not None,
+                restore_ms=round((time.perf_counter() - t0) * 1e3, 3))
         if not getattr(self.ckpt, "last_restore_placed", False):
             # legacy manager (or nothing restored): re-place on this
             # trainer's mesh — per the sharding table when one is

@@ -1,0 +1,453 @@
+"""Burn-rate alerting (obs/alerts.py): multi-window rule mechanics,
+engine fire/resolve transitions journaled with strict-valid schemas,
+the live==offline determinism contract, knob-gated default rule sets,
+the /alertz + /healthz telemetry integration, and the obs_report
+byte-unchanged gate for journals without goodput/alert events."""
+import json
+import time
+
+from deep_vision_tpu.obs import RunJournal, read_journal
+from deep_vision_tpu.obs.alerts import (
+    ALERT_SEVERITIES,
+    AlertEngine,
+    BurnRateRule,
+    WindowRule,
+    _transport_bad,
+    default_rules,
+    default_serving_rules,
+    default_training_rules,
+    evaluate_journal,
+)
+from deep_vision_tpu.obs.registry import Registry
+
+
+def tr(ts, outcome="ok", status=200, latency_ms=5.0):
+    return {"event": "transport_request", "ts": ts, "run_id": "r1",
+            "outcome": outcome, "status": status, "latency_ms": latency_ms,
+            "deadline_ms": 1000.0}
+
+
+def burn_rule(**kw):
+    args = dict(classify=_transport_bad, budget=0.01, burn=2.0,
+                fast_s=2.0, slow_s=8.0, min_count=4, severity="page")
+    args.update(kw)
+    return BurnRateRule("serve_error_burn", **args)
+
+
+def drive(engine, rows):
+    for r in rows:
+        engine.observe(r)
+    return engine
+
+
+# -- BurnRateRule mechanics ---------------------------------------------------
+
+class TestBurnRateRule:
+    def test_fires_on_both_windows_then_resolves(self):
+        eng = AlertEngine([burn_rule()])
+        rows = [tr(t / 4.0) for t in range(5)]          # 0.0 .. 1.0 ok
+        rows += [tr(1.25, "error", 500), tr(1.5, "torn", 0)]
+        drive(eng, rows)
+        active = eng.active()
+        assert [a["rule"] for a in active] == ["serve_error_burn"]
+        assert active[0]["severity"] == "page"
+        assert active[0]["value"] > active[0]["threshold"] == 0.02
+        assert eng.has_active_page()
+        # clean traffic advances EVENT time; once the errors age out of
+        # the fast window the rule stops firing and the alert resolves
+        drive(eng, [tr(2.0 + t / 4.0) for t in range(9)])  # 2.0 .. 4.0
+        assert eng.active() == [] and not eng.has_active_page()
+        pairs = eng.pairs()
+        assert len(pairs) == 1
+        assert pairs[0]["rule"] == "serve_error_burn"
+        assert pairs[0]["resolved_ts"] is not None
+        assert pairs[0]["resolved_ts"] > pairs[0]["fired_ts"]
+
+    def test_slow_window_guards_against_blips(self):
+        # one bad in 80 ok: the FAST ratio alone would page (1/21 in
+        # the last 2 s > 2%), but the slow window says the budget is
+        # fine (1/81 < 2%) — no alert
+        rows = [tr(t / 10.0) for t in range(80)]        # 0.0 .. 7.9
+        rows.append(tr(7.95, "error", 500))
+        eng = drive(AlertEngine([burn_rule()]), rows)
+        assert eng.active() == []
+
+    def test_min_count_guards_thin_fast_window(self):
+        # 100% bad but only 3 samples: below min_count, no page
+        rows = [tr(0.0, "error", 500), tr(0.5, "error", 500),
+                tr(1.0, "error", 500)]
+        eng = drive(AlertEngine([burn_rule()]), rows)
+        assert eng.active() == []
+        eng.observe(tr(1.5, "error", 500))  # the 4th tips it
+        assert [a["rule"] for a in eng.active()] == ["serve_error_burn"]
+
+    def test_policy_outcomes_do_not_burn_budget(self):
+        # sheds / deadline refusals / 4xx are policy, not budget burn
+        rows = [tr(t / 4.0, "shed", 429) for t in range(8)]
+        rows += [tr(2.0 + t / 4.0, "ok", 400) for t in range(8)]
+        eng = drive(AlertEngine([burn_rule()]), rows)
+        assert eng.active() == []
+
+    def test_describe_shape(self):
+        d = burn_rule().describe()
+        assert d["kind"] == "burn_rate" and d["name"] == "serve_error_burn"
+        assert d["severity"] in ALERT_SEVERITIES
+
+
+# -- WindowRule mechanics -----------------------------------------------------
+
+class TestWindowRule:
+    def _steps(self, vals, dt=1.0, field="recompiles"):
+        return [{"event": "step", "ts": i * dt, "step": i, field: v}
+                for i, v in enumerate(vals)]
+
+    def test_delta_agg_catches_counter_burst(self):
+        # recompiles is CUMULATIVE: max-min over the window is the burst
+        rule = WindowRule("recompile_burst",
+                          value=lambda r: r.get("recompiles"),
+                          bound=8.0, window_s=60.0, agg="delta")
+        eng = drive(AlertEngine([rule]), self._steps([2, 3, 4]))
+        assert eng.active() == []
+        eng.observe(self._steps([2, 3, 4, 13])[-1])
+        assert [a["rule"] for a in eng.active()] == ["recompile_burst"]
+        assert eng.active()[0]["value"] == 11.0
+        assert not eng.has_active_page()  # ticket severity
+
+    def test_below_direction_is_the_goodput_floor(self):
+        rule = WindowRule("goodput_floor",
+                          value=lambda r: r.get("goodput_frac"),
+                          bound=0.8, window_s=60.0, agg="mean",
+                          direction="below", min_count=1)
+        rows = [{"event": "goodput_interval", "ts": 1.0,
+                 "goodput_frac": 0.9},
+                {"event": "goodput_interval", "ts": 2.0,
+                 "goodput_frac": 0.3}]
+        eng = AlertEngine([rule])
+        eng.observe(rows[0])
+        assert eng.active() == []
+        eng.observe(rows[1])  # mean 0.6 < 0.8
+        assert [a["rule"] for a in eng.active()] == ["goodput_floor"]
+
+    def test_window_expiry_resolves(self):
+        rule = WindowRule("hot", value=lambda r: r.get("v"), bound=5.0,
+                          window_s=4.0, agg="max")
+        eng = AlertEngine([rule])
+        drive(eng, [{"event": "x", "ts": 0.0, "v": 9.0},
+                    {"event": "x", "ts": 1.0, "v": 9.0}])
+        assert eng.active()
+        # the hot samples age out; fresh cool ones hold the window open
+        drive(eng, [{"event": "x", "ts": 6.0, "v": 1.0},
+                    {"event": "x", "ts": 7.0, "v": 1.0}])
+        assert eng.active() == []
+        assert eng.pairs()[0]["resolved_ts"] == 6.0
+
+    def test_min_count(self):
+        rule = WindowRule("hot", value=lambda r: r.get("v"), bound=5.0,
+                          window_s=60.0, agg="p95", min_count=3)
+        eng = drive(AlertEngine([rule]),
+                    [{"event": "x", "ts": 0.0, "v": 99.0},
+                     {"event": "x", "ts": 1.0, "v": 99.0}])
+        assert eng.active() == []  # two samples is noise, not a signal
+
+
+# -- engine transitions: journaled, schema-valid, deterministic ---------------
+
+class TestEngine:
+    def _fire_resolve_rows(self, base):
+        rows = [tr(base + t / 4.0) for t in range(5)]
+        rows += [tr(base + 1.25, "error", 500),
+                 tr(base + 1.5, "error", 503)]
+        rows += [tr(base + 2.0 + t / 4.0) for t in range(9)]
+        return rows
+
+    def test_transitions_journaled_and_strict_valid(self, tmp_path):
+        from tools.check_journal import check_journal
+
+        j = RunJournal(str(tmp_path / "run.jsonl"), kind="serve")
+        j.manifest(config={"name": "t", "task": "serve"})
+        eng = AlertEngine([burn_rule()], journal=j)
+        j.add_tap(eng.observe)
+        base = round(time.time(), 3)
+        for r in self._fire_resolve_rows(base):
+            j.write(r.pop("event"), **{k: v for k, v in r.items()
+                                       if k != "run_id"})
+        j.close()
+        events = read_journal(j.path)
+        fired = [e for e in events if e.get("event") == "alert_fired"]
+        resolved = [e for e in events
+                    if e.get("event") == "alert_resolved"]
+        assert len(fired) == 1 and len(resolved) == 1
+        assert fired[0]["rule"] == resolved[0]["rule"] == "serve_error_burn"
+        assert fired[0]["severity"] == "page"
+        assert fired[0]["value"] > fired[0]["threshold"]
+        assert resolved[0]["dur_s"] > 0
+        # the engine's own verdict rows are skipped on ingestion, so the
+        # tap observing its own write cannot recurse or re-trigger
+        assert check_journal(j.path, strict=True) == []
+
+    def test_live_equals_offline_replay(self, tmp_path):
+        """The determinism contract the fleetnet smoke asserts end to
+        end: replaying the journal the live engine wrote (its own
+        alert_fired/alert_resolved rows included) through a fresh
+        engine reproduces the exact fired->resolved pairs."""
+        j = RunJournal(str(tmp_path / "run.jsonl"), kind="serve")
+        live = AlertEngine([burn_rule()], journal=j)
+        j.add_tap(live.observe)
+        base = round(time.time(), 3)
+        for r in self._fire_resolve_rows(base):
+            j.write(r.pop("event"), **{k: v for k, v in r.items()
+                                       if k != "run_id"})
+        j.close()
+        offline = evaluate_journal(read_journal(j.path),
+                                   rules=[burn_rule()])
+        key = lambda pairs: [(p["rule"], p["fired_ts"], p["resolved_ts"])
+                             for p in pairs]
+        assert key(live.pairs()) == key(offline.pairs())
+        assert len(live.pairs()) == 1
+
+    def test_event_time_only_no_wall_clock_resolution(self):
+        # frozen event time: an alert CANNOT resolve while no rows flow,
+        # no matter how much wall clock passes — live and offline agree
+        eng = drive(AlertEngine([burn_rule()]),
+                    [tr(t / 4.0) for t in range(4)]
+                    + [tr(1.25, "error", 500)])
+        assert eng.active()
+        assert eng.evaluate() != []  # re-evaluation at frozen event time
+        assert eng.active()
+
+    def test_clean_stream_fires_zero_alerts(self, monkeypatch):
+        for k in ("DVT_ALERT_FAST_S", "DVT_ALERT_SLOW_S",
+                  "DVT_ALERT_ERROR_BUDGET", "DVT_ALERT_BURN",
+                  "DVT_ALERT_GOODPUT_FLOOR", "DVT_ALERT_LATENCY_BUDGET_MS",
+                  "DVT_ALERT_RECOMPILE_BURST",
+                  "DVT_ALERT_STARVATION_FRAC"):
+            monkeypatch.delenv(k, raising=False)
+        rows = [tr(t / 10.0) for t in range(100)]
+        rows += [{"event": "step", "ts": 10.0 + i, "step": i,
+                  "step_time_ms": 100.0, "data_wait_ms": 1.0,
+                  "dispatch_ms": 50.0, "recompiles": 2}
+                 for i in range(20)]
+        eng = evaluate_journal(rows)  # stock knob-tuned rule set
+        assert eng.active() == [] and eng.pairs() == []
+
+    def test_gauge_tracks_active_count(self):
+        reg = Registry()
+        eng = AlertEngine([burn_rule()], registry=reg)
+        drive(eng, [tr(t / 4.0, "error", 500) for t in range(5)])
+        assert reg.gauge("alerts_active").value == 1
+        drive(eng, [tr(3.0 + t / 4.0) for t in range(9)])
+        assert reg.gauge("alerts_active").value == 0
+
+    def test_alertz_shape(self):
+        eng = drive(AlertEngine([burn_rule()]), [tr(0.0)])
+        az = eng.alertz()
+        assert az["now"] == 0.0 and az["active"] == []
+        assert az["history"] == []
+        assert [r["name"] for r in az["rules"]] == ["serve_error_burn"]
+
+
+# -- knob-gated default rule sets ---------------------------------------------
+
+class TestDefaultRules:
+    def test_serving_always_has_the_error_burn_page(self, monkeypatch):
+        monkeypatch.delenv("DVT_ALERT_LATENCY_BUDGET_MS", raising=False)
+        names = [r.name for r in default_serving_rules()]
+        assert names == ["serve_error_burn"]
+        monkeypatch.setenv("DVT_ALERT_LATENCY_BUDGET_MS", "250")
+        names = [r.name for r in default_serving_rules()]
+        assert names == ["serve_error_burn", "serve_latency_budget"]
+
+    def test_training_rules_gate_on_knobs(self, monkeypatch):
+        for k in ("DVT_ALERT_GOODPUT_FLOOR",
+                  "DVT_ALERT_STARVATION_FRAC"):
+            monkeypatch.delenv(k, raising=False)
+        monkeypatch.setenv("DVT_ALERT_RECOMPILE_BURST", "0")  # disable
+        assert default_training_rules() == []
+        monkeypatch.setenv("DVT_ALERT_GOODPUT_FLOOR", "0.5")
+        monkeypatch.setenv("DVT_ALERT_RECOMPILE_BURST", "8")
+        monkeypatch.setenv("DVT_ALERT_STARVATION_FRAC", "0.5")
+        names = [r.name for r in default_training_rules()]
+        assert names == ["goodput_floor", "recompile_burst",
+                         "data_starvation"]
+        assert len(default_rules()) == len(names) + len(
+            default_serving_rules())
+
+
+# -- telemetry integration: /alertz + the page-severity health flip -----------
+
+class TestTelemetry:
+    def test_alertz_route_and_healthz_flip(self, tmp_path):
+        from tests.test_telemetry import get
+
+        from deep_vision_tpu.obs.telemetry import TelemetryServer
+
+        reg = Registry()
+        j = RunJournal(str(tmp_path / "run.jsonl"), kind="serve")
+        tele = TelemetryServer(port=0, role="serve", registry=reg,
+                               journal=j, discovery_dir=str(tmp_path))
+        tele.start()
+        try:
+            # no engine attached: pollable, empty
+            code, _, body = get(tele.address, "/alertz")
+            assert code == 200
+            assert json.loads(body) == {"now": None, "active": [],
+                                        "history": [], "rules": []}
+            eng = AlertEngine([burn_rule()], journal=j)
+            j.add_tap(eng.observe)
+            tele.set_alerts(eng)
+            code, _, body = get(tele.address, "/healthz")
+            assert code == 200  # no active page: healthy
+            drive(eng, [tr(t / 4.0, "error", 500) for t in range(5)])
+            code, _, body = get(tele.address, "/alertz")
+            az = json.loads(body)
+            assert code == 200
+            assert [a["rule"] for a in az["active"]] == ["serve_error_burn"]
+            assert az["rules"][0]["kind"] == "burn_rate"
+            # a firing page fails the "alerts" health source -> 503
+            code, _, body = get(tele.address, "/healthz")
+            row = json.loads(body)
+            assert code == 503
+            assert row["checks"]["alerts"]["paging"] == ["serve_error_burn"]
+            # resolution flips it back
+            drive(eng, [tr(3.0 + t / 4.0) for t in range(9)])
+            code, _, _ = get(tele.address, "/healthz")
+            assert code == 200
+        finally:
+            tele.close()
+            if not j._closed:
+                j.close()
+
+    def test_obs_poll_strict_alerts_exit_and_columns(self, tmp_path, capsys):
+        """The scriptable pager: obs_poll renders the gp%% + ALERTS
+        columns from /statusz + /alertz and --strict-alerts turns a
+        firing rule into a non-zero exit."""
+        from tools import obs_poll
+
+        from deep_vision_tpu.obs.goodput import GoodputMeter
+        from deep_vision_tpu.obs.telemetry import TelemetryServer
+
+        reg = Registry()
+        j = RunJournal(str(tmp_path / "run.jsonl"), kind="serve")
+        tele = TelemetryServer(port=0, role="serve", registry=reg,
+                               journal=j, discovery_dir=str(tmp_path))
+        tele.start()
+        try:
+            meter = GoodputMeter(journal=j, registry=reg)
+            tele.add_status("goodput", meter.telemetry_status)
+            eng = AlertEngine([burn_rule()], journal=j)
+            tele.set_alerts(eng)
+            assert obs_poll.main(["--run-dir", str(tmp_path),
+                                  "--strict-alerts"]) == 0
+            out = capsys.readouterr().out
+            assert "gp " in out and "ALERTS" not in out
+            drive(eng, [tr(t / 4.0, "error", 500) for t in range(5)])
+            # a page flips healthz AND the strict exit; the column names
+            # the firing rule so the one-liner says what is burning
+            assert obs_poll.main(["--run-dir", str(tmp_path),
+                                  "--strict-alerts"]) == 1
+            out = capsys.readouterr().out
+            assert "ALERTS serve_error_burn" in out
+            assert "UNHEALTHY(alerts)" in out
+        finally:
+            tele.close()
+            if not j._closed:
+                j.close()
+
+
+# -- schema drift guard + obs_report byte-unchanged gate ----------------------
+
+class TestSchema:
+    def test_severity_enum_does_not_drift(self):
+        from tools.check_journal import ALERT_SEVERITIES as CJ_SEVERITIES
+
+        assert set(ALERT_SEVERITIES) == CJ_SEVERITIES
+
+    def test_strict_rejects_bad_alert_rows(self, tmp_path):
+        from tools.check_journal import check_journal
+
+        path = str(tmp_path / "j.jsonl")
+        base = {"ts": time.time(), "run_id": "r1"}
+        rows = [
+            {"event": "run_manifest", "kind": "serve", "argv": [], **base},
+            {"event": "alert_fired", "rule": "", "severity": "siren",
+             "value": "high", "threshold": 0.1, **base},
+            {"event": "alert_resolved", "rule": "r", "severity": "page",
+             "dur_s": -2.0, **base},
+            {"event": "exit", "status": "clean_exit", **base},
+        ]
+        with open(path, "w") as f:
+            for r in rows:
+                f.write(json.dumps(r) + "\n")
+        errs = check_journal(path, strict=True)
+        assert any("severity" in e for e in errs), errs
+        assert any("rule" in e for e in errs), errs
+        assert any("value" in e for e in errs), errs
+        assert any("dur_s" in e for e in errs), errs
+
+
+class TestReportGate:
+    def _base_events(self):
+        base = 1000.0
+        return [
+            {"event": "run_manifest", "ts": base, "run_id": "r1",
+             "kind": "train", "argv": []},
+            {"event": "step", "ts": base + 1.0, "run_id": "r1", "step": 1,
+             "step_time_ms": 100.0, "data_wait_ms": 1.0,
+             "dispatch_ms": 50.0},
+            {"event": "exit", "ts": base + 2.0, "run_id": "r1",
+             "status": "clean_exit"},
+        ]
+
+    def test_report_without_new_events_is_unchanged(self):
+        """A pre-goodput journal renders byte-identical: the summarizers
+        return None, no keys attach, no section appears."""
+        from tools.obs_report import (
+            render,
+            summarize_alerts,
+            summarize_goodput,
+            summarize_run,
+        )
+
+        events = self._base_events()
+        assert summarize_goodput(events) is None
+        assert summarize_alerts(events) is None
+        out = summarize_run(events)
+        assert "goodput" not in out and "alerts" not in out
+        text = render(out)
+        assert "goodput" not in text and "alert" not in text
+        # and the gate is the ONLY thing between the two renderings: the
+        # same run WITH goodput/alert rows gains exactly the new section
+        rich = events[:-1] + [
+            {"event": "goodput_summary", "ts": 1001.5, "run_id": "r1",
+             "wall_s": 1.5, "goodput_frac": 0.8, "imbalance_frac": 0.0,
+             "buckets": {"productive_step": 1.2, "overhead": 0.3}},
+            {"event": "alert_fired", "ts": 1001.6, "run_id": "r1",
+             "rule": "serve_error_burn", "severity": "page",
+             "value": 0.5, "threshold": 0.02, "window_s": 2.0},
+            {"event": "alert_resolved", "ts": 1001.9, "run_id": "r1",
+             "rule": "serve_error_burn", "severity": "page",
+             "dur_s": 0.3},
+        ] + events[-1:]
+        rich_text = render(summarize_run(rich))
+        assert "goodput" in rich_text
+        assert "serve_error_burn" in rich_text
+        assert "resolved after 0.3 s" in rich_text
+
+    def test_interval_only_journal_still_reports(self):
+        # a SIGKILLed run leaves only interval rows — the report
+        # accumulates them instead of going dark
+        from tools.obs_report import summarize_goodput
+
+        events = self._base_events()[:-1] + [
+            {"event": "goodput_interval", "ts": 1001.0, "run_id": "r1",
+             "dur_s": 10.0, "goodput_frac": 0.6,
+             "buckets": {"productive_step": 6.0, "overhead": 4.0}},
+            {"event": "goodput_interval", "ts": 1011.0, "run_id": "r1",
+             "dur_s": 10.0, "goodput_frac": 0.6,
+             "buckets": {"productive_step": 6.0, "overhead": 4.0}},
+        ]
+        g = summarize_goodput(events)
+        assert g["source"] == "intervals"
+        assert g["wall_s"] == 20.0
+        assert abs(g["goodput_frac"] - 0.6) < 1e-9
+        assert g["imbalance_frac"] < 1e-9

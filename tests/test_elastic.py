@@ -54,6 +54,12 @@ class _Journal:
     def step(self, step, **fields):  # StepClock's per-step funnel
         self.rows.append({"event": "step", "step": step, **fields})
 
+    def add_tap(self, fn):  # observer hooks (GoodputMeter, AlertEngine):
+        pass                # inert here — these tests assert row trails
+
+    def add_closer(self, fn):
+        pass
+
 
 # -- classification -----------------------------------------------------------
 

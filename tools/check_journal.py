@@ -93,6 +93,11 @@ EVENT_FIELDS = {
     "perf_profile": ("name", "collective_count", "collective_bytes"),
     "perf_collective": ("name", "kind", "dtype", "ops", "bytes"),
     "perf_regression": ("metric", "baseline", "observed", "threshold"),
+    "goodput_interval": ("dur_s", "buckets"),
+    "goodput_summary": ("wall_s", "buckets", "goodput_frac",
+                        "imbalance_frac"),
+    "alert_fired": ("rule", "severity", "value", "threshold"),
+    "alert_resolved": ("rule", "severity", "dur_s"),
 }
 HEALTH_KINDS = {"non_finite", "loss_spike", "divergence", "hang",
                 "watchdog_started"}
@@ -141,6 +146,15 @@ TRANSPORT_SERVER_OUTCOMES = {"started", "stopped", "failed"}
 # inventory parser recognizes
 PERF_COLLECTIVE_KINDS = {"all-reduce", "all-gather", "reduce-scatter",
                          "all-to-all", "collective-permute"}
+# goodput plane (obs/goodput.py GOODPUT_BUCKETS, kept in sync by
+# tests/test_goodput.py): every wall-clock second of a run lands in
+# exactly one of these
+GOODPUT_BUCKETS = {"productive_step", "data_wait", "compile", "checkpoint",
+                   "host_loss_recovery", "replica_respawn",
+                   "rendezvous_wait", "drain", "overhead"}
+# burn-rate alerting (obs/alerts.py ALERT_SEVERITIES, kept in sync by
+# tests/test_alerts.py)
+ALERT_SEVERITIES = {"page", "ticket"}
 # cross-process trace context (obs/propagate.py): W3C-traceparent-shaped
 # ids stamped onto journal events written under an installed context —
 # any event may carry them, so the hex-shape check applies everywhere
@@ -366,6 +380,51 @@ def check_journal(path: str, require_exit: bool = False,
                 if not isinstance(row.get(k), (int, float)):
                     errors.append(f"{path}:{i}: perf_regression {k} must "
                                   f"be numeric, got {row.get(k)!r}")
+        if ev in ("goodput_interval", "goodput_summary"):
+            # wall-clock attribution (obs/goodput.py): buckets is a
+            # {bucket: seconds} mapping over the closed enum — a key
+            # this checker has never heard of means the producer and
+            # the offline tooling disagree about where time can go
+            b = row.get("buckets")
+            if not isinstance(b, dict) or not all(
+                    k in GOODPUT_BUCKETS and
+                    isinstance(v, (int, float)) and not isinstance(v, bool)
+                    and v >= 0 for k, v in b.items()):
+                errors.append(f"{path}:{i}: {ev} buckets must map known "
+                              f"bucket names to non-negative seconds, got "
+                              f"{b!r}")
+            dur_key = "dur_s" if ev == "goodput_interval" else "wall_s"
+            d = row.get(dur_key)
+            if not isinstance(d, (int, float)) or isinstance(d, bool) \
+                    or d < 0:
+                errors.append(f"{path}:{i}: {ev} {dur_key} must be "
+                              f"non-negative seconds, got {d!r}")
+        if ev == "goodput_summary":
+            for k in ("goodput_frac", "imbalance_frac"):
+                v = row.get(k)
+                if not isinstance(v, (int, float)) or isinstance(v, bool) \
+                        or not 0.0 <= v <= 1.0:
+                    errors.append(f"{path}:{i}: goodput_summary {k} must "
+                                  f"be a fraction in [0, 1], got {v!r}")
+        if ev in ("alert_fired", "alert_resolved"):
+            if not isinstance(row.get("rule"), str) or not row.get("rule"):
+                errors.append(f"{path}:{i}: {ev} rule must be a rule name, "
+                              f"got {row.get('rule')!r}")
+            if row.get("severity") not in ALERT_SEVERITIES:
+                errors.append(f"{path}:{i}: unknown {ev} severity "
+                              f"{row.get('severity')!r}")
+        if ev == "alert_fired":
+            for k in ("value", "threshold"):
+                if not isinstance(row.get(k), (int, float)) or \
+                        isinstance(row.get(k), bool):
+                    errors.append(f"{path}:{i}: alert_fired {k} must be "
+                                  f"numeric, got {row.get(k)!r}")
+        if ev == "alert_resolved" and (
+                not isinstance(row.get("dur_s"), (int, float))
+                or isinstance(row.get("dur_s"), bool)
+                or row.get("dur_s", -1) < 0):
+            errors.append(f"{path}:{i}: alert_resolved dur_s must be "
+                          f"non-negative seconds, got {row.get('dur_s')!r}")
         # trace context rides ANY event written under an installed
         # context (obs/journal.py stamps it); when present the ids must
         # be W3C-shaped or obs/merge.py's timelines silently fragment

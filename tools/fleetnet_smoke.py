@@ -38,6 +38,14 @@ round trip:
                 obs_report renders the fleet-edge section, locksmith
                 (armed the whole run) reports zero violations, and the
                 flight dir is empty.
+  6. goodput    the wall-clock ledger (obs/goodput.py) covers every
+                second within 2% with the kill window billed to
+                replica_respawn; the error burn-rate alert fired live
+                during the kill (visible on /alertz), resolved under
+                clean traffic, and an offline replay of the journal
+                (obs/alerts.py evaluate_journal) reproduces the exact
+                fired/resolved pairs; goodput_frac lands as a MAD-gated
+                row in artifacts/perf_ledger.jsonl.
 
 Exit status 0 = every contract held; 1 = something broke.
 """
@@ -81,6 +89,16 @@ def main(argv: Optional[List[str]] = None) -> int:
                    help="requests in the sustained-load episode")
     args = p.parse_args(argv)
 
+    # burn-rate windows at smoke scale: the SIGKILL episode is ~1 s of
+    # traffic, so the fast/slow windows must fit inside the smoke's wall
+    # clock for the alert to both fire and resolve; the budget drops so
+    # even a minimal one-error kill window burns past budget * burn.
+    # Set via env (not arguments) so the offline replay at the end reads
+    # the SAME knob-tuned rule set the live engine did.
+    os.environ["DVT_ALERT_FAST_S"] = "2.0"
+    os.environ["DVT_ALERT_SLOW_S"] = "8.0"
+    os.environ["DVT_ALERT_ERROR_BUDGET"] = "0.002"
+
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -93,6 +111,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         propagate,
         set_flight,
     )
+    from deep_vision_tpu.obs.alerts import (
+        AlertEngine,
+        default_serving_rules,
+        evaluate_journal,
+    )
+    from deep_vision_tpu.obs.goodput import GoodputMeter, attribute_journal
     from deep_vision_tpu.obs.registry import Registry
     from deep_vision_tpu.obs.telemetry import TelemetryServer
     from deep_vision_tpu.resilience import RetryPolicy
@@ -123,6 +147,15 @@ def main(argv: Optional[List[str]] = None) -> int:
                            journal=journal, flight=flight,
                            discovery_dir=work)
     tele.start()
+    # the goodput/alert plane rides the parent journal: the meter taps
+    # every row into the wall-clock ledger, the engine evaluates the
+    # knob-tuned serving rules at event time, and /alertz serves both
+    goodput = GoodputMeter(journal=journal, registry=registry)
+    tele.add_status("goodput", goodput.telemetry_status)
+    alerts = AlertEngine(default_serving_rules(), journal=journal,
+                         registry=registry)
+    journal.add_tap(alerts.observe)
+    tele.set_alerts(alerts)
 
     # -- phase 1: process fleet up, zero-compile children ---------------
     print(f"phase 1: {args.replicas} replica PROCESSES warm from the "
@@ -199,6 +232,17 @@ def main(argv: Optional[List[str]] = None) -> int:
                     for e in edge_errs),
             f"all {len(edge_errs)} edge errors are typed ReplicaLost "
             "behind retryable 503s")
+    # the kill window PAGED: the error burn-rate rule fired live, and
+    # the /alertz endpoint (what tools/obs_poll.py --strict-alerts
+    # polls) shows it active over the wire — event time is frozen at
+    # the last row, so the verdict holds until clean traffic ages the
+    # errors out of the fast window
+    from tools.obs_poll import fetch_json
+    az = fetch_json(tele.host, tele.port, "/alertz")
+    live_active = [a.get("rule") for a in (az or {}).get("active", [])]
+    f.check("serve_error_burn" in live_active,
+            f"burn-rate alert fired during the kill window and /alertz "
+            f"shows it live ({live_active})")
     deadline = time.time() + 60
     while time.time() < deadline and not all(
             s == "serving" for s in pool.replica_states().values()):
@@ -229,6 +273,20 @@ def main(argv: Optional[List[str]] = None) -> int:
     f.check(len(xc["checked"]) == 2,
             "client p50+p99 cross-checked against /varz over the wire "
             f"({len(xc['skewed'])} skew warning(s))")
+    # resolution needs event time to move PAST the kill window: feed
+    # clean probe traffic until the errors age out of the fast window
+    # and the engine journals alert_resolved (bounded, not forever)
+    rc0 = HttpLoadClient("127.0.0.1", tp.port, registry=registry)
+    resolve_deadline = time.time() + 30
+    while time.time() < resolve_deadline and any(
+            a["rule"] == "serve_error_burn" for a in alerts.active()):
+        rc0.submit("toy", probe_img).result(timeout=60)
+        time.sleep(0.25)
+    rc0.close()
+    az = fetch_json(tele.host, tele.port, "/alertz")
+    f.check(not (az or {}).get("active"),
+            "burn-rate alert RESOLVED under clean post-respawn traffic "
+            "(/alertz active list empty)")
 
     # -- phase 3: canary swap across processes --------------------------
     print("phase 3: canary process serves new weights; promote hot-swaps "
@@ -391,6 +449,53 @@ def main(argv: Optional[List[str]] = None) -> int:
             and "429x" in rep.stdout,
             "obs_report renders the fleet-edge section (status ledger "
             "with the 429s)")
+    f.check("goodput" in rep.stdout and "alerts" in rep.stdout
+            and "serve_error_burn" in rep.stdout,
+            "obs_report renders the goodput table and the alert "
+            "timeline from the same journal")
+
+    # -- phase 6: goodput ledger + live==offline alert agreement --------
+    print("phase 6: every second attributed; offline replay reproduces "
+          "the live alert pairs")
+    events = read_jsonl(j_path)
+    f.check(any(e.get("event") == "goodput_summary" for e in events),
+            "the live GoodputMeter flushed a terminal goodput_summary "
+            "via the journal closer")
+    acct = attribute_journal(events)
+    imb = acct.imbalance_frac()
+    f.check(imb <= 0.02,
+            f"goodput buckets sum to wall clock within 2% "
+            f"(imbalance {imb * 100:.2f}%)")
+    f.check(acct.buckets["replica_respawn"] > 0,
+            "the SIGKILL->respawn window is attributed to "
+            f"replica_respawn ({acct.buckets['replica_respawn']:.2f} s), "
+            "not overhead")
+    # live == offline, literally: the engine is a pure state machine
+    # over event time, so replaying the journal through a fresh engine
+    # with the same knob-tuned rules reproduces the exact transitions
+    live_pairs = [(h["rule"], h["fired_ts"], h["resolved_ts"])
+                  for h in alerts.pairs()]
+    off_pairs = [(h["rule"], h["fired_ts"], h["resolved_ts"])
+                 for h in evaluate_journal(
+                     events, rules=default_serving_rules()).pairs()]
+    f.check(live_pairs == off_pairs,
+            f"offline journal replay reproduces the live alert pairs "
+            f"exactly ({live_pairs} == {off_pairs})")
+    f.check(len(live_pairs) == 1
+            and live_pairs[0][0] == "serve_error_burn"
+            and live_pairs[0][2] is not None,
+            "exactly one alert episode: serve_error_burn fired and "
+            "resolved; no spurious rule ever paged")
+    from tools.perf_gate import PerfLedger, default_env, gate_result
+    gp = acct.goodput_frac()
+    verdict = gate_result(
+        PerfLedger(os.path.join(ROOT, "artifacts", "perf_ledger.jsonl")),
+        "goodput_frac", gp, unit="frac",
+        env=dict(default_env(), suite="fleetnet_smoke"),
+        direction="higher")
+    f.check(verdict["verdict"] in ("pass", "insufficient_history"),
+            f"goodput_frac {gp:.3f} passes the MAD gate "
+            f"(verdict {verdict['verdict']})")
 
     if f.errors:
         print(f"\nfleetnet-smoke: {len(f.errors)} contract(s) BROKEN "

@@ -24,7 +24,12 @@ item 1 demands:
      assignment over the survivors (typed `data_reshard`);
   5. every surviving host's journal passes `check_journal --strict`,
      the locksmith is armed throughout with ZERO lock-order violations,
-     and `obs_report` renders the membership timeline.
+     and `obs_report` renders the membership timeline;
+  6. the goodput ledger (obs/goodput.py) covers every wall-clock second
+     within 2%, bills the kill -> first-post-resize-step window to the
+     named failure buckets (host_loss_recovery / rendezvous_wait /
+     checkpoint / compile, not overhead), and lands `goodput_frac` as a
+     MAD-gated row in artifacts/perf_ledger.jsonl.
 
 Worker mode (`--host N`) is the host agent: rendezvous first (pure
 stdlib, so a re-exec'd survivor re-arms its lease BEFORE paying the
@@ -395,6 +400,55 @@ def main(argv: Optional[List[str]] = None) -> int:
         f.check(rep.returncode == 0 and "host_lost" in rep.stdout
                 and "membership" in rep.stdout,
                 "obs_report renders the membership timeline")
+
+        # -- phase 5: goodput attribution -------------------------------
+        # every wall-clock second of each survivor's life must land in a
+        # named bucket, and the seconds between the SIGKILL and the first
+        # post-resize step must land in the FAILURE buckets — a recovery
+        # that bills itself to overhead is unattributed downtime
+        from deep_vision_tpu.obs.goodput import attribute_journal
+        from tools.perf_gate import PerfLedger, default_env, gate_result
+
+        fracs = []
+        for i in survivors:
+            evs = read_jsonl(journals[i])
+            f.check(any(e.get("event") == "goodput_summary" for e in evs),
+                    f"h{i}'s live GoodputMeter flushed a goodput_summary "
+                    "(once per incarnation, via the journal closer)")
+            acct = attribute_journal(evs)
+            imb = acct.imbalance_frac()
+            f.check(imb <= 0.02,
+                    f"h{i} goodput buckets sum to wall clock within 2% "
+                    f"(imbalance {imb * 100:.2f}%)")
+            rec = acct.buckets["host_loss_recovery"]
+            f.check(rec > 0,
+                    f"h{i} attributed the host-loss window to "
+                    f"host_loss_recovery ({rec:.2f} s)")
+            lost = [e for e in evs if e.get("event") == "host_lost"]
+            resized = [e for e in evs if e.get("event") == "world_resized"]
+            post = [e for e in evs if e.get("event") == "step"
+                    and resized and float(e["ts"]) > float(resized[0]["ts"])]
+            if lost and post:
+                window = float(post[0]["ts"]) - float(lost[0]["ts"])
+                named = (rec + acct.buckets["rendezvous_wait"]
+                         + acct.buckets["checkpoint"]
+                         + acct.buckets["compile"])
+                f.check(named >= 0.5 * window,
+                        f"h{i}'s recovery window ({window:.1f} s) lands "
+                        f"predominantly in named failure buckets "
+                        f"({named:.1f} s in recovery/rendezvous/"
+                        "checkpoint/compile, not overhead)")
+            fracs.append(acct.goodput_frac())
+        if fracs and not f.errors:
+            verdict = gate_result(
+                PerfLedger(os.path.join(ROOT, "artifacts",
+                                        "perf_ledger.jsonl")),
+                "goodput_frac", min(fracs), unit="frac",
+                env=dict(default_env(), suite="host_smoke"),
+                direction="higher")
+            f.check(verdict["verdict"] in ("pass", "insufficient_history"),
+                    f"goodput_frac {min(fracs):.3f} passes the MAD gate "
+                    f"(verdict {verdict['verdict']})")
     finally:
         for pr, log in procs.values():
             if pr.poll() is None:

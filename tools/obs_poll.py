@@ -18,7 +18,12 @@ discovery file from a crashed process, the poll's liveness signal.
 
 `--once` (default) prints a single snapshot and exits 0 if every
 discovered process is healthy, 1 otherwise (the scriptable form the
-live smoke uses). `--watch SECONDS` loops forever.
+live smoke uses). `--watch SECONDS` loops forever. Each line also
+carries a `gp NN%` goodput column (obs/goodput.py status source) and an
+`ALERTS rule,rule` column from the process's /alertz endpoint when any
+burn-rate rule is firing; `--strict-alerts` turns a firing alert into a
+non-zero exit (and stops a --watch loop at the first firing snapshot) —
+the scriptable "page me" form the fleet smoke uses.
 """
 from __future__ import annotations
 
@@ -66,7 +71,7 @@ def _unhealthy_names(body) -> str:
     return ",".join(sorted(bad))
 
 
-def format_line(rec: dict, status: dict, ok, health) -> str:
+def format_line(rec: dict, status: dict, ok, health, alertz=None) -> str:
     """One line: role pid@host:port verdict + role-specific vitals."""
     role = str(rec.get("role", "?"))
     where = f"pid {rec.get('pid', '?')} @ {rec['host']}:{rec['port']}"
@@ -102,25 +107,46 @@ def format_line(rec: dict, status: dict, ok, health) -> str:
             vitals.append(f"gate {gate['verdict']}")
         if src.get("recompiles") is not None:
             vitals.append(f"recompiles {src['recompiles']}")
+        # goodput status source (obs/goodput.py): what fraction of this
+        # process's wall clock went to productive work
+        if src.get("goodput_frac") is not None:
+            vitals.append(f"gp {float(src['goodput_frac']) * 100:.0f}%")
+    # the /alertz column: which burn-rate rules are firing RIGHT NOW —
+    # an empty active list renders nothing, keeping clean lines clean
+    active = _active_alerts(alertz)
+    if active:
+        vitals.append("ALERTS " + ",".join(active))
     return f"{role:<13}{where:<28} {verdict:<10} " + "  ".join(vitals)
 
 
+def _active_alerts(alertz) -> list:
+    """Sorted active rule names out of a /alertz body; [] when none."""
+    if not isinstance(alertz, dict):
+        return []
+    return sorted(str(a.get("rule", "?")) for a in
+                  (alertz.get("active") or []) if isinstance(a, dict))
+
+
 def poll_once(run_dir: str, timeout: float = 3.0):
-    """(lines, all_ok) for every discovery file under run_dir."""
+    """(lines, all_ok, any_alert) for every discovery file under run_dir."""
     from deep_vision_tpu.obs.telemetry import read_discovery
 
-    lines, all_ok = [], True
+    lines, all_ok, any_alert = [], True, False
     recs = read_discovery(run_dir)
     if not recs:
-        return [f"no telemetry discovery files under {run_dir}"], False
+        return [f"no telemetry discovery files under {run_dir}"], False, False
     for rec in recs:
         host, port = rec["host"], rec["port"]
         ok, health = _healthz(host, port, timeout=timeout)
         status = fetch_json(host, port, "/statusz", timeout=timeout)
-        lines.append(format_line(rec, status, ok, health))
+        alertz = fetch_json(host, port, "/alertz", timeout=timeout) \
+            if ok is not None else None
+        lines.append(format_line(rec, status, ok, health, alertz))
         if ok is not True:
             all_ok = False
-    return lines, all_ok
+        if _active_alerts(alertz):
+            any_alert = True
+    return lines, all_ok, any_alert
 
 
 def main(argv=None) -> int:
@@ -132,12 +158,20 @@ def main(argv=None) -> int:
                    help="refresh every SECONDS instead of one snapshot")
     p.add_argument("--timeout", type=float, default=3.0,
                    help="per-endpoint HTTP timeout")
+    p.add_argument("--strict-alerts", action="store_true",
+                   help="exit non-zero while any burn-rate alert is "
+                        "firing (/alertz active list non-empty); with "
+                        "--watch the loop exits at the first firing "
+                        "snapshot instead of running forever")
     args = p.parse_args(argv)
 
     while True:
-        lines, all_ok = poll_once(args.run_dir, timeout=args.timeout)
+        lines, all_ok, any_alert = poll_once(args.run_dir,
+                                             timeout=args.timeout)
         for line in lines:
             print(line)
+        if args.strict_alerts and any_alert:
+            return 1
         if args.watch is None:
             return 0 if all_ok else 1
         print("--", flush=True)

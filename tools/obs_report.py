@@ -112,6 +112,12 @@ def summarize_run(events: List[dict]) -> dict:
     perf = summarize_perf(events)
     if perf:
         out["perf"] = perf
+    goodput = summarize_goodput(events)
+    if goodput:
+        out["goodput"] = goodput
+    alerts = summarize_alerts(events)
+    if alerts:
+        out["alerts"] = alerts
     terminal = next(
         (e for e in reversed(events) if e.get("event") in ("exit", "crash")),
         None)
@@ -453,6 +459,76 @@ def summarize_perf(events: List[dict]) -> Optional[dict]:
              if e.get(k) is not None}
             for e in regressions]
     return out
+
+
+def summarize_goodput(events: List[dict]) -> Optional[dict]:
+    """The wall-clock attribution view (obs/goodput.py events): the
+    terminal `goodput_summary` when the run wrote one (the meter's
+    closer guarantees it on any journal'd exit), else the running total
+    accumulated over `goodput_interval` rows (a SIGKILLed run leaves
+    only those). The imbalance flag marks an accounting leak — buckets
+    that do not sum to wall clock within 2%. None when the journal
+    carries no goodput events, so every pre-goodput report renders
+    byte-unchanged."""
+    summaries = [e for e in events if e.get("event") == "goodput_summary"]
+    intervals = [e for e in events if e.get("event") == "goodput_interval"]
+    if not (summaries or intervals):
+        return None
+    if summaries:
+        last = summaries[-1]
+        buckets = {k: float(v) for k, v in (last.get("buckets") or {}).items()
+                   if isinstance(v, (int, float))}
+        return {"source": "summary",
+                "wall_s": float(last.get("wall_s", 0.0) or 0.0),
+                "goodput_frac": float(last.get("goodput_frac", 0.0) or 0.0),
+                "imbalance_frac": float(
+                    last.get("imbalance_frac", 0.0) or 0.0),
+                "buckets": buckets}
+    buckets = {}
+    wall = 0.0
+    for e in intervals:
+        wall += float(e.get("dur_s", 0.0) or 0.0)
+        for k, v in (e.get("buckets") or {}).items():
+            if isinstance(v, (int, float)):
+                buckets[k] = buckets.get(k, 0.0) + float(v)
+    total = sum(buckets.values())
+    return {"source": "intervals",
+            "wall_s": wall,
+            "goodput_frac": (buckets.get("productive_step", 0.0) / wall
+                             if wall > 0 else 0.0),
+            "imbalance_frac": (abs(wall - total) / wall if wall > 0
+                               else 0.0),
+            "buckets": buckets}
+
+
+def summarize_alerts(events: List[dict]) -> Optional[dict]:
+    """The burn-rate alert timeline (obs/alerts.py events): each
+    `alert_fired` paired FIFO-per-rule with its `alert_resolved`, plus
+    any alert still firing when the journal ended. None when the journal
+    carries no alert events — alert-free reports render byte-unchanged."""
+    fired = [e for e in events if e.get("event") == "alert_fired"]
+    resolved = [e for e in events if e.get("event") == "alert_resolved"]
+    if not (fired or resolved):
+        return None
+    open_by_rule: Dict[str, List[dict]] = {}
+    episodes: List[dict] = []
+    for e in fired:
+        row = {k: e.get(k) for k in
+               ("rule", "severity", "value", "threshold", "window_s")
+               if e.get(k) is not None}
+        row["fired_ts"] = e.get("ts")
+        episodes.append(row)
+        open_by_rule.setdefault(str(e.get("rule", "?")), []).append(row)
+    for e in resolved:
+        q = open_by_rule.get(str(e.get("rule", "?")))
+        if q:
+            row = q.pop(0)
+            row["resolved_ts"] = e.get("ts")
+            if isinstance(e.get("dur_s"), (int, float)):
+                row["dur_s"] = float(e["dur_s"])
+    return {"episodes": episodes,
+            "still_firing": sum(1 for r in episodes
+                                if "resolved_ts" not in r)}
 
 
 def summarize_fleet(requests: List[dict], sheds: List[dict],
@@ -804,6 +880,48 @@ def render(summary: dict) -> str:
                          f" vs baseline {r.get('baseline')} "
                          f"(threshold {r.get('threshold')}, "
                          f"{r.get('direction', '?')} is better)"))
+    # goodput attribution (obs/goodput.py): where every wall-clock
+    # second went — the "where did the time go" table, with the
+    # accounting-leak flag when buckets fail to cover the wall clock
+    goodput = summary.get("goodput")
+    if goodput:
+        head = (f"{goodput['goodput_frac'] * 100:.1f}% productive over "
+                f"{goodput['wall_s']:.1f} s wall")
+        if goodput.get("source") == "intervals":
+            head += "  (no terminal summary; accumulated from intervals)"
+        if goodput.get("imbalance_frac", 0.0) > 0.02:
+            head += (f"  ACCOUNTING LEAK "
+                     f"{goodput['imbalance_frac'] * 100:.1f}%")
+        rows.append(("goodput", head))
+        wall = goodput.get("wall_s") or 0.0
+        for name, secs in sorted(goodput.get("buckets", {}).items(),
+                                 key=lambda kv: -kv[1]):
+            if secs <= 0:
+                continue
+            pct = f" ({secs / wall * 100:.1f}%)" if wall > 0 else ""
+            rows.append((f"  {name}", f"{secs:.2f} s{pct}"))
+    # burn-rate alert timeline (obs/alerts.py): each fired episode with
+    # its resolution — the pager history, replayable offline from the
+    # same journal the live engine consumed
+    alerts = summary.get("alerts")
+    if alerts:
+        head = f"{len(alerts['episodes'])} episode(s)"
+        if alerts.get("still_firing"):
+            head += f", {alerts['still_firing']} STILL FIRING"
+        rows.append(("alerts", head))
+        for a in alerts["episodes"]:
+            detail = f"[{a.get('severity', '?')}]"
+            if isinstance(a.get("value"), (int, float)) and \
+                    isinstance(a.get("threshold"), (int, float)):
+                detail += (f" value {a['value']:.4g} > "
+                           f"threshold {a['threshold']:.4g}")
+            if "resolved_ts" in a:
+                detail += (f", resolved after {a.get('dur_s', 0.0):.1f} s"
+                           if isinstance(a.get("dur_s"), (int, float))
+                           else ", resolved")
+            else:
+                detail += ", still firing at journal end"
+            rows.append((f"  {a.get('rule', '?')}", detail))
     # profiler captures: every decision the autoprof policy made, so the
     # table answers "why does this run have three trace dirs" directly
     for e in summary.get("captures", []):
